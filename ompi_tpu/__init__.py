@@ -1,0 +1,62 @@
+"""ompi_tpu — a TPU-native communication framework with Open MPI's capabilities.
+
+Brand-new design (NOT a port) with the capability surface of the reference
+Open MPI tree surveyed in SURVEY.md:
+
+- a portable core runtime: MCA-style component registry with typed control
+  variables, verbosity streams, a single progress engine, software performance
+  counters (reference: opal/mca/base, opal/runtime, ompi/runtime/ompi_spc.h)
+- MPI-semantics point-to-point over host transports (self / shared memory /
+  TCP) with an ob1-style matching engine (reference: ompi/mca/pml/ob1)
+- the full collective suite with per-communicator priority-stacked algorithm
+  selection (reference: ompi/mca/coll, coll_base_comm_select.c)
+- TPU as a first-class accelerator: an ``accelerator/tpu`` component over
+  jax/PJRT and a ``coll/xla`` device plane lowering collectives on
+  TPU-resident buffers to XLA collectives over the ICI mesh
+  (reference north star: opal/mca/accelerator + ompi/mca/coll/accelerator)
+- a TPU-native parallelism layer (``ompi_tpu.parallel``): communicator ↔
+  jax.sharding.Mesh mapping, ring-attention sequence parallelism, pipeline
+  CollectivePermute schedules, MoE all-to-all dispatch.
+
+The host plane is multi-controller SPMD (N OS processes, like MPI ranks); the
+device plane is single-controller SPMD over a jax Mesh. The accelerator
+framework bridges the two.
+"""
+
+__version__ = "0.1.0"
+
+# MPI version the semantics target (reference: VERSION:24-25 -> MPI 3.1 + MPI-4
+# sessions/partitioned/big-count subset).
+MPI_VERSION = (3, 1)
+
+from ompi_tpu.core import cvar, output  # noqa: F401  (registry bootstrap)
+
+
+def init(*args, **kwargs):
+    """Initialize the framework (MPI_Init equivalent).
+
+    Reference call stack: ompi/mpi/c/init.c:67 -> ompi_mpi_init
+    -> ompi_mpi_instance_init (ompi/instance/instance.c:822).
+    """
+    from ompi_tpu.runtime import state
+
+    return state.init(*args, **kwargs)
+
+
+def finalize():
+    """Finalize the framework (MPI_Finalize equivalent)."""
+    from ompi_tpu.runtime import state
+
+    return state.finalize()
+
+
+def initialized():
+    from ompi_tpu.runtime import state
+
+    return state.is_initialized()
+
+
+def finalized():
+    from ompi_tpu.runtime import state
+
+    return state.is_finalized()

@@ -1,0 +1,8 @@
+"""Core runtime services (the OPAL-equivalent layer).
+
+Reference: opal/ — class system, MCA base (component discovery + variable
+system), progress engine, output streams. In Python the object/refcount layer
+(opal/class/opal_object.h) is the language runtime itself; what we keep is the
+*architectural* machinery: frameworks, components, typed cvars/pvars, one
+progress engine, verbosity streams.
+"""

@@ -1,0 +1,81 @@
+"""Verbosity streams + show_help — framework-scoped diagnostics.
+
+Reference: opal/util/output.c (per-framework opal_output streams with MCA
+verbosity cvars like ``coll_base_verbose``) and opal/util/show_help.c
+(templated user-facing error messages).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict
+
+from ompi_tpu.core import cvar
+
+_streams: Dict[str, "Stream"] = {}
+_lock = threading.Lock()
+
+
+class Stream:
+    def __init__(self, framework: str) -> None:
+        self.framework = framework
+        self.var = cvar.register(
+            f"{framework}_verbose", 0, int,
+            help=f"Verbosity level for the {framework} framework (0..100)",
+            level=8)
+
+    @property
+    def level(self) -> int:
+        return self.var.get()
+
+    def verbose(self, level: int, msg: str, *args) -> None:
+        if self.level >= level:
+            if args:
+                msg = msg % args
+            pid = os.getpid()
+            ts = time.strftime("%H:%M:%S")
+            sys.stderr.write(f"[{ts}:{pid}] {self.framework}: {msg}\n")
+
+    def error(self, msg: str, *args) -> None:
+        if args:
+            msg = msg % args
+        sys.stderr.write(f"[{os.getpid()}] {self.framework} ERROR: {msg}\n")
+
+
+def stream(framework: str) -> Stream:
+    with _lock:
+        st = _streams.get(framework)
+        if st is None:
+            st = Stream(framework)
+            _streams[framework] = st
+        return st
+
+
+_HELP = {
+    "no-component": (
+        "No usable component found for framework '%s'.\n"
+        "Requested: %s. Available: %s.\n"
+        "Check the OMPI_TPU_%s environment variable."),
+    "store-unreachable": (
+        "Could not reach the rendezvous store at %s.\n"
+        "Was this process launched by tpurun, and is rank 0 alive?"),
+    "comm-revoked": (
+        "Communicator %s has been revoked (a participating process failed).\n"
+        "Use comm.shrink() / comm.agree() to recover (ULFM semantics)."),
+}
+
+
+def show_help(topic: str, *args) -> str:
+    """Render a templated help message (reference: opal_show_help)."""
+    tmpl = _HELP.get(topic)
+    if tmpl is None:
+        msg = f"unknown help topic {topic!r} (args: {args!r})"
+    else:
+        msg = tmpl % args if args else tmpl
+    banner = "-" * 60
+    text = f"{banner}\n{msg}\n{banner}\n"
+    sys.stderr.write(text)
+    return text

@@ -1,0 +1,74 @@
+"""The progress engine — one poll loop driving every transport.
+
+Reference: opal/runtime/opal_progress.c — components register callbacks
+(opal_progress_register :416); opal_progress() sweeps them (:216-224) and
+yields after an idle spin threshold (:50-68, default 10000). Blocking
+completion waits call progress in a loop (ompi/request SYNC_WAIT,
+opal/threads/wait_sync.h:52).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List
+
+from ompi_tpu.core import cvar
+
+_callbacks: List[Callable[[], int]] = []
+_lock = threading.Lock()
+
+_spin_var = cvar.register(
+    "progress_spin_count", 10000, int,
+    help="Idle progress iterations before yielding the CPU "
+         "(reference: opal_progress.c:51)", level=8)
+
+
+def register(cb: Callable[[], int]) -> None:
+    with _lock:
+        if cb not in _callbacks:
+            _callbacks.append(cb)
+
+
+def unregister(cb: Callable[[], int]) -> None:
+    with _lock:
+        try:
+            _callbacks.remove(cb)
+        except ValueError:
+            pass
+
+
+def progress() -> int:
+    """Sweep all registered callbacks; returns # of events completed."""
+    events = 0
+    # snapshot without the lock held during callbacks (callbacks may
+    # register/unregister; reference does the same single-threaded sweep)
+    for cb in tuple(_callbacks):
+        try:
+            events += cb() or 0
+        except StopIteration:
+            unregister(cb)
+    return events
+
+
+def wait_until(cond: Callable[[], bool], timeout: float | None = None) -> bool:
+    """Spin progress until cond() — the SYNC_WAIT equivalent."""
+    spin_max = _spin_var.get()
+    deadline = None if timeout is None else time.monotonic() + timeout
+    idle = 0
+    while not cond():
+        if progress() > 0:
+            idle = 0
+        else:
+            idle += 1
+            if idle >= spin_max:
+                time.sleep(0)  # sched_yield
+                idle = 0
+        if deadline is not None and time.monotonic() > deadline:
+            return cond()
+    return True
+
+
+def reset_for_testing() -> None:
+    with _lock:
+        _callbacks.clear()

@@ -1,0 +1,110 @@
+"""Performance variables + software performance counters (SPC).
+
+Reference: opal/mca/base/mca_base_pvar.c (MPI_T performance variables) and
+ompi/runtime/ompi_spc.h:46-153 (the ~110-counter SPC enum recorded via
+SPC_RECORD() in the API layer and exported as MPI_T pvars). Here a single
+process-wide counter table serves both roles; the MPI_T-style session API is
+:func:`session` / ``read``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+_counters: Dict[str, int] = {}
+_watermarks: Dict[str, int] = {}
+_timers: Dict[str, float] = {}
+_lock = threading.Lock()
+
+# Counter names mirror the reference SPC set where it applies
+# (ompi/runtime/ompi_spc.h): send/recv counts, bytes, collective op counts,
+# unexpected/out-of-sequence message counts, time in progress, etc.
+WELL_KNOWN = (
+    "send", "isend", "recv", "irecv", "bytes_sent", "bytes_received",
+    "unexpected", "out_of_sequence", "matched_probes",
+    "allreduce", "bcast", "reduce", "allgather", "alltoall", "barrier",
+    "reduce_scatter", "gather", "scatter", "scan", "exscan",
+    "allreduce_xla", "bcast_xla", "allgather_xla", "alltoall_xla",
+    "reduce_scatter_xla",
+    "put", "get", "accumulate", "win_lock",
+    "eager", "rndv", "rget",
+    "time_progress_ns",
+)
+
+
+def record(name: str, value: int = 1) -> None:
+    """SPC_RECORD equivalent — add to a counter."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + value
+
+
+def record_hwm(name: str, value: int) -> None:
+    """High-watermark pvar update."""
+    with _lock:
+        if value > _watermarks.get(name, 0):
+            _watermarks[name] = value
+
+
+class timer:
+    """Context manager accumulating wall time into <name>_ns."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        record(self.name + "_ns", time.perf_counter_ns() - self.t0)
+        return False
+
+
+def read(name: str) -> int:
+    with _lock:
+        if name in _counters:
+            return _counters[name]
+        return _watermarks.get(name, 0)
+
+
+def snapshot() -> Dict[str, int]:
+    with _lock:
+        out = dict(_counters)
+        out.update({k + "_hwm": v for k, v in _watermarks.items()})
+        return out
+
+
+def reset() -> None:
+    with _lock:
+        _counters.clear()
+        _watermarks.clear()
+
+
+class session:
+    """MPI_T-style pvar session: delta-reads counters from session start.
+
+    Counter pvars read as deltas; watermark pvars read as the increase over
+    the watermark at session start (MPI_T semantics: watermarks restart from
+    the current value when a handle is bound).
+    """
+
+    def __init__(self) -> None:
+        with _lock:
+            self._base_counters = dict(_counters)
+            self._base_hwm = dict(_watermarks)
+
+    def read(self, name: str) -> int:
+        with _lock:
+            if name in _counters or name in self._base_counters:
+                return _counters.get(name, 0) - \
+                    self._base_counters.get(name, 0)
+            return max(0, _watermarks.get(name, 0) -
+                       self._base_hwm.get(name, 0))
+
+    def snapshot(self) -> Dict[str, int]:
+        cur = globals()["snapshot"]()
+        base = dict(self._base_counters)
+        base.update({k + "_hwm": v for k, v in self._base_hwm.items()})
+        return {k: v - base.get(k, 0) for k, v in cur.items()}

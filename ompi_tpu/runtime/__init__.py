@@ -1,0 +1,8 @@
+"""Runtime plane: rendezvous store, RTE client, launcher, instance state.
+
+Reference: the PMIx/PRRTE plane — mpirun (ompi/tools/mpirun/main.c) execs
+prterun; ranks connect back via PMIx_Init (ompi/runtime/ompi_rte.c:580) and
+exchange endpoints via the modex (opal/mca/pmix/pmix-internal.h:230-366).
+Here: ``tpurun`` spawns ranks and serves a TCP key-value store; ranks connect
+and use put/get/fence as the modex.
+"""

@@ -1,0 +1,204 @@
+"""Rendezvous TCP key-value store — the PMIx server equivalent.
+
+Reference role: OpenPMIx server inside prterun/prted daemons. Supplies the
+modex (endpoint exchange), fences (PMIx_Fence), collectively-unique ID
+allocation (PMIx_Group_construct used for CID allocation,
+ompi/communicator/comm_cid.c:297-463), and abort propagation.
+
+Protocol: length-prefixed pickled tuples, thread-per-connection (rank counts
+are small; the store is control-plane only — no data flows through it).
+SECURITY: pickle framing means the store trusts its peers; it binds loopback
+by default and must only ever listen on job-private interfaces (same trust
+model as PMIx's unix-socket rendezvous). Multi-node deployments should front
+this with the pod network's isolation, not expose it publicly.
+Commands:
+  ("put", key, value)            -> ("ok",)
+  ("get", key, wait: bool)       -> ("val", value) | ("none",)
+  ("fence", tag, nprocs)         -> blocks until nprocs arrive -> ("ok",)
+  ("inc", key, amount)           -> ("val", new_value)   # atomic counter
+  ("abort", rank, reason)        -> ("ok",)  # marks job aborted
+  ("aborted?",)                  -> ("val", reason | None)
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+_LEN = struct.Struct("!I")
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    hdr = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class Store:
+    """The in-process server. Run via start(); address via .addr."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._data: Dict[str, Any] = {}
+        self._counters: Dict[str, int] = {}
+        self._fences: Dict[str, list] = {}  # tag -> [arrived, released]
+        self._cond = threading.Condition()
+        self._aborted: Optional[str] = None
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.addr: Tuple[str, int] = self._sock.getsockname()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Store":
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="ompi-tpu-store", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- server internals -------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                msg = recv_msg(conn)
+                reply = self._handle(msg)
+                send_msg(conn, reply)
+        except (ConnectionError, OSError, EOFError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, msg: Tuple) -> Tuple:
+        op = msg[0]
+        if op == "put":
+            _, key, value = msg
+            with self._cond:
+                self._data[key] = value
+                self._cond.notify_all()
+            return ("ok",)
+        if op == "get":
+            _, key, wait = msg
+            with self._cond:
+                while wait and key not in self._data and not self._aborted:
+                    self._cond.wait(timeout=1.0)
+                if key in self._data:
+                    return ("val", self._data[key])
+                if self._aborted:
+                    return ("aborted", self._aborted)
+                return ("none",)
+        if op == "fence":
+            # tags must be unique per epoch (the rte client appends an
+            # epoch counter, mirroring PMIx fence instance uniqueness)
+            _, tag, nprocs = msg
+            with self._cond:
+                entry = self._fences.setdefault(tag, [0, 0])
+                entry[0] += 1
+                self._cond.notify_all()
+                while entry[0] < nprocs and not self._aborted:
+                    self._cond.wait(timeout=1.0)
+                if self._aborted:
+                    return ("aborted", self._aborted)
+                entry[1] += 1
+                if entry[1] >= nprocs:  # last releaser reclaims the entry
+                    self._fences.pop(tag, None)
+                return ("ok",)
+        if op == "inc":
+            _, key, amount = msg
+            with self._cond:
+                self._counters[key] = self._counters.get(key, 0) + amount
+                return ("val", self._counters[key])
+        if op == "abort":
+            _, rank, reason = msg
+            with self._cond:
+                self._aborted = f"rank {rank}: {reason}"
+                self._cond.notify_all()
+            return ("ok",)
+        if op == "aborted?":
+            with self._cond:
+                return ("val", self._aborted)
+        return ("err", f"unknown op {op!r}")
+
+
+class Client:
+    """Client handle to a Store (used by ompi_tpu.runtime.rte)."""
+
+    def __init__(self, addr: Tuple[str, int]) -> None:
+        self.addr = addr
+        self._sock = socket.create_connection(addr, timeout=60)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def _rpc(self, *msg: Any) -> Tuple:
+        with self._lock:
+            send_msg(self._sock, msg)
+            self._sock.settimeout(None)
+            reply = recv_msg(self._sock)
+        if reply[0] == "aborted":
+            raise RuntimeError(f"job aborted: {reply[1]}")
+        if reply[0] == "err":
+            raise RuntimeError(reply[1])
+        return reply
+
+    def put(self, key: str, value: Any) -> None:
+        self._rpc("put", key, value)
+
+    def get(self, key: str, wait: bool = True) -> Any:
+        reply = self._rpc("get", key, wait)
+        return reply[1] if reply[0] == "val" else None
+
+    def fence(self, tag: str, nprocs: int) -> None:
+        self._rpc("fence", tag, nprocs)
+
+    def inc(self, key: str, amount: int = 1) -> int:
+        return self._rpc("inc", key, amount)[1]
+
+    def abort(self, rank: int, reason: str) -> None:
+        try:
+            self._rpc("abort", rank, reason)
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,149 @@
+"""Instance state — the MPI-4 session/init engine.
+
+Reference: ompi/instance/instance.c (ompi_mpi_instance_init_common:360 —
+opal_init, rte init, framework opens, pml select, comm init) and
+ompi/runtime/ompi_mpi_init.c:359. MPI_Init maps to init(); MPI-4 Sessions
+map to :class:`Session` (each session can hold its own error handling and
+group derivation, sharing the singleton instance underneath, as in the
+reference where sessions share ompi_mpi_instance).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, Optional
+
+from ompi_tpu.core import output, registry
+from ompi_tpu.runtime import rte
+
+_lock = threading.RLock()
+_initialized = False
+_finalized = False
+_world = None
+_self_comm = None
+_out = output.stream("runtime")
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def is_finalized() -> bool:
+    return _finalized
+
+
+def init(thread_level: int = 0):
+    """Bring up the instance; returns COMM_WORLD.
+
+    Order mirrors ompi_mpi_instance_init_common (instance.c:360):
+    rte/PMIx first, then frameworks, then endpoint exchange (modex),
+    then communicator construction + collective selection.
+    """
+    global _initialized, _world, _self_comm
+    with _lock:
+        if _finalized:
+            raise RuntimeError("init after finalize (MPI semantics)")
+        if _initialized:
+            return _world
+        rte.init()
+        _out.verbose(2, "rte up: rank %d/%d job %s",
+                     rte.rank, rte.size, rte.jobid)
+
+        # accelerator selection happens during core init in the reference
+        # (opal/runtime/opal_init.c:202-206)
+        from ompi_tpu.accelerator import current as _accel_current
+        _accel_current()
+
+        from ompi_tpu import pml
+        from ompi_tpu.comm import build_world
+
+        pml.select()
+        _world, _self_comm = build_world()
+        _initialized = True
+        atexit.register(_atexit_finalize)
+        return _world
+
+
+def world():
+    if not _initialized:
+        init()
+    return _world
+
+
+def comm_self():
+    if not _initialized:
+        init()
+    return _self_comm
+
+
+def finalize() -> None:
+    global _finalized, _initialized, _world, _self_comm
+    with _lock:
+        if _finalized or not _initialized:
+            _finalized = True
+            return
+        try:
+            if _world is not None and rte.size > 1:
+                _world.barrier()
+        except Exception:
+            pass
+        from ompi_tpu import pml
+
+        pml.finalize()
+        registry.close_all()
+        _finalized = True
+        _initialized = False
+        _world = None
+        _self_comm = None
+
+
+def _atexit_finalize() -> None:
+    try:
+        if _initialized and not _finalized:
+            finalize()
+    except Exception:
+        pass
+
+
+class Session:
+    """MPI-4 session (reference: ompi/instance — MPI_Session_init).
+
+    Sessions share the underlying instance; each provides group queries
+    from named process sets and communicator creation from groups.
+    """
+
+    PSET_WORLD = "mpi://WORLD"
+    PSET_SELF = "mpi://SELF"
+
+    def __init__(self, info: Optional[dict] = None) -> None:
+        self.info = dict(info or {})
+        init()
+        self._open = True
+
+    def num_psets(self) -> int:
+        return 2
+
+    def psets(self):
+        return [self.PSET_WORLD, self.PSET_SELF]
+
+    def group_from_pset(self, name: str):
+        if not self._open:
+            raise RuntimeError("session finalized")
+        if name == self.PSET_WORLD:
+            return world().group
+        if name == self.PSET_SELF:
+            return comm_self().group
+        raise KeyError(f"unknown process set {name!r}")
+
+    def comm_from_group(self, group, tag: str = "org.ompi_tpu.default"):
+        from ompi_tpu.comm import comm_create_from_group
+
+        return comm_create_from_group(group, tag)
+
+    def finalize(self) -> None:
+        self._open = False
+
+
+def abort(code: int = 1, reason: str = "MPI_Abort") -> None:
+    rte.abort(reason, code)

@@ -1,0 +1,195 @@
+"""Core layer tests (reference analog: test/util, test/class, MCA var tests)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ompi_tpu.core import cvar, progress, pvar, registry
+
+
+def test_cvar_default_and_set():
+    v = cvar.register("t_alpha_limit", 4096, int, help="test var")
+    assert v.get() == 4096
+    cvar.set("t_alpha_limit", 65536)
+    assert cvar.get("t_alpha_limit") == 65536
+
+
+def test_cvar_env_override():
+    os.environ["OMPI_TPU_T_BETA_LIMIT"] = "123"
+    try:
+        v = cvar.register("t_beta_limit", 7, int)
+        assert v.get() == 123
+    finally:
+        del os.environ["OMPI_TPU_T_BETA_LIMIT"]
+
+
+def test_cvar_bool_parse():
+    os.environ["OMPI_TPU_T_FLAG"] = "yes"
+    try:
+        v = cvar.register("t_flag", False, bool)
+        assert v.get() is True
+    finally:
+        del os.environ["OMPI_TPU_T_FLAG"]
+
+
+def test_cvar_choices():
+    v = cvar.register("t_mode", "fast", str, choices=["fast", "safe"])
+    with pytest.raises(ValueError):
+        v.set("bogus")
+    assert v.get() == "fast"
+
+
+def test_registry_priority_selection():
+    fw = registry.framework("t_fw1")
+
+    @fw.register
+    class Low(registry.Component):
+        NAME = "low"
+        PRIORITY = 10
+
+    @fw.register
+    class High(registry.Component):
+        NAME = "high"
+        PRIORITY = 90
+
+    @fw.register
+    class Broken(registry.Component):
+        NAME = "broken"
+        PRIORITY = 100
+
+        def open(self):
+            return False
+
+    opened = fw.open_components()
+    assert [c.NAME for c in opened] == ["high", "low"]
+    assert fw.select_one().NAME == "high"
+    fw.close_components()
+
+
+def test_registry_exclude_list():
+    fw = registry.framework("t_fw2")
+
+    @fw.register
+    class A(registry.Component):
+        NAME = "a"
+        PRIORITY = 10
+
+    @fw.register
+    class B(registry.Component):
+        NAME = "b"
+        PRIORITY = 20
+
+    cvar.register("t_fw2", "", str)
+    cvar.set("t_fw2", "^b")
+    assert [c.NAME for c in fw.open_components()] == ["a"]
+    fw.close_components()
+
+
+def test_progress_callbacks():
+    hits = []
+
+    def cb():
+        hits.append(1)
+        return 1
+
+    progress.register(cb)
+    try:
+        assert progress.progress() >= 1
+        assert hits
+    finally:
+        progress.unregister(cb)
+
+
+def test_progress_wait_until():
+    state = {"n": 0}
+
+    def cb():
+        state["n"] += 1
+        return 0
+
+    progress.register(cb)
+    try:
+        assert progress.wait_until(lambda: state["n"] >= 5, timeout=5)
+    finally:
+        progress.unregister(cb)
+
+
+def test_pvar_counters(pvar_clean):
+    pvar.record("send", 3)
+    pvar.record("send")
+    assert pvar.read("send") == 4
+    sess = pvar.session()
+    pvar.record("send", 10)
+    assert sess.read("send") == 10
+    pvar.record_hwm("depth", 5)
+    pvar.record_hwm("depth", 3)
+    assert pvar.read("depth") == 5
+
+
+def test_kvstore_roundtrip():
+    from ompi_tpu.runtime import kvstore
+
+    store = kvstore.Store().start()
+    try:
+        c = kvstore.Client(store.addr)
+        c.put("k", {"x": 1})
+        assert c.get("k") == {"x": 1}
+        assert c.get("missing", wait=False) is None
+        assert c.inc("ctr") == 1
+        assert c.inc("ctr", 5) == 6
+        c.close()
+    finally:
+        store.stop()
+
+
+def test_kvstore_fence_blocks_until_all():
+    import threading
+
+    from ompi_tpu.runtime import kvstore
+
+    store = kvstore.Store().start()
+    try:
+        done = []
+
+        def worker(i):
+            c = kvstore.Client(store.addr)
+            c.fence("f1", 3)
+            done.append(i)
+            c.close()
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert sorted(done) == [0, 1, 2]
+    finally:
+        store.stop()
+
+
+def test_launcher_runs_ranks(tmp_path):
+    from ompi_tpu.runtime import launcher
+
+    script = tmp_path / "r.py"
+    script.write_text(
+        "import os, sys\n"
+        "from ompi_tpu.runtime import rte\n"
+        "rte.init()\n"
+        "rte.modex_send('t', rte.rank * 10)\n"
+        "vals = sorted(rte.modex_recv('t', p) for p in range(rte.size))\n"
+        "assert vals == [0, 10, 20], vals\n"
+        "rte.fence()\n")
+    rc = launcher.launch([sys.executable, str(script)], 3, timeout=60)
+    assert rc == 0
+
+
+def test_launcher_propagates_failure(tmp_path):
+    from ompi_tpu.runtime import launcher
+
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    rc = launcher.launch([sys.executable, str(script)], 2, timeout=60)
+    assert rc == 3
