@@ -1,0 +1,54 @@
+"""Datatype engine — MPI derived datatypes + pack/unpack convertor.
+
+Reference: opal/datatype/ (the convertor VM: datatypes compile to vectors of
+ddt_elem_desc_t walked by pack/unpack iterators with partial-completion
+state, opal_datatype_internal.h:115-133) and ompi/datatype/ (the MPI face).
+
+TPU-first redesign: the "compiled" form here is a flat span table
+(offset, length byte ranges per element) held in numpy arrays — packing is
+vectorized gather/scatter over a byte view instead of an interpreter loop,
+which is also the form a future C kernel or on-device gather consumes.
+Partial (pipelined) pack/unpack keeps a byte position, like the reference
+convertor's stack state.
+"""
+
+from ompi_tpu.datatype.datatype import (  # noqa: F401
+    Datatype,
+    PREDEFINED,
+    BYTE,
+    PACKED,
+    CHAR,
+    INT8,
+    UINT8,
+    INT16,
+    UINT16,
+    INT32,
+    UINT32,
+    INT64,
+    UINT64,
+    INT,
+    LONG,
+    FLOAT,
+    DOUBLE,
+    FLOAT16,
+    BFLOAT16,
+    BOOL,
+    COMPLEX64,
+    COMPLEX128,
+    FLOAT_INT,
+    DOUBLE_INT,
+    LONG_INT,
+    TWOINT,
+    SHORT_INT,
+    from_numpy_dtype,
+    contiguous,
+    vector,
+    hvector,
+    indexed,
+    hindexed,
+    indexed_block,
+    create_struct,
+    subarray,
+    resized,
+)
+from ompi_tpu.datatype.convertor import Convertor  # noqa: F401

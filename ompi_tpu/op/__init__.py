@@ -1,0 +1,99 @@
+"""Reduction operations (MPI_Op) — host kernels + user-defined ops.
+
+Reference: ompi/mca/op/ — `base` C loops for every op×type pair plus SIMD
+components (op/avx, op/aarch64) picked per-op by priority (op.h:56-75).
+TPU-first: host kernels are numpy ufuncs (which are themselves SIMD); the
+device plane reduces inside XLA (coll/xla), where the op maps to a lax
+primitive. reduce_local mirrors MPI_Reduce_local
+(ompi/mpi/c/reduce_local.c).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ompi_tpu.datatype.datatype import Datatype
+
+
+class Op:
+    """An MPI reduction operator.
+
+    ``np_fn(a, b) -> result`` elementwise over numpy arrays;
+    ``lax_name`` names the XLA lowering used by coll/xla (e.g. 'add');
+    ``commute`` as per MPI_Op_create's commutativity flag.
+    """
+
+    def __init__(self, name: str, np_fn: Callable, commute: bool = True,
+                 lax_name: Optional[str] = None) -> None:
+        self.name = name
+        self.np_fn = np_fn
+        self.commute = commute
+        self.lax_name = lax_name
+        self.is_builtin = lax_name is not None or name.startswith("MPI_")
+
+    def __call__(self, a, b):
+        return self.np_fn(a, b)
+
+    def __repr__(self) -> str:
+        return f"Op({self.name})"
+
+
+def _minloc(a, b):
+    """MINLOC over (val, loc) struct arrays — lower loc wins ties."""
+    take_b = (b["val"] < a["val"]) | ((b["val"] == a["val"])
+                                      & (b["loc"] < a["loc"]))
+    return np.where(take_b, b, a)
+
+
+def _maxloc(a, b):
+    take_b = (b["val"] > a["val"]) | ((b["val"] == a["val"])
+                                      & (b["loc"] < a["loc"]))
+    return np.where(take_b, b, a)
+
+
+SUM = Op("MPI_SUM", np.add, lax_name="add")
+PROD = Op("MPI_PROD", np.multiply, lax_name="mul")
+MIN = Op("MPI_MIN", np.minimum, lax_name="min")
+MAX = Op("MPI_MAX", np.maximum, lax_name="max")
+LAND = Op("MPI_LAND", np.logical_and, lax_name="and")
+LOR = Op("MPI_LOR", np.logical_or, lax_name="or")
+LXOR = Op("MPI_LXOR", np.logical_xor, lax_name="xor")
+BAND = Op("MPI_BAND", np.bitwise_and, lax_name="and")
+BOR = Op("MPI_BOR", np.bitwise_or, lax_name="or")
+BXOR = Op("MPI_BXOR", np.bitwise_xor, lax_name="xor")
+MINLOC = Op("MPI_MINLOC", _minloc)
+MAXLOC = Op("MPI_MAXLOC", _maxloc)
+REPLACE = Op("MPI_REPLACE", lambda a, b: b, commute=False)
+NO_OP = Op("MPI_NO_OP", lambda a, b: a, commute=False)
+
+BUILTIN = {op.name: op for op in (
+    SUM, PROD, MIN, MAX, LAND, LOR, LXOR, BAND, BOR, BXOR,
+    MINLOC, MAXLOC, REPLACE, NO_OP)}
+
+
+def create(fn: Callable, commute: bool = True, name: str = "user") -> Op:
+    """MPI_Op_create. fn(invec, inoutvec) -> result elementwise arrays."""
+    return Op(name, fn, commute=commute)
+
+
+def reduce_local(inbuf: np.ndarray, inoutbuf: np.ndarray, op: Op,
+                 dtype: Optional[Datatype] = None) -> None:
+    """MPI_Reduce_local: inoutbuf = op(inbuf, inoutbuf), in place.
+
+    Argument order matters for non-commutative user ops: inbuf is the
+    'left' operand, matching MPI's accumulate-order semantics.
+    """
+    result = op.np_fn(inbuf, inoutbuf)
+    np.copyto(inoutbuf, result, casting="same_kind")
+
+
+def apply_bytes(a: bytes, b: bytearray, np_dtype, op: Op) -> None:
+    """Reduce packed byte buffers in place: b = op(a, b) (used by coll).
+
+    ``b`` must be a mutable buffer (bytearray / writable memoryview).
+    """
+    ia = np.frombuffer(a, dtype=np_dtype)
+    ib = np.frombuffer(b, dtype=np_dtype)
+    ib[:] = op.np_fn(ia, ib)

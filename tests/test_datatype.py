@@ -1,0 +1,173 @@
+"""Datatype engine tests (reference analog: test/datatype/ddt_test.c,
+ddt_pack.c, partial.c, position.c, reduce_local.c)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import op
+from ompi_tpu.datatype import (
+    BFLOAT16, DOUBLE, FLOAT, FLOAT_INT, INT32, Convertor, contiguous,
+    create_struct, hindexed, indexed, resized, subarray, vector,
+    from_numpy_dtype,
+)
+from ompi_tpu.datatype import convertor as cv
+
+
+def test_predefined_sizes():
+    assert FLOAT.size == 4 and FLOAT.extent == 4
+    assert DOUBLE.size == 8
+    assert BFLOAT16.size == 2
+    assert FLOAT.is_contiguous
+
+
+def test_contiguous_pack_roundtrip():
+    buf = np.arange(16, dtype=np.float32)
+    t = contiguous(4, FLOAT).commit()
+    data = cv.pack(buf, t, 4)  # all 16 floats
+    assert len(data) == 64
+    out = np.zeros(16, dtype=np.float32)
+    cv.unpack(data, out, t, 4)
+    np.testing.assert_array_equal(out, buf)
+
+
+def test_vector_strided_pack():
+    # pack every other float: classic column-of-matrix pattern
+    buf = np.arange(12, dtype=np.float32).reshape(3, 4)
+    col = vector(3, 1, 4, FLOAT).commit()  # 3 blocks of 1, stride 4
+    data = cv.pack(buf, col, 1)
+    got = np.frombuffer(data, dtype=np.float32)
+    np.testing.assert_array_equal(got, buf[:, 0])
+    # unpack into another matrix's column
+    out = np.zeros((3, 4), dtype=np.float32)
+    cv.unpack(data, out, col, 1)
+    np.testing.assert_array_equal(out[:, 0], buf[:, 0])
+    assert out[:, 1:].sum() == 0
+
+
+def test_vector_count_gt_one_uses_extent():
+    # extent of vector(2,1,2,INT32) spans 3 ints (last block is 1 int);
+    # resize it to 4 ints so count>1 tiles cleanly (MPI resized pattern)
+    t = vector(2, 1, 2, INT32)
+    tr = resized(t, 0, 16).commit()
+    buf = np.arange(8, dtype=np.int32)
+    data = cv.pack(buf, tr, 2)
+    got = np.frombuffer(data, dtype=np.int32)
+    np.testing.assert_array_equal(got, [0, 2, 4, 6])
+
+
+def test_indexed_and_hindexed():
+    buf = np.arange(10, dtype=np.int32)
+    t = indexed([2, 3], [0, 5], INT32).commit()
+    got = np.frombuffer(cv.pack(buf, t, 1), dtype=np.int32)
+    np.testing.assert_array_equal(got, [0, 1, 5, 6, 7])
+    th = hindexed([1, 1], [4, 32], INT32).commit()
+    got = np.frombuffer(cv.pack(buf, th, 1), dtype=np.int32)
+    np.testing.assert_array_equal(got, [1, 8])
+
+
+def test_struct_heterogeneous():
+    # {int32 @0, float64 @8} like a C struct with padding
+    raw = bytearray(16)
+    np.frombuffer(raw, dtype=np.int32, count=1, offset=0)[:] = 7
+    st = create_struct([1, 1], [0, 8], [INT32, DOUBLE])
+    np.frombuffer(raw, dtype=np.float64, count=1, offset=8)[:] = 2.5
+    data = cv.pack(raw, st.commit(), 1)
+    assert len(data) == 12  # packed drops the padding
+    assert np.frombuffer(data[:4], dtype=np.int32)[0] == 7
+    assert np.frombuffer(data[4:], dtype=np.float64)[0] == 2.5
+    out = bytearray(16)
+    cv.unpack(data, out, st, 1)
+    assert np.frombuffer(out, dtype=np.int32, count=1)[0] == 7
+
+
+def test_subarray_2d_tile():
+    buf = np.arange(36, dtype=np.float32).reshape(6, 6)
+    t = subarray([6, 6], [2, 3], [1, 2], FLOAT).commit()
+    got = np.frombuffer(cv.pack(buf, t, 1), dtype=np.float32)
+    np.testing.assert_array_equal(got, buf[1:3, 2:5].reshape(-1))
+
+
+def test_partial_pack_pipeline():
+    """Fragment-at-a-time pack/unpack — the rndv pipeline path
+    (reference: partial.c + convertor position state)."""
+    buf = np.arange(100, dtype=np.float64)
+    t = vector(25, 1, 2, from_numpy_dtype(np.float64)).commit()
+    conv = Convertor(buf, t, 1)
+    frags = []
+    while not conv.done:
+        frags.append(conv.pack(max_bytes=33))  # deliberately unaligned
+    assert sum(map(len, frags)) == t.size
+    out = np.zeros(100, dtype=np.float64)
+    uc = Convertor(out, t, 1)
+    for f in frags:
+        uc.unpack(f)
+    # the vector covers the 25 even indices 0..48 only
+    np.testing.assert_array_equal(out[:50:2], buf[:50:2])
+    assert out[50:].sum() == 0 and out[1:50:2].sum() == 0
+
+
+def test_convertor_checksum():
+    buf = np.arange(64, dtype=np.uint8)
+    c1 = Convertor(buf, from_numpy_dtype(np.uint8), 64, checksum=True)
+    whole = c1.pack()
+    c2 = Convertor(buf, from_numpy_dtype(np.uint8), 64, checksum=True)
+    while not c2.done:
+        c2.pack(max_bytes=7)
+    assert c1.checksum == c2.checksum
+    assert len(whole) == 64
+
+
+def test_set_position_restart():
+    buf = np.arange(32, dtype=np.int32)
+    t = from_numpy_dtype(np.int32)
+    conv = Convertor(buf, t, 32)
+    a = conv.pack(max_bytes=64)
+    conv.set_position(0)
+    b = conv.pack(max_bytes=64)
+    assert a == b
+
+
+def test_reduce_local_sum_and_order():
+    a = np.array([1, 2, 3], dtype=np.float32)
+    b = np.array([10, 20, 30], dtype=np.float32)
+    op.reduce_local(a, b, op.SUM)
+    np.testing.assert_array_equal(b, [11, 22, 33])
+    sub = op.create(lambda x, y: x - y, commute=False)
+    a2 = np.array([5], dtype=np.int32)
+    b2 = np.array([2], dtype=np.int32)
+    op.reduce_local(a2, b2, sub)
+    assert b2[0] == 3  # in - inout, MPI operand order
+
+
+def test_minloc_maxloc():
+    a = np.zeros(2, dtype=FLOAT_INT.base)
+    b = np.zeros(2, dtype=FLOAT_INT.base)
+    a["val"] = [1.0, 9.0]
+    a["loc"] = [0, 0]
+    b["val"] = [3.0, 2.0]
+    b["loc"] = [1, 1]
+    r = op.MINLOC(a, b)
+    assert r["val"].tolist() == [1.0, 2.0]
+    assert r["loc"].tolist() == [0, 1]
+    r = op.MAXLOC(a, b)
+    assert r["val"].tolist() == [3.0, 9.0]
+    assert r["loc"].tolist() == [1, 0]
+
+
+def test_apply_bytes():
+    a = np.array([1, 2, 3], dtype=np.int64).tobytes()
+    b = bytearray(np.array([10, 20, 30], dtype=np.int64).tobytes())
+    op.apply_bytes(a, b, np.int64, op.SUM)
+    np.testing.assert_array_equal(
+        np.frombuffer(b, dtype=np.int64), [11, 22, 33])
+
+
+def test_large_count_spans():
+    """>2GB-style logical sizes stay int64 (reference: large_data.c —
+    the fork's whole point is big-count)."""
+    t = vector(1000, 1, 1000, DOUBLE).commit()
+    spans = t.spans_for_count(1)
+    assert spans.dtype == np.int64
+    big = contiguous(300_000_000, DOUBLE)  # 2.4 GB logical
+    assert big.size == 2_400_000_000
+    assert big.spans_for_count(1)[0][1] == 2_400_000_000
