@@ -1,0 +1,77 @@
+"""Accelerator framework — device buffer integration.
+
+Reference: opal/mca/accelerator/ (accelerator.h:668-711, the 30-entry
+module: check_addr, streams/events, memcpy sync+async, alloc/free, IPC,
+device info...). Exactly one active component + null fallback
+(accelerator.h:24-27); selected during core init (opal_init.c:202-206).
+
+TPU-native redesign: PJRT (via jax) is the device runtime. check_addr
+classifies jax.Array vs host memory; memcpy maps to device_put /
+device_get; "streams" map to the PJRT async dispatch + block_until_ready
+events; IPC handles are out of scope for single-controller TPU (the device
+plane shares buffers through the mesh instead — see ompi_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ompi_tpu.core import registry
+
+framework = registry.framework("accelerator")
+
+_current = None
+
+
+class Accelerator(registry.Component):
+    """The module interface (subset of the reference's 30 entries that
+    has meaning on this runtime; the rest raise NotImplementedError to
+    make capability probing explicit)."""
+
+    def check_addr(self, buf) -> bool:
+        """True if buf is device-resident (reference: check_addr)."""
+        return False
+
+    def to_host(self, buf):
+        """Device -> host numpy copy (memcpy DtoH)."""
+        raise NotImplementedError
+
+    def to_device(self, host_array, like=None):
+        """Host -> device copy (memcpy HtoD)."""
+        raise NotImplementedError
+
+    def copy_async(self, src, dst_like=None):
+        """Async DtoH: returns an Event completing when readable."""
+        raise NotImplementedError
+
+    def alloc(self, shape, dtype):
+        raise NotImplementedError
+
+    def num_devices(self) -> int:
+        return 0
+
+    def device_info(self) -> dict:
+        return {}
+
+    def mem_bandwidth(self) -> Optional[float]:
+        """Device memory bandwidth GB/s if known (reference: mem_bw)."""
+        return None
+
+    def synchronize(self) -> None:
+        pass
+
+
+def current() -> Accelerator:
+    """The selected accelerator component (null always qualifies)."""
+    global _current
+    if _current is None:
+        from ompi_tpu.accelerator import null, tpu  # register components
+
+        _current = framework.select_one()
+    return _current
+
+
+def reset_for_testing() -> None:
+    global _current
+    _current = None
+    framework.close_components()

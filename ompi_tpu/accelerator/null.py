@@ -1,0 +1,33 @@
+"""accelerator/null — host-only fallback.
+
+Reference: opal/mca/accelerator/null/accelerator_null_component.c:138 —
+check_addr always says "host", memcpys are host memcpy. Always available;
+keeps every accelerator-consuming path exercised on CPU-only machines.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.accelerator import Accelerator, framework
+
+
+@framework.register
+class NullAccelerator(Accelerator):
+    NAME = "null"
+    PRIORITY = 1  # the fallthrough
+
+    def check_addr(self, buf) -> bool:
+        return False
+
+    def to_host(self, buf):
+        return np.asarray(buf)
+
+    def to_device(self, host_array, like=None):
+        return np.asarray(host_array)
+
+    def alloc(self, shape, dtype):
+        return np.empty(shape, dtype=dtype)
+
+    def num_devices(self) -> int:
+        return 0

@@ -1,0 +1,139 @@
+"""accelerator/tpu — the PJRT/jax device component (THE north star hook).
+
+Reference model: opal/mca/accelerator/cuda/accelerator_cuda.c (1,235 LoC
+over the CUDA driver API) with **lazy initialization** under a lock so the
+device runtime is only touched on first real use
+(accelerator_cuda_component.c:44,128,258). Here the device API is jax/PJRT:
+
+- check_addr     -> isinstance(buf, jax.Array) + platform check
+                    (cuPointerGetAttributes equivalent)
+- memcpy DtoH    -> np.asarray(jax.device_get)
+- memcpy HtoD    -> jax.device_put
+- events/streams -> PJRT async dispatch; Event.wait = block_until_ready
+- device info    -> jax.devices() metadata
+- mem_bw         -> known HBM numbers per TPU generation
+
+Import of jax is deferred (lazy init) exactly as the reference defers
+touching libcuda — opening this component must be free on hosts that
+never see a device buffer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ompi_tpu.accelerator import Accelerator, framework
+from ompi_tpu.core import output
+
+_out = output.stream("accelerator_tpu")
+
+# HBM bandwidth GB/s by TPU generation (public spec numbers)
+_HBM_BW = {"v4": 1228.0, "v5e": 819.0, "v5 lite": 819.0, "v5p": 2765.0,
+           "v6e": 1640.0}
+
+
+@framework.register
+class TpuAccelerator(Accelerator):
+    NAME = "tpu"
+    PRIORITY = 50  # above null when usable
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._jax = None
+        self._np = None
+        self._devices = None
+
+    def open(self) -> bool:
+        # stay lazily-openable: only verify jax is importable cheaply.
+        # Actual device discovery happens on first use (reference lazy
+        # init pattern).
+        try:
+            import importlib.util
+
+            return importlib.util.find_spec("jax") is not None
+        except Exception:
+            return False
+
+    def _ensure(self):
+        with self._lock:
+            if self._jax is None:
+                import jax
+                import numpy as np
+
+                self._jax = jax
+                self._np = np
+                self._devices = jax.devices()
+                _out.verbose(2, "lazy init: %d device(s): %s",
+                             len(self._devices),
+                             [str(d) for d in self._devices])
+        return self._jax
+
+    # -- module entries ---------------------------------------------------
+    def check_addr(self, buf) -> bool:
+        # cheap type check first; do NOT force jax import for host arrays
+        mod = type(buf).__module__
+        if not (mod.startswith("jax") or mod.startswith("jaxlib")):
+            return False
+        jax = self._ensure()
+        return isinstance(buf, jax.Array)
+
+    def to_host(self, buf):
+        jax = self._ensure()
+        return self._np.asarray(jax.device_get(buf))
+
+    def to_device(self, host_array, like=None):
+        jax = self._ensure()
+        if like is not None and hasattr(like, "sharding"):
+            return jax.device_put(host_array, like.sharding)
+        return jax.device_put(host_array)
+
+    def copy_async(self, src, dst_like=None):
+        """Async DtoH returning an Event (PJRT dispatch is async)."""
+        jax = self._ensure()
+
+        class Event:
+            def __init__(self, arr):
+                self.arr = arr
+
+            def query(self) -> bool:
+                return True  # PJRT arrays expose readiness via block
+
+            def wait(self):
+                return np.asarray(self.arr)
+
+        import numpy as np
+
+        return Event(jax.device_get(src))
+
+    def alloc(self, shape, dtype):
+        jax = self._ensure()
+        return jax.numpy.zeros(shape, dtype=dtype)
+
+    def num_devices(self) -> int:
+        self._ensure()
+        return len(self._devices)
+
+    def device_info(self) -> dict:
+        self._ensure()
+        if not self._devices:
+            return {}
+        d = self._devices[0]
+        return {
+            "platform": d.platform,
+            "kind": getattr(d, "device_kind", "unknown"),
+            "id": d.id,
+            "process_index": getattr(d, "process_index", 0),
+        }
+
+    def mem_bandwidth(self) -> Optional[float]:
+        kind = self.device_info().get("kind", "").lower()
+        for key, bw in _HBM_BW.items():
+            if key in kind:
+                return bw
+        return None
+
+    def synchronize(self) -> None:
+        if self._jax is not None:
+            (self._jax.effects_barrier
+             if hasattr(self._jax, "effects_barrier") else lambda: None)()

@@ -1,0 +1,10 @@
+"""BTL — byte transfer layer (host transports).
+
+Reference: opal/mca/btl/ (btl.h:1172-1240 module struct). Components here:
+``self`` (loopback, reference btl/self), ``sm`` (shared-memory rings,
+reference btl/sm FIFO + fast-box), ``tcp`` (reference btl/tcp). Each BTL
+delivers framed active-message bytes to the PML callback, reliable and
+ordered per (sender, receiver) direction.
+"""
+
+from ompi_tpu.btl.base import Btl, set_recv_callback, framework  # noqa: F401

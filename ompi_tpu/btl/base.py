@@ -1,0 +1,94 @@
+"""BTL base interface and the BML endpoint multiplexer.
+
+Reference: opal/mca/btl/btl.h (module interface) + ompi/mca/bml/r2 (the
+BTL multiplexer choosing, per peer, which BTL to use by exclusivity/
+priority). The PML registers one receive callback
+(mca_bml_base_register AM callbacks, pml_ob1.c:478-527).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ompi_tpu.core import cvar, progress, registry
+from ompi_tpu.runtime import rte
+
+framework = registry.framework("btl")
+
+# the PML's AM callback: fn(data: bytes) — framing is PML-private
+_recv_cb: Optional[Callable[[bytes], None]] = None
+
+
+def set_recv_callback(cb: Callable[[bytes], None]) -> None:
+    global _recv_cb
+    _recv_cb = cb
+
+
+def deliver(data: bytes) -> None:
+    if _recv_cb is not None:
+        _recv_cb(data)
+
+
+class Btl(registry.Component):
+    """One transport. Reliable ordered delivery per directed pair."""
+
+    #: max payload the PML may push in one eager send (btl_eager_limit)
+    EAGER_LIMIT_DEFAULT = 65536
+    #: max bytes per rndv fragment (btl_max_send_size)
+    MAX_SEND_DEFAULT = 131072
+
+    def __init__(self) -> None:
+        self.eager_limit = cvar.register(
+            f"btl_{self.NAME}_eager_limit", self.EAGER_LIMIT_DEFAULT, int,
+            help=f"Max eager message size for btl/{self.NAME} "
+                 "(reference: btl_eager_limit)").get()
+        self.max_send = cvar.register(
+            f"btl_{self.NAME}_max_send_size", self.MAX_SEND_DEFAULT, int,
+            help="Max rndv fragment size").get()
+
+    def reachable(self, peer: int) -> bool:
+        raise NotImplementedError
+
+    def send(self, dst: int, data: bytes) -> None:
+        """Reliable ordered AM send of one framed message."""
+        raise NotImplementedError
+
+    def progress(self) -> int:
+        return 0
+
+    def finalize(self) -> None:
+        pass
+
+
+class Bml:
+    """Endpoint table: picks one BTL per peer (reference: bml/r2).
+
+    Selection: highest-priority reachable BTL. btl/self for self, sm for
+    same-host peers, tcp otherwise; OMPI_TPU_BTL can restrict the set.
+    """
+
+    def __init__(self) -> None:
+        self.btls: List[Btl] = [c for c in framework.open_components()
+                                if isinstance(c, Btl)]
+        self.endpoints: Dict[int, Btl] = {}
+        for btl in self.btls:
+            progress.register(btl.progress)
+
+    def endpoint(self, peer: int) -> Btl:
+        ep = self.endpoints.get(peer)
+        if ep is None:
+            for btl in self.btls:  # already priority-sorted
+                if btl.reachable(peer):
+                    ep = btl
+                    break
+            if ep is None:
+                raise RuntimeError(
+                    f"rank {rte.rank}: no BTL reaches peer {peer}")
+            self.endpoints[peer] = ep
+        return ep
+
+    def finalize(self) -> None:
+        for btl in self.btls:
+            progress.unregister(btl.progress)
+            btl.finalize()
+        framework.close_components()

@@ -1,0 +1,42 @@
+"""btl/self — loopback transport.
+
+Reference: opal/mca/btl/self (690 LoC): sends to one's own rank complete by
+invoking the receive callback directly. Delivery is deferred to the next
+progress sweep (queued) so that matching never recurses inside a send call
+from within the matching engine itself.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from ompi_tpu.btl import base
+from ompi_tpu.runtime import rte
+
+
+@base.framework.register
+class SelfBtl(base.Btl):
+    NAME = "self"
+    PRIORITY = 100  # exclusively owns self-sends (reference exclusivity)
+    EAGER_LIMIT_DEFAULT = 1 << 30  # loopback copies once either way
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._queue: deque = deque()
+
+    def open(self) -> bool:
+        return True
+
+    def reachable(self, peer: int) -> bool:
+        return peer == rte.rank
+
+    def send(self, dst: int, data: bytes) -> None:
+        assert dst == rte.rank
+        self._queue.append(data)
+
+    def progress(self) -> int:
+        n = 0
+        while self._queue:
+            base.deliver(self._queue.popleft())
+            n += 1
+        return n

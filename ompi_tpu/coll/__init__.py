@@ -1,0 +1,107 @@
+"""Collectives framework — per-communicator priority-stacked tables.
+
+Reference: ompi/mca/coll/ — coll.h:532-649 (the per-comm function table),
+coll_base_comm_select.c:236-330 (all enabled components stacked in
+ascending priority, each overriding the slots it implements; disqualify on
+priority<0). Components here: ``basic`` (linear reference algorithms),
+``tuned`` (decision rules over the base algorithm library), ``xla``
+(device-plane collectives on TPU-resident buffers), ``self``
+(COMM_SELF trivial).
+
+Collective p2p traffic runs in the communicator's collective context
+(cid*2+1) with a per-comm monotonically increasing operation tag, so user
+p2p can never interfere (reference uses the same split tag space).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ompi_tpu.core import output, registry
+
+framework = registry.framework("coll")
+_out = output.stream("coll_base")
+
+#: every slot a component may install (blocking + object + nonblocking
+#: variants are derived); mirrors coll.h's function-pointer members
+SLOTS = (
+    "barrier", "bcast", "reduce", "allreduce", "gather", "gatherv",
+    "scatter", "scatterv", "allgather", "allgatherv", "alltoall",
+    "alltoallv", "reduce_scatter", "reduce_scatter_block", "scan",
+    "exscan", "reduce_local",
+    # object (pickled) variants
+    "bcast_obj", "gather_obj", "scatter_obj", "allgather_obj",
+    "alltoall_obj", "allreduce_obj",
+    # ULFM agreement
+    "agree",
+    # neighborhood (installed when a topology is attached)
+    "neighbor_allgather", "neighbor_alltoall",
+)
+
+
+class CollModule(registry.Component):
+    """A coll component instance; query() returns per-comm priority."""
+
+    def query(self, comm) -> int:
+        """Return priority for this comm, or <0 to disqualify
+        (reference: coll_base_comm_select.c:456-471)."""
+        return self.PRIORITY
+
+    def slots(self, comm) -> Dict[str, callable]:
+        """The function slots this module installs for this comm."""
+        return {}
+
+
+class CollTable:
+    """The stacked per-communicator table (comm.coll)."""
+
+    def __init__(self) -> None:
+        self.fns: Dict[str, callable] = {}
+        self.providers: Dict[str, str] = {}
+        self.seq = 0  # per-comm collective operation sequence -> tag
+
+    def next_tag(self) -> int:
+        self.seq += 1
+        return self.seq & 0x3FFFFFFF
+
+    def __getattr__(self, name):
+        try:
+            return self.fns[name]
+        except KeyError:
+            raise NotImplementedError(
+                f"no coll component provides '{name}'") from None
+
+
+def comm_select(comm) -> None:
+    """Stack all qualifying components in ascending priority
+    (higher priority installs last, overriding lower)."""
+    table = CollTable()
+    comps = framework.open_components()
+    ranked = []
+    for comp in comps:
+        if not isinstance(comp, CollModule):
+            continue
+        try:
+            pri = comp.query(comm)
+        except Exception as exc:
+            _out.verbose(1, "component %s query failed: %s",
+                         comp.NAME, exc)
+            continue
+        if pri is None or pri < 0:
+            continue
+        ranked.append((pri, comp))
+    ranked.sort(key=lambda t: t[0])  # ascending: high pri wins
+    for pri, comp in ranked:
+        for slot, fn in comp.slots(comm).items():
+            table.fns[slot] = fn
+            table.providers[slot] = comp.NAME
+    comm.coll = table
+    _out.verbose(5, "comm %s coll table: %s", getattr(comm, "name", "?"),
+                 {s: table.providers.get(s) for s in table.fns})
+
+
+def _register_builtin() -> None:
+    from ompi_tpu.coll import basic, libnbc, tuned  # noqa: F401
+
+
+_register_builtin()

@@ -1,0 +1,401 @@
+"""coll/basic — linear reference algorithms.
+
+Reference: ompi/mca/coll/basic (4,882 LoC): naive linear/log
+implementations every other component is validated against. These are the
+correctness baseline: simple, deterministic operand order (rank order),
+used by tests as the brute-force oracle.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ompi_tpu import op as op_mod
+from ompi_tpu import pml
+from ompi_tpu.coll import CollModule, framework
+from ompi_tpu.core import pvar
+from ompi_tpu.datatype.convertor import dtype_of
+
+IN_PLACE = "MPI_IN_PLACE"
+
+
+def _p(comm):
+    return pml.current()
+
+
+def _tag(comm) -> int:
+    return comm.coll.next_tag()
+
+
+@framework.register
+class CollBasic(CollModule):
+    NAME = "basic"
+    PRIORITY = 10  # reference: basic priority 10
+
+    def query(self, comm) -> int:
+        return self.PRIORITY
+
+    def slots(self, comm):
+        return {
+            "barrier": barrier_linear,
+            "bcast": bcast_linear,
+            "reduce": reduce_linear,
+            "allreduce": allreduce_reduce_bcast,
+            "gather": gather_linear,
+            "gatherv": gatherv_linear,
+            "scatter": scatter_linear,
+            "scatterv": scatterv_linear,
+            "allgather": allgather_gather_bcast,
+            "allgatherv": allgatherv_linear,
+            "alltoall": alltoall_pairwise_isend,
+            "alltoallv": alltoallv_linear,
+            "reduce_scatter": reduce_scatter_basic,
+            "reduce_scatter_block": reduce_scatter_block_basic,
+            "scan": scan_linear,
+            "exscan": exscan_linear,
+            "reduce_local": reduce_local,
+            "bcast_obj": bcast_obj_linear,
+            "gather_obj": gather_obj_linear,
+            "scatter_obj": scatter_obj_linear,
+            "allgather_obj": allgather_obj,
+            "alltoall_obj": alltoall_obj,
+            "allreduce_obj": allreduce_obj,
+        }
+
+
+# -- p2p building blocks (always collective context) ----------------------
+
+def _send(comm, buf, count, dtype, dst, tag):
+    _p(comm).send(comm, buf, count, dtype, dst, tag, collective=True)
+
+
+def _recv(comm, buf, count, dtype, src, tag):
+    return _p(comm).recv(comm, buf, count, dtype, src, tag,
+                         collective=True)
+
+
+def _isend(comm, buf, count, dtype, dst, tag):
+    return _p(comm).isend(comm, buf, count, dtype, dst, tag,
+                          collective=True)
+
+
+def _irecv(comm, buf, count, dtype, src, tag):
+    return _p(comm).irecv(comm, buf, count, dtype, src, tag,
+                          collective=True)
+
+
+def _send_obj(comm, obj, dst, tag):
+    _p(comm).send_obj(comm, obj, dst, tag, collective=True)
+
+
+def _recv_obj(comm, src, tag):
+    return _p(comm).recv_obj(comm, src, tag, collective=True)
+
+
+# -- collectives ----------------------------------------------------------
+
+def barrier_linear(comm) -> None:
+    """Linear barrier: gather-to-0 then release (coll_basic_barrier.c)."""
+    pvar.record("barrier")
+    tag = _tag(comm)
+    token = np.zeros(1, dtype=np.uint8)
+    if comm.rank == 0:
+        for r in range(1, comm.size):
+            _recv(comm, token, 1, None, r, tag)
+        for r in range(1, comm.size):
+            _send(comm, token, 1, None, r, tag)
+    elif comm.size > 1:
+        _send(comm, token, 1, None, 0, tag)
+        _recv(comm, token, 1, None, 0, tag)
+
+
+def bcast_linear(comm, buf, count, dtype, root: int) -> None:
+    pvar.record("bcast")
+    tag = _tag(comm)
+    if comm.rank == root:
+        reqs = [_isend(comm, buf, count, dtype, r, tag)
+                for r in range(comm.size) if r != root]
+        for q in reqs:
+            q.wait()
+    else:
+        _recv(comm, buf, count, dtype, root, tag)
+
+
+def reduce_linear(comm, sendbuf, recvbuf, count, dtype, op, root: int):
+    """Deterministic rank-order reduction (coll_basic_reduce.c)."""
+    pvar.record("reduce")
+    tag = _tag(comm)
+    sb = np.asarray(sendbuf) if sendbuf is not IN_PLACE else \
+        np.asarray(recvbuf)
+    if comm.rank == root:
+        # blocking recvs arrive in ascending rank order, so fold
+        # incrementally — identical deterministic order, O(N) memory
+        tmp = np.empty_like(sb)
+        result = None
+        for r in range(comm.size):
+            if r == root:
+                contrib = sb
+            else:
+                _recv(comm, tmp, count, dtype, r, tag)
+                contrib = tmp
+            result = contrib.copy() if result is None \
+                else op.np_fn(result, contrib)
+        np.copyto(np.asarray(recvbuf), result, casting="same_kind")
+    else:
+        _send(comm, sb, count, dtype, root, tag)
+
+
+def allreduce_reduce_bcast(comm, sendbuf, recvbuf, count, dtype, op):
+    pvar.record("allreduce")
+    reduce_linear(comm, sendbuf, recvbuf, count, dtype, op, 0)
+    bcast_linear(comm, recvbuf, count, dtype, 0)
+
+
+def gather_linear(comm, sendbuf, recvbuf, count, dtype, root: int):
+    """recvbuf at root: shaped (size * count) elements."""
+    pvar.record("gather")
+    tag = _tag(comm)
+    sb = np.asarray(sendbuf)
+    if comm.rank == root:
+        rb = np.asarray(recvbuf).reshape(comm.size, -1)
+        rb[root][:] = sb.reshape(-1)
+        reqs = [(r, _irecv(comm, rb[r], count, dtype, r, tag))
+                for r in range(comm.size) if r != root]
+        for _, q in reqs:
+            q.wait()
+    else:
+        _send(comm, sb, count, dtype, root, tag)
+
+
+def gatherv_linear(comm, sendbuf, recvbuf, counts, displs, dtype,
+                   root: int):
+    pvar.record("gather")
+    tag = _tag(comm)
+    sb = np.asarray(sendbuf)
+    if comm.rank == root:
+        rb = np.asarray(recvbuf).reshape(-1)
+        rb[displs[root]:displs[root] + counts[root]] = sb.reshape(-1)
+        reqs = []
+        for r in range(comm.size):
+            if r == root:
+                continue
+            view = rb[displs[r]:displs[r] + counts[r]]
+            reqs.append(_irecv(comm, view, counts[r], dtype, r, tag))
+        for q in reqs:
+            q.wait()
+    else:
+        _send(comm, sb, len(sb.reshape(-1)), dtype, root, tag)
+
+
+def scatter_linear(comm, sendbuf, recvbuf, count, dtype, root: int):
+    pvar.record("scatter")
+    tag = _tag(comm)
+    rb = np.asarray(recvbuf)
+    if comm.rank == root:
+        sb = np.asarray(sendbuf).reshape(comm.size, -1)
+        reqs = [_isend(comm, sb[r], count, dtype, r, tag)
+                for r in range(comm.size) if r != root]
+        rb.reshape(-1)[:] = sb[root]
+        for q in reqs:
+            q.wait()
+    else:
+        _recv(comm, rb, count, dtype, root, tag)
+
+
+def scatterv_linear(comm, sendbuf, recvbuf, counts, displs, dtype,
+                    root: int):
+    pvar.record("scatter")
+    tag = _tag(comm)
+    rb = np.asarray(recvbuf)
+    if comm.rank == root:
+        sb = np.asarray(sendbuf).reshape(-1)
+        reqs = []
+        for r in range(comm.size):
+            view = sb[displs[r]:displs[r] + counts[r]]
+            if r == root:
+                rb.reshape(-1)[:counts[r]] = view
+            else:
+                reqs.append(_isend(comm, view.copy(), counts[r], dtype,
+                                   r, tag))
+        for q in reqs:
+            q.wait()
+    else:
+        _recv(comm, rb, len(rb.reshape(-1)), dtype, root, tag)
+
+
+def allgather_gather_bcast(comm, sendbuf, recvbuf, count, dtype):
+    pvar.record("allgather")
+    gather_linear(comm, sendbuf, recvbuf, count, dtype, 0)
+    bcast_linear(comm, recvbuf, count * comm.size, dtype, 0)
+
+
+def allgatherv_linear(comm, sendbuf, recvbuf, counts, displs, dtype):
+    pvar.record("allgather")
+    gatherv_linear(comm, sendbuf, recvbuf, counts, displs, dtype, 0)
+    total = max(displs[r] + counts[r] for r in range(comm.size))
+    bcast_linear(comm, np.asarray(recvbuf).reshape(-1)[:total], total,
+                 dtype, 0)
+
+
+def alltoall_pairwise_isend(comm, sendbuf, recvbuf, count, dtype):
+    """All nonblocking at once (coll_basic_alltoall linear)."""
+    pvar.record("alltoall")
+    tag = _tag(comm)
+    sb = np.asarray(sendbuf).reshape(comm.size, -1)
+    rb = np.asarray(recvbuf).reshape(comm.size, -1)
+    rb[comm.rank][:] = sb[comm.rank]
+    rreqs = [(r, _irecv(comm, rb[r], count, dtype, r, tag))
+             for r in range(comm.size) if r != comm.rank]
+    sreqs = [_isend(comm, sb[r], count, dtype, r, tag)
+             for r in range(comm.size) if r != comm.rank]
+    for _, q in rreqs:
+        q.wait()
+    for q in sreqs:
+        q.wait()
+
+
+def alltoallv_linear(comm, sendbuf, recvbuf, scounts, sdispls,
+                     rcounts, rdispls, dtype):
+    pvar.record("alltoall")
+    tag = _tag(comm)
+    sb = np.asarray(sendbuf).reshape(-1)
+    rb = np.asarray(recvbuf).reshape(-1)
+    me = comm.rank
+    rb[rdispls[me]:rdispls[me] + rcounts[me]] = \
+        sb[sdispls[me]:sdispls[me] + scounts[me]]
+    rreqs = []
+    for r in range(comm.size):
+        if r == me:
+            continue
+        view = rb[rdispls[r]:rdispls[r] + rcounts[r]]
+        rreqs.append(_irecv(comm, view, rcounts[r], dtype, r, tag))
+    sreqs = []
+    for r in range(comm.size):
+        if r == me:
+            continue
+        view = sb[sdispls[r]:sdispls[r] + scounts[r]].copy()
+        sreqs.append(_isend(comm, view, scounts[r], dtype, r, tag))
+    for q in rreqs:
+        q.wait()
+    for q in sreqs:
+        q.wait()
+
+
+def reduce_scatter_block_basic(comm, sendbuf, recvbuf, count, dtype, op):
+    """reduce at 0 + scatter (coll_basic_reduce_scatter_block.c)."""
+    pvar.record("reduce_scatter")
+    sb = np.asarray(sendbuf)
+    total = np.empty_like(sb) if comm.rank == 0 else sb
+    reduce_linear(comm, sb, total, count * comm.size, dtype, op, 0)
+    scatter_linear(comm, total if comm.rank == 0 else None, recvbuf,
+                   count, dtype, 0)
+
+
+def reduce_scatter_basic(comm, sendbuf, recvbuf, counts, dtype, op):
+    """MPI_Reduce_scatter with per-rank counts: reduce + scatterv."""
+    pvar.record("reduce_scatter")
+    sb = np.asarray(sendbuf)
+    total = np.empty_like(sb) if comm.rank == 0 else sb
+    reduce_linear(comm, sb, total, int(sum(counts)), dtype, op, 0)
+    displs = np.concatenate([[0], np.cumsum(counts[:-1])]).tolist()
+    scatterv_linear(comm, total if comm.rank == 0 else None, recvbuf,
+                    counts, displs, dtype, 0)
+
+
+def scan_linear(comm, sendbuf, recvbuf, count, dtype, op):
+    """MPI_Scan: inclusive prefix in rank order."""
+    pvar.record("scan")
+    tag = _tag(comm)
+    sb = np.asarray(sendbuf)
+    rb = np.asarray(recvbuf)
+    if comm.rank == 0:
+        np.copyto(rb, sb, casting="same_kind")
+    else:
+        prev = np.empty_like(rb)
+        _recv(comm, prev, count, dtype, comm.rank - 1, tag)
+        np.copyto(rb, op.np_fn(prev, sb), casting="same_kind")
+    if comm.rank + 1 < comm.size:
+        _send(comm, rb, count, dtype, comm.rank + 1, tag)
+
+
+def exscan_linear(comm, sendbuf, recvbuf, count, dtype, op):
+    pvar.record("exscan")
+    tag = _tag(comm)
+    sb = np.asarray(sendbuf)
+    rb = np.asarray(recvbuf)
+    if comm.rank > 0:
+        _recv(comm, rb, count, dtype, comm.rank - 1, tag)
+    if comm.rank + 1 < comm.size:
+        nxt = sb if comm.rank == 0 else op.np_fn(rb, sb)
+        _send(comm, np.ascontiguousarray(nxt), count, dtype,
+              comm.rank + 1, tag)
+
+
+def reduce_local(comm, inbuf, inoutbuf, count, dtype, op):
+    op_mod.reduce_local(np.asarray(inbuf), np.asarray(inoutbuf), op)
+
+
+# -- object variants ------------------------------------------------------
+
+def bcast_obj_linear(comm, obj, root: int):
+    tag = _tag(comm)
+    if comm.rank == root:
+        for r in range(comm.size):
+            if r != root:
+                _send_obj(comm, obj, r, tag)
+        return obj
+    return _recv_obj(comm, root, tag)
+
+
+def gather_obj_linear(comm, obj, root: int) -> Optional[List[Any]]:
+    tag = _tag(comm)
+    if comm.rank == root:
+        out: List[Any] = [None] * comm.size
+        out[root] = obj
+        for r in range(comm.size):
+            if r != root:
+                out[r] = _recv_obj(comm, r, tag)
+        return out
+    _send_obj(comm, obj, root, tag)
+    return None
+
+
+def scatter_obj_linear(comm, objs, root: int):
+    tag = _tag(comm)
+    if comm.rank == root:
+        for r in range(comm.size):
+            if r != root:
+                _send_obj(comm, objs[r], r, tag)
+        return objs[root]
+    return _recv_obj(comm, root, tag)
+
+
+def allgather_obj(comm, obj) -> List[Any]:
+    got = gather_obj_linear(comm, obj, 0)
+    return bcast_obj_linear(comm, got, 0)
+
+
+def alltoall_obj(comm, objs) -> List[Any]:
+    tag = _tag(comm)
+    me = comm.rank
+    out: List[Any] = [None] * comm.size
+    out[me] = objs[me]
+    sreqs = [_p(comm).isend_obj(comm, objs[r], r, tag, collective=True)
+             for r in range(comm.size) if r != me]
+    for r in range(comm.size):
+        if r != me:
+            out[r] = _recv_obj(comm, r, tag)
+    for q in sreqs:
+        q.wait()
+    return out
+
+
+def allreduce_obj(comm, obj, fn):
+    """Generic python-object allreduce with a binary fn."""
+    vals = allgather_obj(comm, obj)
+    acc = vals[0]
+    for v in vals[1:]:
+        acc = fn(acc, v)
+    return acc

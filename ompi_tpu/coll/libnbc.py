@@ -1,0 +1,274 @@
+"""coll/libnbc — nonblocking collectives as progressed schedules.
+
+Reference: ompi/mca/coll/libnbc (12,428 LoC): each i-collective compiles to
+a schedule of send/recv/op/copy rounds advanced by the progress engine
+(nbc_internal.h:156-165). Here a schedule is a Python generator that
+yields lists of outstanding p2p requests; the NBC engine resumes it when
+the current round completes — same round semantics, idiomatic coroutine
+form.
+"""
+
+from __future__ import annotations
+
+from typing import Generator, List, Optional
+
+import numpy as np
+
+from ompi_tpu.coll import CollModule, framework
+from ompi_tpu.coll import basic as B
+from ompi_tpu.coll.basic import _irecv, _isend, _tag
+from ompi_tpu.core import progress
+from ompi_tpu.pml import request as rq
+
+_active: List["NbcRequest"] = []
+_registered = False
+
+
+def _nbc_progress() -> int:
+    events = 0
+    for req in list(_active):
+        events += req._advance()
+    return events
+
+
+class NbcRequest(rq.Request):
+    """A schedule being progressed (reference: NBC_Handle)."""
+
+    def __init__(self, gen: Generator) -> None:
+        super().__init__()
+        self._gen = gen
+        self._round: Optional[List[rq.Request]] = None
+        global _registered
+        if not _registered:
+            progress.register(_nbc_progress)
+            _registered = True
+        _active.append(self)
+        self._advance()
+
+    def _advance(self) -> int:
+        if self.completed:
+            return 0
+        if self._round is not None and \
+                not all(r.completed for r in self._round):
+            return 0
+        events = 0
+        try:
+            while True:
+                self._round = self._gen.send(None)
+                events += 1
+                if self._round and \
+                        not all(r.completed for r in self._round):
+                    return events
+        except StopIteration:
+            _active.remove(self)
+            self.complete()
+            return events + 1
+
+
+# -- schedules ------------------------------------------------------------
+
+def _sched_barrier(comm, tag):
+    """Dissemination rounds (libnbc ibarrier)."""
+    rank, size = comm.rank, comm.size
+    tok = np.zeros(1, dtype=np.uint8)
+    rtok = np.zeros(1, dtype=np.uint8)
+    dist = 1
+    while dist < size:
+        to = (rank + dist) % size
+        frm = (rank - dist + size) % size
+        yield [_irecv(comm, rtok, 1, None, frm, tag),
+               _isend(comm, tok, 1, None, to, tag)]
+        dist <<= 1
+
+
+def _sched_bcast(comm, buf, count, dtype, root, tag):
+    """Binomial rounds."""
+    rank, size = comm.rank, comm.size
+    vrank = (rank - root + size) % size
+    arr = np.asarray(buf)
+    if vrank != 0:
+        mask = 1
+        while not (vrank & mask):
+            mask <<= 1
+        parent = (vrank - mask + root) % size
+        yield [_irecv(comm, arr, count, dtype, parent, tag)]
+    sends = []
+    m = 1
+    while m < size:
+        if vrank & m:
+            break
+        if vrank + m < size:
+            child = (vrank + m + root) % size
+            sends.append(_isend(comm, arr, count, dtype, child, tag))
+        m <<= 1
+    if sends:
+        yield sends
+
+
+def _sched_allreduce(comm, sendbuf, recvbuf, count, dtype, op, tag):
+    """Recursive-doubling rounds (libnbc iallreduce)."""
+    rank, size = comm.rank, comm.size
+    rb = np.asarray(recvbuf)
+    sb = np.asarray(recvbuf) if sendbuf is B.IN_PLACE \
+        else np.asarray(sendbuf)
+    if rb is not sb:
+        np.copyto(rb, sb, casting="same_kind")
+    tmp = np.empty_like(rb)
+    adjsize = 1
+    while adjsize * 2 <= size:
+        adjsize *= 2
+    extra = size - adjsize
+    if rank < 2 * extra:
+        if rank % 2 == 1:
+            yield [_isend(comm, rb, count, dtype, rank - 1, tag)]
+            yield [_irecv(comm, rb, count, dtype, rank - 1, tag)]
+            return
+        yield [_irecv(comm, tmp, count, dtype, rank + 1, tag)]
+        rb[...] = op.np_fn(rb, tmp)
+    new_rank = rank // 2 if rank < 2 * extra else rank - extra
+    mask = 1
+    while mask < adjsize:
+        peer_new = new_rank ^ mask
+        peer = peer_new * 2 if peer_new < extra else peer_new + extra
+        yield [_irecv(comm, tmp, count, dtype, peer, tag),
+               _isend(comm, rb.copy(), count, dtype, peer, tag)]
+        if peer_new < new_rank:
+            rb[...] = op.np_fn(tmp, rb)
+        else:
+            rb[...] = op.np_fn(rb, tmp)
+        mask <<= 1
+    if rank < 2 * extra and rank % 2 == 0:
+        yield [_isend(comm, rb, count, dtype, rank + 1, tag)]
+
+
+def _sched_gather(comm, sendbuf, recvbuf, count, dtype, root, tag):
+    rank, size = comm.rank, comm.size
+    sb = np.asarray(sendbuf)
+    if rank == root:
+        rb = np.asarray(recvbuf).reshape(size, -1)
+        rb[root][:] = sb.reshape(-1)
+        yield [_irecv(comm, rb[r], count, dtype, r, tag)
+               for r in range(size) if r != root]
+    else:
+        yield [_isend(comm, sb, count, dtype, root, tag)]
+
+
+def _sched_scatter(comm, sendbuf, recvbuf, count, dtype, root, tag):
+    rank, size = comm.rank, comm.size
+    rb = np.asarray(recvbuf)
+    if rank == root:
+        sb = np.asarray(sendbuf).reshape(size, -1)
+        rb.reshape(-1)[:] = sb[root]
+        yield [_isend(comm, sb[r].copy(), count, dtype, r, tag)
+               for r in range(size) if r != root]
+    else:
+        yield [_irecv(comm, rb, count, dtype, root, tag)]
+
+
+def _sched_allgather(comm, sendbuf, recvbuf, count, dtype, tag):
+    """Ring rounds."""
+    rank, size = comm.rank, comm.size
+    rb = np.asarray(recvbuf).reshape(size, -1)
+    if sendbuf is not B.IN_PLACE:
+        rb[rank][:] = np.asarray(sendbuf).reshape(-1)
+    nxt, prv = (rank + 1) % size, (rank - 1 + size) % size
+    for step in range(size - 1):
+        sidx = (rank - step + size) % size
+        ridx = (rank - step - 1 + size) % size
+        yield [_irecv(comm, rb[ridx], count, dtype, prv, tag),
+               _isend(comm, rb[sidx].copy(), count, dtype, nxt, tag)]
+
+
+def _sched_alltoall(comm, sendbuf, recvbuf, count, dtype, tag):
+    """Pairwise rounds."""
+    rank, size = comm.rank, comm.size
+    sb = np.asarray(sendbuf).reshape(size, -1)
+    rb = np.asarray(recvbuf).reshape(size, -1)
+    rb[rank][:] = sb[rank]
+    for step in range(1, size):
+        to = (rank + step) % size
+        frm = (rank - step + size) % size
+        yield [_irecv(comm, rb[frm], count, dtype, frm, tag),
+               _isend(comm, sb[to], count, dtype, to, tag)]
+
+
+def _sched_reduce(comm, sendbuf, recvbuf, count, dtype, op, root, tag):
+    rank, size = comm.rank, comm.size
+    vrank = (rank - root + size) % size
+    sb = np.asarray(recvbuf) if sendbuf is B.IN_PLACE \
+        else np.asarray(sendbuf)
+    acc = sb.copy()
+    tmp = np.empty_like(acc)
+    mask = 1
+    while mask < size:
+        if vrank & mask:
+            parent = (vrank - mask + root) % size
+            yield [_isend(comm, acc, count, dtype, parent, tag)]
+            return
+        child_v = vrank + mask
+        if child_v < size:
+            child = (child_v + root) % size
+            yield [_irecv(comm, tmp, count, dtype, child, tag)]
+            acc = op.np_fn(acc, tmp)
+        mask <<= 1
+    if recvbuf is not None:
+        np.copyto(np.asarray(recvbuf), acc, casting="same_kind")
+
+
+# -- component ------------------------------------------------------------
+
+def ibarrier(comm):
+    return NbcRequest(_sched_barrier(comm, _tag(comm)))
+
+
+def ibcast(comm, buf, count, dtype, root):
+    return NbcRequest(_sched_bcast(comm, buf, count, dtype, root,
+                                   _tag(comm)))
+
+
+def iallreduce(comm, sendbuf, recvbuf, count, dtype, op):
+    return NbcRequest(_sched_allreduce(comm, sendbuf, recvbuf, count,
+                                       dtype, op, _tag(comm)))
+
+
+def ireduce(comm, sendbuf, recvbuf, count, dtype, op, root):
+    return NbcRequest(_sched_reduce(comm, sendbuf, recvbuf, count,
+                                    dtype, op, root, _tag(comm)))
+
+
+def igather(comm, sendbuf, recvbuf, count, dtype, root):
+    return NbcRequest(_sched_gather(comm, sendbuf, recvbuf, count,
+                                    dtype, root, _tag(comm)))
+
+
+def iscatter(comm, sendbuf, recvbuf, count, dtype, root):
+    return NbcRequest(_sched_scatter(comm, sendbuf, recvbuf, count,
+                                     dtype, root, _tag(comm)))
+
+
+def iallgather(comm, sendbuf, recvbuf, count, dtype):
+    return NbcRequest(_sched_allgather(comm, sendbuf, recvbuf, count,
+                                       dtype, _tag(comm)))
+
+
+def ialltoall(comm, sendbuf, recvbuf, count, dtype):
+    return NbcRequest(_sched_alltoall(comm, sendbuf, recvbuf, count,
+                                      dtype, _tag(comm)))
+
+
+@framework.register
+class CollLibnbc(CollModule):
+    NAME = "libnbc"
+    PRIORITY = 20
+
+    def slots(self, comm):
+        return {
+            "ibarrier": ibarrier,
+            "ibcast": ibcast,
+            "iallreduce": iallreduce,
+            "ireduce": ireduce,
+            "igather": igather,
+            "iscatter": iscatter,
+            "iallgather": iallgather,
+            "ialltoall": ialltoall,
+        }

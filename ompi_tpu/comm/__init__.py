@@ -1,0 +1,304 @@
+"""Groups and communicators.
+
+Reference: ompi/group/ (set-algebra over proc lists) and ompi/communicator/
+(CID allocation over PMIx groups, comm_cid.c:297-463; dup/split/create).
+A communicator = (Group mapping comm rank -> world rank, cid, coll table,
+errhandler, FT state). Context-id space: p2p uses tag context cid*2,
+collectives cid*2+1 (the reference splits tag space the same way).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ompi_tpu import errors
+from ompi_tpu.core import output
+from ompi_tpu.runtime import rte
+
+_out = output.stream("comm")
+
+UNDEFINED = -32766
+
+
+class Group:
+    """MPI_Group: an ordered set of world ranks."""
+
+    __slots__ = ("ranks", "_index")
+
+    def __init__(self, ranks: Sequence[int]) -> None:
+        self.ranks: Tuple[int, ...] = tuple(ranks)
+        self._index = {r: i for i, r in enumerate(self.ranks)}
+
+    @property
+    def size(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def rank(self) -> int:
+        """This process's rank in the group (UNDEFINED if absent)."""
+        return self._index.get(rte.rank, UNDEFINED)
+
+    def translate(self, rank: int, other: "Group") -> int:
+        """MPI_Group_translate_ranks for one rank."""
+        world = self.ranks[rank]
+        return other._index.get(world, UNDEFINED)
+
+    # -- set algebra (MPI_Group_union/intersection/difference) -----------
+    def union(self, other: "Group") -> "Group":
+        extra = [r for r in other.ranks if r not in self._index]
+        return Group(list(self.ranks) + extra)
+
+    def intersection(self, other: "Group") -> "Group":
+        return Group([r for r in self.ranks if r in other._index])
+
+    def difference(self, other: "Group") -> "Group":
+        return Group([r for r in self.ranks if r not in other._index])
+
+    def incl(self, ranks: Sequence[int]) -> "Group":
+        return Group([self.ranks[r] for r in ranks])
+
+    def excl(self, ranks: Sequence[int]) -> "Group":
+        drop = set(ranks)
+        return Group([r for i, r in enumerate(self.ranks)
+                      if i not in drop])
+
+    def range_incl(self, ranges) -> "Group":
+        out = []
+        for first, last, stride in ranges:
+            out.extend(range(first, last + (1 if stride > 0 else -1),
+                             stride))
+        return self.incl(out)
+
+    def compare(self, other: "Group") -> str:
+        if self.ranks == other.ranks:
+            return "ident"
+        if set(self.ranks) == set(other.ranks):
+            return "similar"
+        return "unequal"
+
+    def __repr__(self) -> str:
+        return f"Group({list(self.ranks)})"
+
+
+_comms: Dict[int, "Communicator"] = {}
+_comms_lock = threading.Lock()
+
+
+def lookup_cid(cid: int) -> Optional["Communicator"]:
+    return _comms.get(cid)
+
+
+class Communicator:
+    """Base communicator: group + cid + per-comm collective table.
+
+    P2P methods (send/recv families) and collective methods are attached
+    by ompi_tpu.mpi (the API layer) and ompi_tpu.coll (table stacking) —
+    this module owns identity, construction and destruction.
+    """
+
+    def __init__(self, group: Group, cid: int,
+                 errhandler: str = errors.ERRORS_ARE_FATAL) -> None:
+        self.group = group
+        self.cid = cid
+        self.errhandler = errhandler
+        self.attrs: Dict[object, object] = {}  # MPI_Comm_set_attr
+        self.info: Dict[str, str] = {}
+        self.name = f"comm#{cid}"
+        self.revoked = False  # ULFM state
+        self.coll = None  # installed by coll.comm_select
+        self.topo = None  # cart/graph attachment
+        with _comms_lock:
+            _comms[cid] = self
+        from ompi_tpu.coll import comm_select
+
+        comm_select(self)
+        # replay any frames peers sent before we constructed this comm
+        from ompi_tpu import pml as _pml
+
+        if _pml._pml is not None:
+            _pml.current().comm_registered(cid)
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return self.group.rank
+
+    @property
+    def size(self) -> int:
+        return self.group.size
+
+    def world_rank(self, rank: int) -> int:
+        """comm rank -> world (job) rank."""
+        if rank == self.rank:
+            return rte.rank
+        return self.group.ranks[rank]
+
+    def comm_rank_of_world(self, world: int) -> int:
+        return self.group._index.get(world, UNDEFINED)
+
+    def set_name(self, name: str) -> None:
+        self.name = name
+
+    def get_name(self) -> str:
+        return self.name
+
+    # -- construction (collective over self) ------------------------------
+    def dup(self) -> "Communicator":
+        """MPI_Comm_dup."""
+        cid = self._agree_cid(f"dup:{self.cid}")
+        return Communicator(Group(self.group.ranks), cid,
+                            self.errhandler)
+
+    def split(self, color: int, key: int = 0) -> Optional["Communicator"]:
+        """MPI_Comm_split — gather (color,key) at root, compute groups,
+        scatter results (reference does allgather + local compute;
+        root-compute keeps the p2p bootstrap simple)."""
+        from ompi_tpu import mpi
+
+        me = (color, key, rte.rank)
+        all_triples = self._gather_obj(me, root=0)
+        if self.rank == 0:
+            groups: Dict[int, List[Tuple]] = {}
+            for t in all_triples:
+                if t[0] != UNDEFINED:
+                    groups.setdefault(t[0], []).append(t)
+            plans = {}
+            for col, members in groups.items():
+                members.sort(key=lambda t: (t[1], t[2]))
+                ranks = [t[2] for t in members]
+                cid = alloc_cid()
+                for t in members:
+                    plans[t[2]] = (ranks, cid)
+            results = [plans.get(t[2]) for t in all_triples]
+        else:
+            results = None
+        mine = self._scatter_obj(results, root=0)
+        if mine is None:
+            return None
+        ranks, cid = mine
+        return Communicator(Group(ranks), cid, self.errhandler)
+
+    def split_type(self, split_type: str = "shared",
+                   key: int = 0) -> Optional["Communicator"]:
+        """MPI_Comm_split_type(MPI_COMM_TYPE_SHARED): all our ranks are
+        reachable by shared memory within a host; color by hostname."""
+        import hashlib
+        import socket as _s
+
+        host = _s.gethostname()
+        # stable digest: Python's hash() is salted per process
+        color = int.from_bytes(
+            hashlib.sha1(host.encode()).digest()[:4], "little") \
+            & 0x7FFFFFFF
+        return self.split(color, key)
+
+    def create(self, group: Group) -> Optional["Communicator"]:
+        """MPI_Comm_create."""
+        color = 0 if group.rank != UNDEFINED else UNDEFINED
+        sub = self.split(color, key=group.rank)
+        if sub is None:
+            return None
+        return sub
+
+    def free(self) -> None:
+        with _comms_lock:
+            _comms.pop(self.cid, None)
+
+    # -- ULFM (reference: ompi/communicator/ft) ---------------------------
+    def revoke(self) -> None:
+        from ompi_tpu.ft import revoke as _revoke
+
+        _revoke(self)
+
+    def is_revoked(self) -> bool:
+        return self.revoked
+
+    def check_revoked(self) -> None:
+        if self.revoked:
+            raise errors.RevokedError()
+
+    # -- internal p2p helpers used before coll exists ---------------------
+    def _gather_obj(self, obj, root: int):
+        from ompi_tpu import pml
+
+        p = pml.current()
+        if self.rank == root:
+            out = [None] * self.size
+            out[self.rank] = obj
+            reqs = []
+            for r in range(self.size):
+                if r != self.rank:
+                    reqs.append((r, p.irecv_obj(self, r, tag=-7)))
+            for r, req in reqs:
+                req.wait()
+                out[r] = req._obj
+            return out
+        p.send_obj(self, obj, root, tag=-7)
+        return None
+
+    def _scatter_obj(self, objs, root: int):
+        from ompi_tpu import pml
+
+        p = pml.current()
+        if self.rank == root:
+            for r in range(self.size):
+                if r != self.rank:
+                    p.send_obj(self, objs[r], r, tag=-8)
+            return objs[self.rank]
+        req = p.irecv_obj(self, root, tag=-8)
+        req.wait()
+        return req._obj
+
+    def _agree_cid(self, tag: str) -> int:
+        """All members agree on a fresh cid: rank 0 allocates, others
+        receive (reference: comm_cid.c PMIx-group allocation)."""
+        if self.rank == 0:
+            cid = alloc_cid()
+            payload = [cid] * self.size
+            self._scatter_obj(payload, root=0)
+            return cid
+        return self._scatter_obj(None, root=0)
+
+    def __repr__(self) -> str:
+        return (f"Communicator({self.name}, rank={self.rank}/"
+                f"{self.size}, cid={self.cid})")
+
+
+def alloc_cid() -> int:
+    """Globally-unique communicator id (store-side atomic counter)."""
+    return 1 + rte.next_id("cid")
+
+
+_cfg_epochs: Dict[str, int] = {}
+
+
+def comm_create_from_group(group: Group,
+                           tag: str) -> Optional[Communicator]:
+    """MPI_Comm_create_from_group (MPI-4 sessions path): agreement via
+    the store keyed by the user-supplied tag, no parent needed. Members
+    call in the same order per (tag, group), so a local epoch counter
+    keeps repeated invocations distinct."""
+    if group.rank == UNDEFINED:
+        return None
+    client = rte.client()
+    base_key = f"cfg:{rte.jobid}:{tag}:{','.join(map(str, group.ranks))}"
+    epoch = _cfg_epochs.get(base_key, 0)
+    _cfg_epochs[base_key] = epoch + 1
+    key = f"{base_key}:{epoch}"
+    if group.rank == 0:
+        cid = alloc_cid()
+        client.put(key, cid)
+    else:
+        cid = client.get(key, wait=True)
+    return Communicator(group, cid)
+
+
+def build_world() -> Tuple[Communicator, Communicator]:
+    """COMM_WORLD (cid 0) + COMM_SELF (cid 1)."""
+    rte.init()
+    world = Communicator(Group(range(rte.size)), cid=0)
+    world.set_name("MPI_COMM_WORLD")
+    selfc = Communicator(Group([rte.rank]), cid=1)
+    selfc.set_name("MPI_COMM_SELF")
+    return world, selfc

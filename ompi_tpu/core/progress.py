@@ -19,9 +19,11 @@ _callbacks: List[Callable[[], int]] = []
 _lock = threading.Lock()
 
 _spin_var = cvar.register(
-    "progress_spin_count", 10000, int,
-    help="Idle progress iterations before yielding the CPU "
-         "(reference: opal_progress.c:51)", level=8)
+    "progress_spin_count", 200, int,
+    help="Idle progress iterations before yielding the CPU. The "
+         "reference uses 10000 C-loop iterations (opal_progress.c:51); "
+         "one Python sweep costs ~50x a C one, so the default is scaled "
+         "down to keep the pre-yield spin time comparable.", level=8)
 
 
 def register(cb: Callable[[], int]) -> None:
@@ -56,13 +58,21 @@ def wait_until(cond: Callable[[], bool], timeout: float | None = None) -> bool:
     spin_max = _spin_var.get()
     deadline = None if timeout is None else time.monotonic() + timeout
     idle = 0
+    yields = 0
     while not cond():
         if progress() > 0:
             idle = 0
+            yields = 0
         else:
             idle += 1
             if idle >= spin_max:
-                time.sleep(0)  # sched_yield
+                # escalate: yield first (latency), then real sleeps so an
+                # oversubscribed host (ranks >> cores) still makes
+                # progress (the reference only yields; Python spin is
+                # costlier, so back off harder)
+                yields += 1
+                time.sleep(0 if yields < 4 else
+                           min(100e-6 * yields, 2e-3))
                 idle = 0
         if deadline is not None and time.monotonic() > deadline:
             return cond()

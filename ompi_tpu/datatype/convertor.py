@@ -100,12 +100,28 @@ class Convertor:
         src = self._flat(writable=False)
         if self._spans is None:
             out = src[start:end].tobytes()
+        elif start == 0 and end == self.packed_size:
+            out = src[self._gather_index()].tobytes()
         else:
             out = self._gather(src, start, end)
         self.position = end
         if self.checksum is not None:
             self.checksum = zlib.crc32(out, self.checksum)
         return out
+
+    def _gather_index(self) -> np.ndarray:
+        """Flat byte-index vector for the whole layout — one vectorized
+        fancy-index replaces the per-span interpreter loop (the compiled
+        form a native/pallas gather kernel consumes as-is)."""
+        idx = getattr(self, "_idx", None)
+        if idx is None:
+            spans, cum = self._spans, self._cum
+            lens = spans[:, 1]
+            idx = (np.repeat(spans[:, 0], lens)
+                   + np.arange(int(cum[-1]), dtype=np.int64)
+                   - np.repeat(cum[:-1], lens))
+            self._idx = idx
+        return idx
 
     def _gather(self, src: np.ndarray, start: int, end: int) -> bytes:
         spans, cum = self._spans, self._cum
@@ -131,6 +147,8 @@ class Convertor:
         src = np.frombuffer(data, dtype=np.uint8, count=n)
         if self._spans is None:
             dst[start:end] = src
+        elif start == 0 and end == self.packed_size:
+            dst[self._gather_index()] = src
         else:
             self._scatter(dst, src, start, end)
         self.position = end

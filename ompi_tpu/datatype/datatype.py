@@ -233,26 +233,13 @@ def indexed(blocklengths: Sequence[int], displs: Sequence[int],
 
 def hindexed(blocklengths: Sequence[int], displs_bytes: Sequence[int],
              old: Datatype) -> Datatype:
-    """MPI_Type_create_hindexed — displacements in bytes."""
-    parts = []
-    lb = None
-    ub = None
-    for bl, disp in zip(blocklengths, displs_bytes):
-        if bl <= 0:
-            continue
-        block = _tile(old.spans, bl, old.extent)
-        block = block.copy()
-        block[:, 0] += disp
-        parts.append(block)
-        this_lb = disp + old.lb
-        this_ub = disp + (bl - 1) * old.extent + old.ub
-        lb = this_lb if lb is None else min(lb, this_lb)
-        ub = this_ub if ub is None else max(ub, this_ub)
-    if not parts:
-        return Datatype([], 0, name="indexed")
-    spans = np.concatenate(parts)
-    spans = spans[np.argsort(spans[:, 0], kind="stable")]
-    return Datatype(spans, ub - lb, lb=lb, name="indexed")
+    """MPI_Type_create_hindexed — displacements in bytes. Pack order
+    follows the type map (declaration) order per MPI-3.1 §4.1, exactly
+    like create_struct with a single repeated type."""
+    d = create_struct(blocklengths, displs_bytes,
+                      [old] * len(blocklengths))
+    d.name = "indexed"
+    return d
 
 
 def indexed_block(blocklength: int, displs: Sequence[int],

@@ -1,0 +1,92 @@
+"""MPI error classes and errhandler semantics.
+
+Reference: ompi/errhandler/ + mpi error classes (MPI-3.1 §8.4). Errors are
+Python exceptions; communicators carry an errhandler that decides raise vs
+abort (ERRORS_ARE_FATAL aborts the job like the reference default;
+ERRORS_RETURN raises to the caller — the Pythonic 'return').
+"""
+
+from __future__ import annotations
+
+SUCCESS = 0
+ERR_BUFFER = 1
+ERR_COUNT = 2
+ERR_TYPE = 3
+ERR_TAG = 4
+ERR_COMM = 5
+ERR_RANK = 6
+ERR_REQUEST = 7
+ERR_ROOT = 8
+ERR_GROUP = 9
+ERR_OP = 10
+ERR_TOPOLOGY = 11
+ERR_DIMS = 12
+ERR_ARG = 13
+ERR_UNKNOWN = 14
+ERR_TRUNCATE = 15
+ERR_OTHER = 16
+ERR_INTERN = 17
+ERR_PENDING = 18
+ERR_IN_STATUS = 19
+ERR_WIN = 45
+ERR_FILE = 27
+ERR_NO_MEM = 34
+ERR_NOT_SUPPORTED = 51
+# ULFM (reference: ompi/mpiext/ftmpi)
+ERR_PROC_FAILED = 75
+ERR_PROC_FAILED_PENDING = 76
+ERR_REVOKED = 77
+
+
+class MPIError(Exception):
+    """Base MPI exception carrying an error class."""
+
+    def __init__(self, error_class: int = ERR_OTHER, msg: str = "") -> None:
+        self.error_class = error_class
+        super().__init__(msg or f"MPI error class {error_class}")
+
+
+class TruncateError(MPIError):
+    def __init__(self, msg: str = "message truncated") -> None:
+        super().__init__(ERR_TRUNCATE, msg)
+
+
+class RankError(MPIError):
+    def __init__(self, msg: str = "invalid rank") -> None:
+        super().__init__(ERR_RANK, msg)
+
+
+class ProcFailedError(MPIError):
+    """ULFM MPI_ERR_PROC_FAILED."""
+
+    def __init__(self, ranks=(), msg: str = "") -> None:
+        self.failed_ranks = tuple(ranks)
+        super().__init__(ERR_PROC_FAILED,
+                         msg or f"process failure: ranks {ranks}")
+
+
+class RevokedError(MPIError):
+    """ULFM MPI_ERR_REVOKED."""
+
+    def __init__(self, msg: str = "communicator revoked") -> None:
+        super().__init__(ERR_REVOKED, msg)
+
+
+_CLASS_MAP = {
+    ERR_TRUNCATE: TruncateError,
+    ERR_RANK: RankError,
+    ERR_REVOKED: RevokedError,
+}
+
+
+def raise_mpi_error(error_class: int, msg: str = "") -> None:
+    cls = _CLASS_MAP.get(error_class)
+    if cls is not None:
+        raise cls() if not msg else cls(msg)
+    raise MPIError(error_class, msg)
+
+
+# errhandlers (reference: MPI_ERRORS_ARE_FATAL default on comms)
+ERRORS_ARE_FATAL = "errors_are_fatal"
+ERRORS_RETURN = "errors_return"
+ERRORS_ABORT = "errors_abort"

@@ -1,0 +1,59 @@
+"""Fault tolerance — ULFM-style revoke/shrink/agree + failure detector.
+
+Reference: ompi/communicator/ft/ (heartbeat ring detector
+comm_ft_detector.c:30-74, reliable failure propagation
+comm_ft_propagator.c, revoke) and ompi/mpiext/ftmpi (MPIX API),
+coll/ftagree (early-returning agreement).
+
+This module starts as revoke propagation + shrink + agreement over the
+store; the heartbeat detector lands with the detector submodule.
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from ompi_tpu.runtime import rte
+
+
+def _revoke_key(comm) -> str:
+    return f"ft:revoked:{rte.jobid}:{comm.cid}"
+
+
+def revoke(comm) -> None:
+    """MPIX_Comm_revoke: mark + propagate through the store (the
+    reference floods a reliable bcast; the store is our reliable
+    propagation channel)."""
+    comm.revoked = True
+    rte.client().put(_revoke_key(comm), True)
+
+
+def check_remote_revoked(comm) -> bool:
+    if comm.revoked:
+        return True
+    if rte.client().get(_revoke_key(comm), wait=False):
+        comm.revoked = True
+    return comm.revoked
+
+
+def shrink(comm):
+    """MPIX_Comm_shrink: agree on the alive group, build a new comm."""
+    from ompi_tpu import comm as comm_mod
+
+    alive: List[int] = sorted(agree_alive(comm))
+    group = comm_mod.Group(alive)
+    return comm_mod.comm_create_from_group(
+        group, tag=f"shrink:{comm.cid}")
+
+
+def agree_alive(comm) -> Set[int]:
+    """Best-effort alive-set agreement via store heartbeat keys."""
+    client = rte.client()
+    key = f"ft:alive:{rte.jobid}:{comm.cid}:{rte.rank}"
+    client.put(key, True)
+    alive = set()
+    for r in comm.group.ranks:
+        if client.get(f"ft:alive:{rte.jobid}:{comm.cid}:{r}",
+                      wait=False):
+            alive.add(r)
+    return alive

@@ -1,0 +1,577 @@
+"""The public MPI-style API.
+
+Reference: ompi/mpi/c/ (444 per-function bindings doing profiling hook,
+SPC counter, param check, then framework dispatch — e.g. allreduce.c:37-127).
+Pythonic surface follows the mpi4py convention: lowercase methods move
+pickled Python objects, capitalized methods move numpy buffers in place.
+
+Buffer specs for capitalized methods: ``array`` | ``(array, count)`` |
+``(array, count, Datatype)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ompi_tpu import errors, op as op_mod, pml
+from ompi_tpu.comm import Communicator, Group, UNDEFINED
+from ompi_tpu.core import pvar
+from ompi_tpu.datatype import Datatype
+from ompi_tpu.datatype.convertor import dtype_of
+from ompi_tpu.pml import request as rq
+from ompi_tpu.pml.request import (  # noqa: F401  (re-exports)
+    ANY_SOURCE, ANY_TAG, PROC_NULL, Request, Status, wait_all, wait_any,
+    wait_some, test_all, test_any,
+)
+
+IN_PLACE = "MPI_IN_PLACE"
+
+# re-export ops & common datatypes at the API level
+SUM, PROD, MIN, MAX = op_mod.SUM, op_mod.PROD, op_mod.MIN, op_mod.MAX
+LAND, LOR, BAND, BOR = op_mod.LAND, op_mod.LOR, op_mod.BAND, op_mod.BOR
+MINLOC, MAXLOC = op_mod.MINLOC, op_mod.MAXLOC
+
+
+def _parse_buf(buf) -> Tuple[Any, int, Optional[Datatype]]:
+    """(array|bytearray, count, dtype) from a buffer spec."""
+    if isinstance(buf, tuple):
+        if len(buf) == 2:
+            arr, count = buf
+            return arr, count, dtype_of(arr)
+        arr, count, dt = buf
+        return arr, count, dt
+    arr = buf
+    if isinstance(arr, np.ndarray):
+        return arr, arr.size, dtype_of(arr)
+    mv = memoryview(arr)
+    return arr, mv.nbytes, None
+
+
+class _PersistentRequest(rq.Request):
+    """MPI_Send_init / MPI_Recv_init handles (reference: persistent
+    requests restarted by MPI_Start)."""
+
+    def __init__(self, comm, kind: str, args: tuple) -> None:
+        super().__init__()
+        self.persistent = True
+        self.comm = comm
+        self.kind = kind
+        self.args = args
+        self._live: Optional[rq.Request] = None
+        self.completed = True  # inactive until started
+
+    def start(self) -> None:
+        p = pml.current()
+        if self.kind == "send":
+            buf, count, dt, dest, tag = self.args
+            self._live = p.isend(self.comm, buf, count, dt, dest, tag)
+        else:
+            buf, count, dt, src, tag = self.args
+            self._live = p.irecv(self.comm, buf, count, dt, src, tag)
+        self.completed = False
+
+    def test(self) -> bool:
+        if self._live is not None and self._live.test():
+            self.status = self._live.status
+            self.completed = True
+        return self.completed
+
+    def wait(self, timeout=None):
+        if self._live is None:
+            return self.status
+        st = self._live.wait(timeout=timeout)
+        self.status = st
+        self.completed = True
+        return st
+
+
+def start_all(reqs: Sequence[_PersistentRequest]) -> None:
+    for r in reqs:
+        r.start()
+
+
+# ---------------------------------------------------------------------------
+# Communicator API methods. Defined here and attached to Communicator to
+# keep identity (comm/) separate from surface (this module), mirroring the
+# reference's ompi/communicator vs ompi/mpi/c split.
+# ---------------------------------------------------------------------------
+
+def _check_rank(comm, rank: int, allow_null: bool = True) -> None:
+    if rank == PROC_NULL and allow_null:
+        return
+    if rank == ANY_SOURCE:
+        return
+    if not 0 <= rank < comm.size:
+        raise errors.RankError(f"rank {rank} out of range for {comm}")
+
+
+# -- object (pickled) p2p --
+
+def _send(self, obj, dest: int, tag: int = 0) -> None:
+    self.check_revoked()
+    _check_rank(self, dest)
+    pvar.record("send")
+    pml.current().send_obj(self, obj, dest, tag)
+
+
+def _isend(self, obj, dest: int, tag: int = 0) -> rq.Request:
+    self.check_revoked()
+    _check_rank(self, dest)
+    return pml.current().isend_obj(self, obj, dest, tag)
+
+
+def _recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+          status: Optional[Status] = None):
+    self.check_revoked()
+    obj_req = pml.current().irecv_obj(self, source, tag)
+    st = obj_req.wait()
+    if status is not None:
+        status.source, status.tag = st.source, st.tag
+        status.count, status.error = st.count, st.error
+    return obj_req._obj
+
+
+def _irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+    self.check_revoked()
+    return pml.current().irecv_obj(self, source, tag)
+
+
+def _sendrecv(self, obj, dest: int, source: int = ANY_SOURCE,
+              sendtag: int = 0, recvtag: int = ANY_TAG):
+    rreq = pml.current().irecv_obj(self, source, recvtag)
+    sreq = pml.current().isend_obj(self, obj, dest, sendtag)
+    rreq.wait()
+    sreq.wait()
+    return rreq._obj
+
+
+# -- buffer p2p --
+
+def _Send(self, buf, dest: int, tag: int = 0) -> None:
+    self.check_revoked()
+    _check_rank(self, dest)
+    arr, count, dt = _parse_buf(buf)
+    pvar.record("send")
+    pml.current().send(self, arr, count, dt, dest, tag)
+
+
+def _Isend(self, buf, dest: int, tag: int = 0) -> rq.Request:
+    self.check_revoked()
+    arr, count, dt = _parse_buf(buf)
+    return pml.current().isend(self, arr, count, dt, dest, tag)
+
+
+def _Ssend(self, buf, dest: int, tag: int = 0) -> None:
+    self.check_revoked()
+    arr, count, dt = _parse_buf(buf)
+    pml.current().send(self, arr, count, dt, dest, tag, sync=True)
+
+
+def _Issend(self, buf, dest: int, tag: int = 0) -> rq.Request:
+    arr, count, dt = _parse_buf(buf)
+    return pml.current().isend(self, arr, count, dt, dest, tag, sync=True)
+
+
+def _Rsend(self, buf, dest: int, tag: int = 0) -> None:
+    # ready-send: receiver is guaranteed posted; eager path is identical
+    _Send(self, buf, dest, tag)
+
+
+def _Bsend(self, buf, dest: int, tag: int = 0) -> None:
+    """Buffered send: copy now, deliver in background."""
+    arr, count, dt = _parse_buf(buf)
+    if isinstance(arr, np.ndarray):
+        copy = np.array(arr, copy=True)
+    else:  # raw buffer: keep byte semantics (dtype_of(bytes) would
+        # infer an S-dtype and inflate the size)
+        copy = np.frombuffer(bytes(arr), dtype=np.uint8).copy()
+    req = pml.current().isend(self, copy, count, dt, dest, tag)
+    _pending_bsends.append(req)
+
+
+def _Recv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+          status: Optional[Status] = None) -> Status:
+    self.check_revoked()
+    arr, count, dt = _parse_buf(buf)
+    st = pml.current().recv(self, arr, count, dt, source, tag)
+    if status is not None:
+        status.source, status.tag = st.source, st.tag
+        status.count, status.error = st.count, st.error
+    return st
+
+
+def _Irecv(self, buf, source: int = ANY_SOURCE,
+           tag: int = ANY_TAG) -> rq.Request:
+    self.check_revoked()
+    arr, count, dt = _parse_buf(buf)
+    return pml.current().irecv(self, arr, count, dt, source, tag)
+
+
+def _Sendrecv(self, sendbuf, dest: int, recvbuf, source: int = ANY_SOURCE,
+              sendtag: int = 0, recvtag: int = ANY_TAG) -> Status:
+    rreq = _Irecv(self, recvbuf, source, recvtag)
+    sreq = _Isend(self, sendbuf, dest, sendtag)
+    st = rreq.wait()
+    sreq.wait()
+    return st
+
+
+def _Sendrecv_replace(self, buf, dest: int, source: int = ANY_SOURCE,
+                      sendtag: int = 0, recvtag: int = ANY_TAG) -> Status:
+    arr, count, dt = _parse_buf(buf)
+    tmp = np.array(arr, copy=True)
+    rreq = pml.current().irecv(self, arr, count, dt, source, recvtag)
+    sreq = pml.current().isend(self, tmp, count, dt, dest, sendtag)
+    st = rreq.wait()
+    sreq.wait()
+    return st
+
+
+# -- probe family --
+
+def _Probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Status:
+    return pml.current().probe(self, source, tag)
+
+
+def _Iprobe(self, source: int = ANY_SOURCE,
+            tag: int = ANY_TAG) -> Optional[Status]:
+    return pml.current().iprobe(self, source, tag)
+
+
+def _Mprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+    return pml.current().mprobe(self, source, tag)
+
+
+def _Improbe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+    return pml.current().improbe(self, source, tag)
+
+
+def _Mrecv(self, msg, buf) -> Status:
+    arr, count, dt = _parse_buf(buf)
+    return pml.current().mrecv(msg, arr, count, dt)
+
+
+# -- persistent --
+
+def _Send_init(self, buf, dest: int, tag: int = 0) -> _PersistentRequest:
+    arr, count, dt = _parse_buf(buf)
+    return _PersistentRequest(self, "send", (arr, count, dt, dest, tag))
+
+
+def _Recv_init(self, buf, source: int = ANY_SOURCE,
+               tag: int = ANY_TAG) -> _PersistentRequest:
+    arr, count, dt = _parse_buf(buf)
+    return _PersistentRequest(self, "recv", (arr, count, dt, source, tag))
+
+
+# -- collectives (capitalized: buffers; lowercase: objects) --
+
+def _Barrier(self) -> None:
+    self.check_revoked()
+    self.coll.barrier(self)
+
+
+def _Bcast(self, buf, root: int = 0) -> None:
+    self.check_revoked()
+    arr, count, dt = _parse_buf(buf)
+    self.coll.bcast(self, arr, count, dt, root)
+
+
+def _Reduce(self, sendbuf, recvbuf, op=op_mod.SUM, root: int = 0) -> None:
+    sarr, count, dt = _parse_buf(sendbuf) if sendbuf is not IN_PLACE \
+        else (IN_PLACE, None, None)
+    rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
+    if sarr is IN_PLACE:
+        count, dt = _parse_buf(recvbuf)[1:]
+    self.coll.reduce(self, sarr, rarr, count, dt, op, root)
+
+
+def _Allreduce(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
+    self.check_revoked()
+    if sendbuf is IN_PLACE:
+        rarr, count, dt = _parse_buf(recvbuf)
+        self.coll.allreduce(self, IN_PLACE, rarr, count, dt, op)
+    else:
+        sarr, count, dt = _parse_buf(sendbuf)
+        rarr = _parse_buf(recvbuf)[0]
+        self.coll.allreduce(self, sarr, rarr, count, dt, op)
+
+
+def _Gather(self, sendbuf, recvbuf, root: int = 0) -> None:
+    sarr, count, dt = _parse_buf(sendbuf)
+    rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
+    self.coll.gather(self, sarr, rarr, count, dt, root)
+
+
+def _Gatherv(self, sendbuf, recvbuf, counts, displs=None,
+             root: int = 0) -> None:
+    sarr = _parse_buf(sendbuf)[0]
+    rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
+    if displs is None:
+        displs = np.concatenate([[0], np.cumsum(counts[:-1])]).tolist()
+    self.coll.gatherv(self, sarr, rarr, counts, displs,
+                      dtype_of(sarr), root)
+
+
+def _Scatter(self, sendbuf, recvbuf, root: int = 0) -> None:
+    rarr, count, dt = _parse_buf(recvbuf)
+    sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
+    self.coll.scatter(self, sarr, rarr, count, dt, root)
+
+
+def _Scatterv(self, sendbuf, recvbuf, counts, displs=None,
+              root: int = 0) -> None:
+    rarr = _parse_buf(recvbuf)[0]
+    sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
+    if displs is None:
+        displs = np.concatenate([[0], np.cumsum(counts[:-1])]).tolist()
+    self.coll.scatterv(self, sarr, rarr, counts, displs,
+                       dtype_of(rarr), root)
+
+
+def _Allgather(self, sendbuf, recvbuf) -> None:
+    self.check_revoked()
+    sarr, count, dt = _parse_buf(sendbuf)
+    rarr = _parse_buf(recvbuf)[0]
+    self.coll.allgather(self, sarr, rarr, count, dt)
+
+
+def _Allgatherv(self, sendbuf, recvbuf, counts, displs=None) -> None:
+    sarr = _parse_buf(sendbuf)[0]
+    rarr = _parse_buf(recvbuf)[0]
+    if displs is None:
+        displs = np.concatenate([[0], np.cumsum(counts[:-1])]).tolist()
+    self.coll.allgatherv(self, sarr, rarr, counts, displs,
+                         dtype_of(sarr))
+
+
+def _Alltoall(self, sendbuf, recvbuf) -> None:
+    self.check_revoked()
+    sarr = _parse_buf(sendbuf)[0]
+    rarr = _parse_buf(recvbuf)[0]
+    count = np.asarray(sarr).size // self.size
+    self.coll.alltoall(self, sarr, rarr, count, dtype_of(sarr))
+
+
+def _Alltoallv(self, sendbuf, recvbuf, scounts, rcounts,
+               sdispls=None, rdispls=None) -> None:
+    sarr = _parse_buf(sendbuf)[0]
+    rarr = _parse_buf(recvbuf)[0]
+    if sdispls is None:
+        sdispls = np.concatenate([[0], np.cumsum(scounts[:-1])]).tolist()
+    if rdispls is None:
+        rdispls = np.concatenate([[0], np.cumsum(rcounts[:-1])]).tolist()
+    self.coll.alltoallv(self, sarr, rarr, scounts, sdispls, rcounts,
+                        rdispls, dtype_of(sarr))
+
+
+def _Reduce_scatter_block(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
+    rarr, count, dt = _parse_buf(recvbuf)
+    sarr = _parse_buf(sendbuf)[0]
+    self.coll.reduce_scatter_block(self, sarr, rarr, count, dt, op)
+
+
+def _Reduce_scatter(self, sendbuf, recvbuf, counts, op=op_mod.SUM) -> None:
+    rarr = _parse_buf(recvbuf)[0]
+    sarr = _parse_buf(sendbuf)[0]
+    self.coll.reduce_scatter(self, sarr, rarr, counts,
+                             dtype_of(rarr), op)
+
+
+def _Scan(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
+    sarr, count, dt = _parse_buf(sendbuf)
+    rarr = _parse_buf(recvbuf)[0]
+    self.coll.scan(self, sarr, rarr, count, dt, op)
+
+
+def _Exscan(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
+    sarr, count, dt = _parse_buf(sendbuf)
+    rarr = _parse_buf(recvbuf)[0]
+    self.coll.exscan(self, sarr, rarr, count, dt, op)
+
+
+# -- nonblocking collectives (MPI-3 i-variants via coll/libnbc) --
+
+def _Ibarrier(self) -> rq.Request:
+    return self.coll.ibarrier(self)
+
+
+def _Ibcast(self, buf, root: int = 0) -> rq.Request:
+    arr, count, dt = _parse_buf(buf)
+    return self.coll.ibcast(self, arr, count, dt, root)
+
+
+def _Iallreduce(self, sendbuf, recvbuf, op=op_mod.SUM) -> rq.Request:
+    if sendbuf is IN_PLACE:
+        rarr, count, dt = _parse_buf(recvbuf)
+        return self.coll.iallreduce(self, IN_PLACE, rarr, count, dt, op)
+    sarr, count, dt = _parse_buf(sendbuf)
+    return self.coll.iallreduce(self, sarr, _parse_buf(recvbuf)[0],
+                                count, dt, op)
+
+
+def _Ireduce(self, sendbuf, recvbuf, op=op_mod.SUM,
+             root: int = 0) -> rq.Request:
+    sarr, count, dt = _parse_buf(sendbuf)
+    rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
+    return self.coll.ireduce(self, sarr, rarr, count, dt, op, root)
+
+
+def _Igather(self, sendbuf, recvbuf, root: int = 0) -> rq.Request:
+    sarr, count, dt = _parse_buf(sendbuf)
+    rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
+    return self.coll.igather(self, sarr, rarr, count, dt, root)
+
+
+def _Iscatter(self, sendbuf, recvbuf, root: int = 0) -> rq.Request:
+    rarr, count, dt = _parse_buf(recvbuf)
+    sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
+    return self.coll.iscatter(self, sarr, rarr, count, dt, root)
+
+
+def _Iallgather(self, sendbuf, recvbuf) -> rq.Request:
+    sarr, count, dt = _parse_buf(sendbuf)
+    return self.coll.iallgather(self, sarr, _parse_buf(recvbuf)[0],
+                                count, dt)
+
+
+def _Ialltoall(self, sendbuf, recvbuf) -> rq.Request:
+    sarr = _parse_buf(sendbuf)[0]
+    rarr = _parse_buf(recvbuf)[0]
+    count = np.asarray(sarr).size // self.size
+    return self.coll.ialltoall(self, sarr, rarr, count, dtype_of(sarr))
+
+
+def _barrier(self) -> None:
+    _Barrier(self)
+
+
+def _bcast(self, obj=None, root: int = 0):
+    self.check_revoked()
+    return self.coll.bcast_obj(self, obj, root)
+
+
+def _gather(self, obj, root: int = 0):
+    return self.coll.gather_obj(self, obj, root)
+
+
+def _scatter(self, objs=None, root: int = 0):
+    return self.coll.scatter_obj(self, objs, root)
+
+
+def _allgather(self, obj):
+    return self.coll.allgather_obj(self, obj)
+
+
+def _alltoall(self, objs):
+    return self.coll.alltoall_obj(self, objs)
+
+
+def _allreduce(self, obj, op=None):
+    fn = op if callable(op) and not isinstance(op, op_mod.Op) else \
+        (op.np_fn if isinstance(op, op_mod.Op) else (lambda a, b: a + b))
+    return self.coll.allreduce_obj(self, obj, fn)
+
+
+def _reduce(self, obj, op=None, root: int = 0):
+    vals = self.coll.gather_obj(self, obj, root)
+    if vals is None:
+        return None
+    fn = op if callable(op) and not isinstance(op, op_mod.Op) else \
+        (op.np_fn if isinstance(op, op_mod.Op) else (lambda a, b: a + b))
+    acc = vals[0]
+    for v in vals[1:]:
+        acc = fn(acc, v)
+    return acc
+
+
+_pending_bsends: List[rq.Request] = []
+
+
+def _flush_bsends() -> None:
+    for r in list(_pending_bsends):
+        r.wait()
+    _pending_bsends.clear()
+
+
+_API = {
+    "send": _send, "isend": _isend, "recv": _recv, "irecv": _irecv,
+    "sendrecv": _sendrecv,
+    "Send": _Send, "Isend": _Isend, "Ssend": _Ssend, "Issend": _Issend,
+    "Rsend": _Rsend, "Bsend": _Bsend, "Recv": _Recv, "Irecv": _Irecv,
+    "Sendrecv": _Sendrecv, "Sendrecv_replace": _Sendrecv_replace,
+    "Probe": _Probe, "Iprobe": _Iprobe, "Mprobe": _Mprobe,
+    "Improbe": _Improbe, "Mrecv": _Mrecv,
+    "Send_init": _Send_init, "Recv_init": _Recv_init,
+    "Barrier": _Barrier, "barrier": _barrier,
+    "Bcast": _Bcast, "bcast": _bcast,
+    "Reduce": _Reduce, "reduce": _reduce,
+    "Allreduce": _Allreduce, "allreduce": _allreduce,
+    "Gather": _Gather, "gather": _gather,
+    "Gatherv": _Gatherv,
+    "Scatter": _Scatter, "scatter": _scatter,
+    "Scatterv": _Scatterv,
+    "Allgather": _Allgather, "allgather": _allgather,
+    "Allgatherv": _Allgatherv,
+    "Alltoall": _Alltoall, "alltoall": _alltoall,
+    "Alltoallv": _Alltoallv,
+    "Reduce_scatter": _Reduce_scatter,
+    "Reduce_scatter_block": _Reduce_scatter_block,
+    "Scan": _Scan, "Exscan": _Exscan,
+    "Ibarrier": _Ibarrier, "Ibcast": _Ibcast,
+    "Iallreduce": _Iallreduce, "Ireduce": _Ireduce,
+    "Igather": _Igather, "Iscatter": _Iscatter,
+    "Iallgather": _Iallgather, "Ialltoall": _Ialltoall,
+}
+
+for _name, _fn in _API.items():
+    setattr(Communicator, _name, _fn)
+
+
+# ---------------------------------------------------------------------------
+# module-level state: COMM_WORLD / COMM_SELF / init / finalize
+# ---------------------------------------------------------------------------
+
+def Init():
+    from ompi_tpu.runtime import state
+
+    return state.init()
+
+
+def Finalize() -> None:
+    from ompi_tpu.runtime import state
+
+    _flush_bsends()
+    state.finalize()
+
+
+def Is_initialized() -> bool:
+    from ompi_tpu.runtime import state
+
+    return state.is_initialized()
+
+
+def Get_processor_name() -> str:
+    import socket
+
+    return socket.gethostname()
+
+
+def Wtime() -> float:
+    import time
+
+    return time.perf_counter()
+
+
+def __getattr__(name: str):
+    if name == "COMM_WORLD":
+        from ompi_tpu.runtime import state
+
+        return state.world()
+    if name == "COMM_SELF":
+        from ompi_tpu.runtime import state
+
+        return state.comm_self()
+    raise AttributeError(name)

@@ -85,8 +85,11 @@ def reduce_local(inbuf: np.ndarray, inoutbuf: np.ndarray, op: Op,
     Argument order matters for non-commutative user ops: inbuf is the
     'left' operand, matching MPI's accumulate-order semantics.
     """
-    result = op.np_fn(inbuf, inoutbuf)
-    np.copyto(inoutbuf, result, casting="same_kind")
+    if isinstance(op.np_fn, np.ufunc):
+        op.np_fn(inbuf, inoutbuf, out=inoutbuf, casting="same_kind")
+    else:
+        result = op.np_fn(inbuf, inoutbuf)
+        np.copyto(inoutbuf, result, casting="same_kind")
 
 
 def apply_bytes(a: bytes, b: bytearray, np_dtype, op: Op) -> None:

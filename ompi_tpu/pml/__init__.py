@@ -1,0 +1,44 @@
+"""PML — point-to-point messaging layer.
+
+Reference: ompi/mca/pml/ (pml.h:157-515 interface; ob1 is the default
+matching engine over BML/BTLs). Exactly one PML is selected per job
+(ompi/instance/instance.c:535). Here the framework selects the ``ob1``
+equivalent; the interposition pattern (pml/monitoring) is available via
+``monitoring.install()``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_pml = None
+
+
+def select():
+    """Select and initialize the PML (mca_pml_base_select equivalent)."""
+    global _pml
+    if _pml is None:
+        from ompi_tpu.pml.ob1 import Ob1
+
+        _pml = Ob1()
+        _pml.enable()
+    return _pml
+
+
+def current():
+    if _pml is None:
+        return select()
+    return _pml
+
+
+def set_current(pml) -> None:
+    """Install an interposition PML (reference: pml/monitoring, pml/v)."""
+    global _pml
+    _pml = pml
+
+
+def finalize() -> None:
+    global _pml
+    if _pml is not None:
+        _pml.disable()
+        _pml = None

@@ -1,0 +1,507 @@
+"""ob1 — the default matching PML over BML/BTLs.
+
+Reference: ompi/mca/pml/ob1/ — protocols MATCH (eager), RNDV (ack-driven
+pipelined frags), headers pml_ob1_hdr.h:43-52, protocol choice by size
+(pml_ob1_sendreq.h:388-440), per-(comm,peer) sequence ordering + expected/
+unexpected queues (pml_ob1_recvfrag.c:863-960). RGET/RDMA protocols have
+no host-RDMA substrate here; the accelerator-aware path lives at the coll
+level (coll/xla) per the TPU integration architecture (SURVEY.md §5).
+
+Wire format (little-endian structs + raw convertor payload):
+  MATCH/RNDV: <B type><I ctx><i src><i tag><Q seq><Q size><B flags><Q msgid>
+              [payload (eager only)]
+  ACK:        <B type><Q msgid><Q recv_id>
+  FRAG:       <B type><Q recv_id><Q offset>[payload]
+ctx = cid*2 + (0 p2p | 1 collective); src is the sender's ctx-comm rank.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import struct
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu import errors
+from ompi_tpu.btl import base as btl_base
+from ompi_tpu.core import output, pvar
+from ompi_tpu.datatype import BYTE, Convertor
+from ompi_tpu.datatype.convertor import dtype_of
+from ompi_tpu.pml import request as rq
+from ompi_tpu.runtime import rte
+
+HDR_MATCH = 1
+HDR_RNDV = 2
+HDR_ACK = 3
+HDR_FRAG = 4
+
+FLAG_SYNC = 1  # ssend: sender wants a match ack
+FLAG_OBJ = 2   # payload is a pickled python object
+
+_MATCH = struct.Struct("<BIiiQQBQ")
+_ACK = struct.Struct("<BQQ")
+_FRAG = struct.Struct("<BQQ")
+
+_out = output.stream("pml_ob1")
+_msg_ids = itertools.count(1)
+
+#: "no object" sentinel — None is a perfectly valid object to send
+NO_OBJ = object()
+
+
+class SendRequest(rq.Request):
+    __slots__ = ("conv", "dst_world", "ctx", "msgid")
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.conv: Optional[Convertor] = None
+        self.dst_world = -1
+        self.ctx = 0
+        self.msgid = 0
+
+
+class RecvRequest(rq.Request):
+    __slots__ = ("ctx", "want_src", "want_tag", "buf", "count", "dtype",
+                 "conv", "total", "is_obj", "recv_id", "matched")
+
+    def __init__(self, ctx: int, src: int, tag: int, buf, count, dtype,
+                 is_obj: bool) -> None:
+        super().__init__()
+        self.ctx = ctx
+        self.want_src = src
+        self.want_tag = tag
+        self.buf = buf
+        self.count = count
+        self.dtype = dtype
+        self.conv: Optional[Convertor] = None
+        self.total = 0
+        self.is_obj = is_obj
+        self.recv_id = 0
+        self.matched = False
+
+    def _cancel(self) -> None:
+        if not self.matched and not self.completed:
+            self.status.cancelled = True
+            self.complete()
+
+
+class _Unexpected:
+    """Parked arrival that found no posted recv."""
+
+    __slots__ = ("hdr", "payload", "src_world")
+
+    def __init__(self, hdr, payload, src_world) -> None:
+        self.hdr = hdr       # parsed (type, ctx, src, tag, seq, size,
+        self.payload = payload  # flags, msgid); eager payload bytes
+        self.src_world = src_world
+
+
+class Message:
+    """MPI_Message (mprobe/mrecv handle)."""
+
+    def __init__(self, pml, ctx, unexpected: _Unexpected) -> None:
+        self._pml = pml
+        self._ctx = ctx
+        self._ux = unexpected
+
+
+class Ob1:
+    """The PML instance (one per process)."""
+
+    def __init__(self) -> None:
+        from ompi_tpu.btl import self_btl, sm, tcp  # register components
+        from ompi_tpu.btl.base import Bml
+
+        self.bml = Bml()
+        # matching state, keyed by ctx (= cid*2 + collective bit)
+        self.posted: Dict[int, deque] = {}
+        self.unexpected: Dict[int, deque] = {}
+        # ordered delivery: per (ctx, src) sequence numbers
+        self.send_seq: Dict[Tuple[int, int], int] = {}
+        self.recv_seq: Dict[Tuple[int, int], int] = {}
+        self.reorder: Dict[Tuple[int, int], Dict[int, Tuple]] = {}
+        # in-flight protocol state
+        self.pending_ack: Dict[int, SendRequest] = {}   # msgid -> req
+        self.active_recv: Dict[int, RecvRequest] = {}   # recv_id -> req
+        self._recv_ids = itertools.count(1)
+        # frames for communicators this rank has not constructed yet
+        # (a peer can finish comm creation and send before we do —
+        # reference ob1 queues "non-existing communicator" fragments)
+        self.early_frames: Dict[int, list] = {}
+
+    # -- lifecycle --------------------------------------------------------
+    def enable(self) -> None:
+        btl_base.set_recv_callback(self._on_frame)
+
+    def disable(self) -> None:
+        btl_base.set_recv_callback(None)
+        self.bml.finalize()
+
+    # -- helpers ----------------------------------------------------------
+    @staticmethod
+    def _ctx(comm, collective: bool = False) -> int:
+        return comm.cid * 2 + (1 if collective else 0)
+
+    def _next_seq(self, ctx: int, dst_commrank: int) -> int:
+        key = (ctx, dst_commrank)
+        seq = self.send_seq.get(key, 0)
+        self.send_seq[key] = seq + 1
+        return seq
+
+    def _eager_limit(self, dst_world: int) -> int:
+        return self.bml.endpoint(dst_world).eager_limit
+
+    def _frag_size(self, dst_world: int) -> int:
+        return self.bml.endpoint(dst_world).max_send
+
+    # -- send path (reference: pml_ob1_sendreq.h:388-440) -----------------
+    def isend(self, comm, buf, count, dtype, dst: int, tag: int,
+              sync: bool = False, obj=NO_OBJ,
+              collective: bool = False) -> SendRequest:
+        req = SendRequest()
+        if dst == rq.PROC_NULL:
+            req.complete()
+            return req
+        ctx = self._ctx(comm, collective)
+        flags = 0
+        if obj is not NO_OBJ:
+            payload_all = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+            conv = Convertor(payload_all, BYTE, len(payload_all))
+            flags |= FLAG_OBJ
+        else:
+            if dtype is None:
+                dtype = dtype_of(buf)
+            conv = Convertor(buf, dtype, count)
+        if sync:
+            flags |= FLAG_SYNC
+        dst_world = comm.world_rank(dst)
+        src_commrank = comm.rank
+        seq = self._next_seq(ctx, dst)
+        size = conv.packed_size
+        msgid = next(_msg_ids)
+        req.conv = conv
+        req.dst_world = dst_world
+        req.ctx = ctx
+        req.msgid = msgid
+        eager = self._eager_limit(dst_world)
+        pvar.record("isend")
+        if size <= eager:
+            payload = conv.pack()
+            hdr = _MATCH.pack(HDR_MATCH, ctx, src_commrank, tag, seq,
+                              size, flags, msgid)
+            pvar.record("eager")
+            if sync:
+                self.pending_ack[msgid] = req
+                self.bml.endpoint(dst_world).send(dst_world, hdr + payload)
+            else:
+                self.bml.endpoint(dst_world).send(dst_world, hdr + payload)
+                req.complete()
+        else:
+            hdr = _MATCH.pack(HDR_RNDV, ctx, src_commrank, tag, seq,
+                              size, flags, msgid)
+            pvar.record("rndv")
+            self.pending_ack[msgid] = req
+            self.bml.endpoint(dst_world).send(dst_world, hdr)
+        return req
+
+    def send(self, comm, buf, count, dtype, dst: int, tag: int,
+             sync: bool = False, collective: bool = False) -> None:
+        self.isend(comm, buf, count, dtype, dst, tag, sync=sync,
+                   collective=collective).wait()
+
+    def send_obj(self, comm, obj, dst: int, tag: int,
+                 collective: bool = False) -> None:
+        self.isend(comm, None, 0, None, dst, tag, obj=obj,
+                   collective=collective).wait()
+
+    def isend_obj(self, comm, obj, dst: int, tag: int,
+                  collective: bool = False) -> SendRequest:
+        return self.isend(comm, None, 0, None, dst, tag, obj=obj,
+                          collective=collective)
+
+    # -- recv path --------------------------------------------------------
+    def irecv(self, comm, buf, count, dtype, src: int, tag: int,
+              collective: bool = False) -> RecvRequest:
+        if src == rq.PROC_NULL:
+            req = RecvRequest(0, src, tag, buf, count, dtype, False)
+            req.status.source = rq.PROC_NULL
+            req.status.tag = rq.ANY_TAG
+            req.complete()
+            return req
+        ctx = self._ctx(comm, collective)
+        if dtype is None and buf is not None:
+            dtype = dtype_of(buf)
+        req = RecvRequest(ctx, src, tag, buf, count, dtype, False)
+        pvar.record("irecv")
+        self._post(req)
+        return req
+
+    def irecv_obj(self, comm, src: int, tag: int,
+                  collective: bool = False) -> RecvRequest:
+        ctx = self._ctx(comm, collective)
+        req = RecvRequest(ctx, src, tag, None, 0, None, True)
+        pvar.record("irecv")
+        self._post(req)
+        return req
+
+    def recv(self, comm, buf, count, dtype, src: int, tag: int,
+             collective: bool = False) -> rq.Status:
+        return self.irecv(comm, buf, count, dtype, src, tag,
+                          collective=collective).wait()
+
+    def recv_obj(self, comm, src: int, tag: int, collective: bool = False):
+        req = self.irecv_obj(comm, src, tag, collective=collective)
+        req.wait()
+        return req._obj
+
+    def _post(self, req: RecvRequest) -> None:
+        """Try the unexpected queue, else append to posted."""
+        ux_q = self.unexpected.setdefault(req.ctx, deque())
+        for ux in ux_q:
+            if self._hdr_matches(req, ux.hdr):
+                ux_q.remove(ux)
+                self._match(req, ux.hdr, ux.payload, ux.src_world)
+                return
+        self.posted.setdefault(req.ctx, deque()).append(req)
+
+    @staticmethod
+    def _hdr_matches(req: RecvRequest, hdr) -> bool:
+        _, _, src, tag, _, _, _, _ = hdr
+        if req.want_src != rq.ANY_SOURCE and req.want_src != src:
+            return False
+        if req.want_tag != rq.ANY_TAG and req.want_tag != tag:
+            return False
+        # negative tags are framework-internal: never match ANY_TAG
+        if req.want_tag == rq.ANY_TAG and tag < 0:
+            return False
+        return True
+
+    # -- probe family -----------------------------------------------------
+    def iprobe(self, comm, src: int, tag: int) -> Optional[rq.Status]:
+        from ompi_tpu.core import progress
+
+        progress.progress()
+        ctx = self._ctx(comm)
+        probe = RecvRequest(ctx, src, tag, None, 0, None, False)
+        for ux in self.unexpected.get(ctx, ()):
+            if self._hdr_matches(probe, ux.hdr):
+                st = rq.Status()
+                _, _, s, t, _, size, _, _ = ux.hdr
+                st.source, st.tag, st.count = s, t, size
+                pvar.record("matched_probes")
+                return st
+        return None
+
+    def probe(self, comm, src: int, tag: int) -> rq.Status:
+        from ompi_tpu.core import progress
+
+        result: List[rq.Status] = []
+
+        def check() -> bool:
+            st = self.iprobe(comm, src, tag)
+            if st is not None:
+                result.append(st)
+                return True
+            return False
+
+        progress.wait_until(check)
+        return result[0]
+
+    def improbe(self, comm, src: int,
+                tag: int) -> Optional[Tuple[Message, rq.Status]]:
+        from ompi_tpu.core import progress
+
+        progress.progress()
+        ctx = self._ctx(comm)
+        probe = RecvRequest(ctx, src, tag, None, 0, None, False)
+        q = self.unexpected.get(ctx, deque())
+        for ux in q:
+            if self._hdr_matches(probe, ux.hdr):
+                q.remove(ux)
+                st = rq.Status()
+                _, _, s, t, _, size, _, _ = ux.hdr
+                st.source, st.tag, st.count = s, t, size
+                return Message(self, ctx, ux), st
+        return None
+
+    def mprobe(self, comm, src: int, tag: int) -> Tuple[Message, rq.Status]:
+        from ompi_tpu.core import progress
+
+        out: List = []
+
+        def check() -> bool:
+            got = self.improbe(comm, src, tag)
+            if got is not None:
+                out.append(got)
+                return True
+            return False
+
+        progress.wait_until(check)
+        return out[0]
+
+    def mrecv(self, msg: Message, buf, count, dtype) -> rq.Status:
+        ux = msg._ux
+        req = RecvRequest(msg._ctx, ux.hdr[2], ux.hdr[3], buf, count,
+                          dtype, buf is None)
+        self._match(req, ux.hdr, ux.payload, ux.src_world)
+        req.wait()
+        return req.status
+
+    # -- matching & protocol (receiver side) ------------------------------
+    def _on_frame(self, data: bytes) -> None:
+        t = data[0]
+        if t in (HDR_MATCH, HDR_RNDV):
+            hdr = _MATCH.unpack_from(data, 0)
+            payload = data[_MATCH.size:]
+            self._on_match_frame(hdr, payload)
+        elif t == HDR_ACK:
+            _, msgid, recv_id = _ACK.unpack_from(data, 0)
+            self._on_ack(msgid, recv_id)
+        elif t == HDR_FRAG:
+            _, recv_id, offset = _FRAG.unpack_from(data, 0)
+            self._on_frag(recv_id, offset, data[_FRAG.size:])
+        else:
+            _out.error("unknown frame type %d", t)
+
+    def _on_match_frame(self, hdr, payload) -> None:
+        """Sequence-ordered delivery per (ctx, src)
+        (reference: match_incomming, pml_ob1_recvfrag.c:863-960)."""
+        _, ctx, src, _, seq, _, _, _ = hdr
+        from ompi_tpu import comm as comm_mod
+
+        if comm_mod.lookup_cid(ctx // 2) is None:
+            self.early_frames.setdefault(ctx // 2, []).append(
+                (hdr, payload))
+            return
+        key = (ctx, src)
+        expected = self.recv_seq.get(key, 0)
+        if seq != expected:
+            pvar.record("out_of_sequence")
+            self.reorder.setdefault(key, {})[seq] = (hdr, payload)
+            return
+        self._deliver_match(hdr, payload)
+        self.recv_seq[key] = expected + 1
+        # drain any parked successors
+        parked = self.reorder.get(key)
+        while parked:
+            nxt = self.recv_seq[key]
+            item = parked.pop(nxt, None)
+            if item is None:
+                break
+            self._deliver_match(*item)
+            self.recv_seq[key] = nxt + 1
+
+    def _deliver_match(self, hdr, payload) -> None:
+        _, ctx, src, tag, _, size, flags, msgid = hdr
+        q = self.posted.setdefault(ctx, deque())
+        for req in q:
+            if self._hdr_matches(req, hdr):
+                q.remove(req)
+                self._match(req, hdr, payload, self._src_world(ctx, src))
+                return
+        pvar.record("unexpected")
+        self.unexpected.setdefault(ctx, deque()).append(
+            _Unexpected(hdr, payload, self._src_world(ctx, src)))
+
+    @staticmethod
+    def _src_world(ctx: int, src_commrank: int) -> int:
+        from ompi_tpu import comm as comm_mod
+
+        c = comm_mod.lookup_cid(ctx // 2)
+        if c is None:
+            raise errors.MPIError(errors.ERR_COMM,
+                                  f"message for unknown cid {ctx // 2}")
+        return c.group.ranks[src_commrank]
+
+    def _match(self, req: RecvRequest, hdr, payload, src_world: int) -> None:
+        typ, ctx, src, tag, _, size, flags, msgid = hdr
+        req.matched = True
+        req.status.source = src
+        req.status.tag = tag
+        req.total = size
+        # build the receive convertor
+        if req.is_obj or (flags & FLAG_OBJ and req.buf is None):
+            req.buf = bytearray(size)
+            req.is_obj = True
+            req.conv = Convertor(req.buf, BYTE, size)
+        else:
+            req.conv = Convertor(req.buf, req.dtype, req.count)
+            if size > req.conv.packed_size:
+                # truncation: still must drain the protocol
+                req.status.error = errors.ERR_TRUNCATE
+        if typ == HDR_MATCH:
+            take = min(size, req.conv.packed_size)
+            req.conv.unpack(payload[:take])
+            req.status.count = take
+            if flags & FLAG_SYNC:
+                ack = _ACK.pack(HDR_ACK, msgid, 0)
+                self.bml.endpoint(src_world).send(src_world, ack)
+            self._finish_recv(req)
+        else:  # RNDV: allocate recv id, ack, wait for frags
+            req.recv_id = next(self._recv_ids)
+            self.active_recv[req.recv_id] = req
+            ack = _ACK.pack(HDR_ACK, msgid, req.recv_id)
+            self.bml.endpoint(src_world).send(src_world, ack)
+
+    def _finish_recv(self, req: RecvRequest) -> None:
+        if req.is_obj and req.status.error == 0:
+            req._obj = pickle.loads(bytes(req.buf))
+        req.complete(req.status.error)
+
+    # -- sender: ack/frag streaming (reference: mca_pml_ob1_send_request_
+    #    schedule pipeline, depth pml_ob1_component.c:207) ----------------
+    def _on_ack(self, msgid: int, recv_id: int) -> None:
+        req = self.pending_ack.pop(msgid, None)
+        if req is None:
+            _out.error("ACK for unknown msgid %d", msgid)
+            return
+        if recv_id == 0:  # eager ssend ack
+            req.complete()
+            return
+        conv = req.conv
+        frag_size = self._frag_size(req.dst_world)
+        ep = self.bml.endpoint(req.dst_world)
+        while not conv.done:
+            offset = conv.position
+            data = conv.pack(max_bytes=frag_size)
+            ep.send(req.dst_world,
+                    _FRAG.pack(HDR_FRAG, recv_id, offset) + data)
+        req.complete()
+
+    def _on_frag(self, recv_id: int, offset: int, data: bytes) -> None:
+        req = self.active_recv.get(recv_id)
+        if req is None:
+            _out.error("FRAG for unknown recv_id %d", recv_id)
+            return
+        if req.status.error == errors.ERR_TRUNCATE:
+            # drain the stream but drop bytes beyond capacity
+            room = req.conv.packed_size - req.conv.position
+            if room > 0:
+                req.conv.unpack(data[:room])
+        else:
+            assert offset == req.conv.position, \
+                f"frag offset {offset} != conv position {req.conv.position}"
+            req.conv.unpack(data)
+        # completion when the sender's full size has streamed past us
+        end = offset + len(data)
+        if end >= req.total:
+            req.status.count = min(req.total, req.conv.packed_size)
+            del self.active_recv[recv_id]
+            self._finish_recv(req)
+
+    def comm_registered(self, cid: int) -> None:
+        """Replay frames that arrived before this comm existed locally."""
+        frames = self.early_frames.pop(cid, None)
+        if frames:
+            for hdr, payload in frames:
+                self._on_match_frame(hdr, payload)
+
+    # -- cancel / cleanup -------------------------------------------------
+    def cancel_recv(self, req: RecvRequest) -> None:
+        q = self.posted.get(req.ctx)
+        if q is not None and req in q:
+            q.remove(req)
+        req._cancel()

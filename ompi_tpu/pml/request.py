@@ -1,0 +1,133 @@
+"""Requests — completion objects for nonblocking operations.
+
+Reference: ompi/request/ (request.h:451-470 wait via ompi_wait_sync_t;
+req_test.c/req_wait.c for test/wait{,any,all,some}). Completion here is a
+flag flipped by the progress engine; waits spin progress (SYNC_WAIT,
+opal/threads/wait_sync.h:52).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, List, Optional, Sequence
+
+from ompi_tpu.core import progress
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+PROC_NULL = -2
+
+_req_ids = itertools.count(1)
+
+
+class Status:
+    """MPI_Status."""
+
+    __slots__ = ("source", "tag", "error", "count", "cancelled")
+
+    def __init__(self) -> None:
+        self.source = ANY_SOURCE
+        self.tag = ANY_TAG
+        self.error = 0
+        self.count = 0
+        self.cancelled = False
+
+    def get_count(self, datatype=None) -> int:
+        if datatype is None or datatype.size == 0:
+            return self.count
+        return self.count // datatype.size
+
+    def __repr__(self) -> str:
+        return (f"Status(source={self.source}, tag={self.tag}, "
+                f"count={self.count})")
+
+
+class Request:
+    """Base request; subclasses fill in _cancel/_free/start."""
+
+    def __init__(self) -> None:
+        self.id = next(_req_ids)
+        self.completed = False
+        self.status = Status()
+        self.persistent = False
+        self._obj: Any = None  # deserialized payload for object recvs
+
+    # -- completion ------------------------------------------------------
+    def complete(self, error: int = 0) -> None:
+        self.status.error = error
+        self.completed = True
+
+    def test(self) -> bool:
+        if not self.completed:
+            progress.progress()
+        return self.completed
+
+    def wait(self, timeout: Optional[float] = None) -> Status:
+        progress.wait_until(lambda: self.completed, timeout=timeout)
+        if not self.completed:
+            raise TimeoutError(f"request {self.id} did not complete")
+        if self.status.error:
+            from ompi_tpu.errors import raise_mpi_error
+
+            raise_mpi_error(self.status.error)
+        return self.status
+
+    def cancel(self) -> None:
+        self._cancel()
+
+    def _cancel(self) -> None:  # best-effort; recv-only in practice
+        pass
+
+    def start(self) -> None:  # persistent requests override
+        raise RuntimeError("not a persistent request")
+
+    def free(self) -> None:
+        pass
+
+
+class CompletedRequest(Request):
+    """Immediately-complete request (e.g. PROC_NULL ops)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.complete()
+
+
+REQUEST_NULL = CompletedRequest()
+
+
+# -- wait/test plural forms (MPI_Waitall etc.) ---------------------------
+
+def wait_all(reqs: Sequence[Request],
+             timeout: Optional[float] = None) -> List[Status]:
+    progress.wait_until(lambda: all(r.completed for r in reqs),
+                        timeout=timeout)
+    if not all(r.completed for r in reqs):
+        raise TimeoutError("waitall timed out")
+    return [r.status for r in reqs]
+
+
+def wait_any(reqs: Sequence[Request]) -> int:
+    progress.wait_until(lambda: any(r.completed for r in reqs))
+    for i, r in enumerate(reqs):
+        if r.completed:
+            return i
+    raise AssertionError
+
+
+def wait_some(reqs: Sequence[Request]) -> List[int]:
+    progress.wait_until(lambda: any(r.completed for r in reqs))
+    return [i for i, r in enumerate(reqs) if r.completed]
+
+
+def test_all(reqs: Sequence[Request]) -> bool:
+    progress.progress()
+    return all(r.completed for r in reqs)
+
+
+def test_any(reqs: Sequence[Request]) -> Optional[int]:
+    progress.progress()
+    for i, r in enumerate(reqs):
+        if r.completed:
+            return i
+    return None

@@ -167,11 +167,14 @@ class Client:
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
 
-    def _rpc(self, *msg: Any) -> Tuple:
+    def _rpc(self, *msg: Any, timeout: Optional[float] = None) -> Tuple:
         with self._lock:
             send_msg(self._sock, msg)
-            self._sock.settimeout(None)
-            reply = recv_msg(self._sock)
+            self._sock.settimeout(timeout)
+            try:
+                reply = recv_msg(self._sock)
+            finally:
+                self._sock.settimeout(None)
         if reply[0] == "aborted":
             raise RuntimeError(f"job aborted: {reply[1]}")
         if reply[0] == "err":
@@ -185,8 +188,11 @@ class Client:
         reply = self._rpc("get", key, wait)
         return reply[1] if reply[0] == "val" else None
 
-    def fence(self, tag: str, nprocs: int) -> None:
-        self._rpc("fence", tag, nprocs)
+    def fence(self, tag: str, nprocs: int,
+              timeout: Optional[float] = None) -> None:
+        """Blocks until nprocs arrive. A timeout raises socket.timeout —
+        used by shutdown paths that must not hang on a dead peer."""
+        self._rpc("fence", tag, nprocs, timeout=timeout)
 
     def inc(self, key: str, amount: int = 1) -> int:
         return self._rpc("inc", key, amount)[1]

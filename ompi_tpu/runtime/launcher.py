@@ -39,9 +39,15 @@ def build_env(rank: int, size: int, store_addr, jobid: str,
     env["OMPI_TPU_STORE_ADDR"] = f"{store_addr[0]}:{store_addr[1]}"
     for k, v in (mca or {}).items():
         env[f"OMPI_TPU_{k.upper()}"] = v
-    # rank processes must not grab the real TPU all at once; the device
-    # plane is the single-controller parallel/ layer. Host ranks run on CPU.
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    # Rank processes must not grab the real TPU: the device plane is the
+    # single-controller parallel/ layer in the launching process. Force
+    # host ranks onto CPU (override with OMPI_TPU_RANK_JAX_PLATFORMS for
+    # one-rank-per-chip multi-controller deployments).
+    env["JAX_PLATFORMS"] = env.get("OMPI_TPU_RANK_JAX_PLATFORMS", "cpu")
+    if env["JAX_PLATFORMS"] == "cpu":
+        # skip TPU-plugin registration in sitecustomize for CPU ranks
+        # (costs ~2s of jax import per process otherwise)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
     # make ompi_tpu importable in ranks regardless of install state
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))

@@ -84,15 +84,16 @@ def modex_recv(component: str, peer: int, wait: bool = True) -> Any:
     return client().get(f"modex:{jobid}:{component}:{peer}", wait=wait)
 
 
-def fence(tag: str = "") -> None:
-    """All-rank rendezvous (PMIx_Fence)."""
+def fence(tag: str = "", timeout: float | None = None) -> None:
+    """All-rank rendezvous (PMIx_Fence). A timeout (shutdown paths only:
+    it leaves the RPC stream desynchronized) raises socket.timeout."""
     global _fence_epoch
     if size == 1:
         return
     with _lock:
         _fence_epoch += 1
         epoch = _fence_epoch
-    client().fence(f"fence:{jobid}:{tag}:{epoch}", size)
+    client().fence(f"fence:{jobid}:{tag}:{epoch}", size, timeout=timeout)
 
 
 def next_id(space: str) -> int:

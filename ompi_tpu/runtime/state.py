@@ -88,6 +88,15 @@ def finalize() -> None:
                 _world.barrier()
         except Exception:
             pass
+        try:
+            if rte.size > 1:
+                # every rank must have drained its last messages before
+                # any transport tears down (unlink/close races). Bounded:
+                # a rank whose barrier failed still fences, and a dead
+                # peer cannot hang survivors past the timeout.
+                rte.fence("finalize", timeout=30.0)
+        except Exception:
+            pass
         from ompi_tpu import pml
 
         pml.finalize()

@@ -1,0 +1,44 @@
+"""Multi-rank test harness.
+
+Reference analog: the test strategy of SURVEY.md §4 — no mock network;
+N real processes on localhost over self+sm+tcp stand in for a cluster
+(the mpi4py-suite-under-mpiexec pattern of the reference CI).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import textwrap
+from typing import Dict, Optional
+
+from ompi_tpu.runtime import launcher
+
+_PRELUDE = """
+import numpy as np
+from ompi_tpu import mpi
+comm = mpi.Init()
+rank, size = comm.rank, comm.size
+"""
+
+_EPILOGUE = """
+mpi.Finalize()
+"""
+
+
+def run_ranks(body: str, n: int, mca: Optional[Dict[str, str]] = None,
+              timeout: float = 120, prelude: bool = True) -> None:
+    """Run `body` (indented python) in n ranks; assert all exit 0."""
+    src = (_PRELUDE if prelude else "") + textwrap.dedent(body) \
+        + (_EPILOGUE if prelude else "")
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as fh:
+        fh.write(src)
+        path = fh.name
+    try:
+        rc = launcher.launch([sys.executable, path], n, mca=mca,
+                             timeout=timeout)
+        assert rc == 0, f"ranks exited with {rc}\n--- script ---\n{src}"
+    finally:
+        os.unlink(path)

@@ -1,0 +1,105 @@
+"""A/B validation of every base algorithm against coll/basic — the
+reference's own strategy (forced-algorithm params, SURVEY.md §4)."""
+
+import pytest
+
+from tests.harness import run_ranks
+
+_ALLREDUCE_BODY = """
+    rng = np.random.default_rng(42 + rank)
+    for n in (1, 5, 1000, 4096):
+        data = rng.standard_normal(n).astype(np.float64)
+        out = np.zeros_like(data)
+        comm.Allreduce(data, out)
+        oracle = np.zeros_like(data)
+        mpi.COMM_WORLD  # touch
+        # oracle via deterministic basic linear: gather+sum in rank order
+        allv = comm.allgather(data)
+        expect = allv[0].copy()
+        for v in allv[1:]:
+            expect = expect + v
+        assert np.allclose(out, expect, rtol=1e-12), (n, out, expect)
+"""
+
+
+@pytest.mark.parametrize("algo", ["recursivedoubling", "ring",
+                                  "rabenseifner"])
+@pytest.mark.parametrize("n", [3, 4])
+def test_allreduce_algos(algo, n):
+    run_ranks(_ALLREDUCE_BODY, n,
+              mca={"coll_tuned_allreduce_algorithm": algo})
+
+
+@pytest.mark.parametrize("algo", ["binomial", "pipeline"])
+def test_bcast_algos(algo):
+    run_ranks("""
+        for n in (3, 1000, 100_000):
+            buf = (np.arange(n, dtype=np.float32) * 2 if rank == 1
+                   else np.zeros(n, dtype=np.float32))
+            comm.Bcast(buf, root=1)
+            assert (buf == np.arange(n, dtype=np.float32) * 2).all()
+    """, 4, mca={"coll_tuned_bcast_algorithm": algo})
+
+
+@pytest.mark.parametrize("algo", ["ring", "bruck", "recursivedoubling"])
+def test_allgather_algos(algo):
+    run_ranks("""
+        for cnt in (1, 7, 512):
+            sb = np.full(cnt, rank + 1, dtype=np.int64)
+            rb = np.zeros(cnt * size, dtype=np.int64)
+            comm.Allgather(sb, rb)
+            expect = np.repeat(np.arange(1, size + 1), cnt)
+            assert (rb == expect).all(), (cnt, rb)
+    """, 4, mca={"coll_tuned_allgather_algorithm": algo})
+
+
+@pytest.mark.parametrize("algo", ["pairwise", "bruck"])
+def test_alltoall_algos(algo):
+    run_ranks("""
+        for cnt in (1, 9):
+            sb = np.arange(size * cnt, dtype=np.int32) + rank * 1000
+            rb = np.zeros(size * cnt, dtype=np.int32)
+            comm.Alltoall(sb, rb)
+            expect = np.concatenate([
+                np.arange(rank * cnt, (rank + 1) * cnt) + s * 1000
+                for s in range(size)]).astype(np.int32)
+            assert (rb == expect).all(), (cnt, rb, expect)
+    """, 4, mca={"coll_tuned_alltoall_algorithm": algo})
+
+
+@pytest.mark.parametrize("algo", ["recursivedoubling", "bruck"])
+@pytest.mark.parametrize("n", [3, 4])
+def test_barrier_algos(algo, n):
+    run_ranks("""
+        for _ in range(10):
+            comm.Barrier()
+    """, n, mca={"coll_tuned_barrier_algorithm": algo})
+
+
+def test_reduce_scatter_block_ring():
+    run_ranks("""
+        sb = (np.arange(3 * size, dtype=np.float64) + 1) * (rank + 1)
+        rb = np.zeros(3, dtype=np.float64)
+        comm.Reduce_scatter_block(sb, rb)
+        tot = sum(r + 1 for r in range(size))
+        expect = (np.arange(3 * size, dtype=np.float64) + 1) * tot
+        assert np.allclose(rb, expect[3 * rank:3 * rank + 3])
+    """, 4)
+
+
+def test_reduce_scatter_recursivehalving():
+    run_ranks("""
+        counts = [2] * size
+        sb = np.arange(2 * size, dtype=np.float64) * (rank + 2)
+        rb = np.zeros(2, dtype=np.float64)
+        comm.Reduce_scatter(sb, rb, counts)
+        tot = sum(r + 2 for r in range(size))
+        expect = np.arange(2 * size, dtype=np.float64) * tot
+        assert np.allclose(rb, expect[2 * rank:2 * rank + 2])
+    """, 4)
+
+
+def test_nonpow2_ring_and_fold():
+    """Non-power-of-two sizes exercise the fold paths."""
+    run_ranks(_ALLREDUCE_BODY, 3,
+              mca={"coll_tuned_allreduce_algorithm": "ring"})

@@ -1,0 +1,60 @@
+"""Nonblocking collectives (coll/libnbc schedules)."""
+
+from tests.harness import run_ranks
+
+
+def test_ibarrier_overlap():
+    run_ranks("""
+        req = comm.Ibarrier()
+        # overlap local work with the barrier rounds
+        acc = float(np.arange(1000).sum())
+        req.wait()
+        assert acc == 499500.0
+    """, 4)
+
+
+def test_iallreduce_and_ibcast():
+    run_ranks("""
+        data = np.full(64, rank + 1, dtype=np.float64)
+        out = np.zeros_like(data)
+        r1 = comm.Iallreduce(data, out)
+        buf = (np.arange(32, dtype=np.int32) if rank == 0
+               else np.zeros(32, dtype=np.int32))
+        r2 = comm.Ibcast(buf, root=0)
+        mpi.wait_all([r1, r2])
+        assert (out == sum(r + 1 for r in range(size))).all()
+        assert (buf == np.arange(32, dtype=np.int32)).all()
+    """, 4)
+
+
+def test_igather_iscatter_ialltoall():
+    run_ranks("""
+        sb = np.full(2, rank, dtype=np.int64)
+        rb = np.zeros(2 * size, dtype=np.int64) if rank == 0 else None
+        r1 = comm.Igather(sb, rb, root=0)
+        r1.wait()
+        if rank == 0:
+            assert (rb.reshape(size, 2) ==
+                    np.arange(size)[:, None]).all()
+        a2a_s = np.arange(size, dtype=np.int32) + rank * 10
+        a2a_r = np.zeros(size, dtype=np.int32)
+        comm.Ialltoall(a2a_s, a2a_r).wait()
+        assert (a2a_r == np.arange(size) * 10 + rank).all()
+    """, 3)
+
+
+def test_multiple_outstanding_nbc():
+    """Several i-collectives in flight on one comm at once."""
+    run_ranks("""
+        reqs = []
+        outs = []
+        for k in range(4):
+            data = np.full(16, (rank + 1) * (k + 1), dtype=np.float64)
+            out = np.zeros_like(data)
+            outs.append(out)
+            reqs.append(comm.Iallreduce(data, out))
+        mpi.wait_all(reqs)
+        tot = sum(r + 1 for r in range(size))
+        for k, out in enumerate(outs):
+            assert (out == tot * (k + 1)).all(), (k, out)
+    """, 3)

@@ -1,0 +1,196 @@
+"""Point-to-point stack tests (reference analog: test/simple + the
+mpi4py p2p suite run under mpiexec)."""
+
+from tests.harness import run_ranks
+
+
+def test_ring_4rank():
+    """BASELINE config #1: examples/ring_c.c equivalent."""
+    run_ranks("""
+        nxt, prv = (rank + 1) % size, (rank - 1 + size) % size
+        msg = np.array([10], dtype=np.int32)
+        if rank == 0:
+            comm.Send(msg, dest=nxt, tag=201)
+        while True:
+            comm.Recv(msg, source=prv, tag=201)
+            if rank == 0:
+                msg[0] -= 1
+            comm.Send(msg, dest=nxt, tag=201)
+            if msg[0] == 0:
+                break
+        if rank == 0:
+            comm.Recv(msg, source=prv, tag=201)
+        assert msg[0] == 0
+    """, 4)
+
+
+def test_object_roundtrip():
+    run_ranks("""
+        if rank == 0:
+            comm.send({"k": [1, 2, 3]}, dest=1, tag=7)
+            got = comm.recv(source=1, tag=8)
+            assert got == "reply", got
+        elif rank == 1:
+            got = comm.recv(source=0, tag=7)
+            assert got == {"k": [1, 2, 3]}, got
+            comm.send("reply", dest=0, tag=8)
+    """, 2)
+
+
+def test_rndv_large_message():
+    """> eager limit: exercises RNDV ACK + FRAG pipeline over sm."""
+    run_ranks("""
+        n = 300_000  # 1.2MB of float32 > sm rndv thresholds
+        if rank == 0:
+            data = np.arange(n, dtype=np.float32)
+            comm.Send(data, dest=1, tag=1)
+        else:
+            buf = np.zeros(n, dtype=np.float32)
+            st = comm.Recv(buf, source=0, tag=1)
+            assert st.count == n * 4, st.count
+            assert buf[0] == 0 and buf[-1] == n - 1
+            assert (buf == np.arange(n, dtype=np.float32)).all()
+    """, 2)
+
+
+def test_any_source_any_tag_ordering():
+    run_ranks("""
+        if rank == 0:
+            seen = set()
+            for _ in range(size - 1):
+                st = mpi.Status()
+                obj = comm.recv(source=mpi.ANY_SOURCE, tag=mpi.ANY_TAG,
+                                status=st)
+                assert obj == st.source * 100 + st.tag
+                seen.add(st.source)
+            assert seen == {1, 2}
+        else:
+            comm.send(rank * 100 + rank, dest=0, tag=rank)
+    """, 3)
+
+
+def test_nonovertaking_same_peer():
+    """Messages between one pair must match in send order."""
+    run_ranks("""
+        if rank == 0:
+            for i in range(50):
+                comm.send(i, dest=1, tag=5)
+        else:
+            for i in range(50):
+                assert comm.recv(source=0, tag=5) == i
+    """, 2)
+
+
+def test_isend_irecv_waitall():
+    run_ranks("""
+        peer = 1 - rank
+        sends = [comm.Isend(np.full(8, rank * 10 + i, dtype=np.int64),
+                            dest=peer, tag=i) for i in range(10)]
+        bufs = [np.zeros(8, dtype=np.int64) for _ in range(10)]
+        recvs = [comm.Irecv(bufs[i], source=peer, tag=i)
+                 for i in range(10)]
+        mpi.wait_all(recvs)
+        mpi.wait_all(sends)
+        for i, b in enumerate(bufs):
+            assert (b == peer * 10 + i).all()
+    """, 2)
+
+
+def test_ssend_synchronous():
+    run_ranks("""
+        import time
+        if rank == 0:
+            t0 = time.time()
+            comm.Ssend(np.ones(4, dtype=np.int32), dest=1, tag=3)
+            elapsed = time.time() - t0
+            # receiver posts after 0.3s; ssend cannot complete before
+            assert elapsed > 0.2, elapsed
+        else:
+            time.sleep(0.3)
+            buf = np.zeros(4, dtype=np.int32)
+            comm.Recv(buf, source=0, tag=3)
+    """, 2)
+
+
+def test_probe_and_truncation():
+    run_ranks("""
+        if rank == 0:
+            comm.Send(np.arange(10, dtype=np.float64), dest=1, tag=11)
+            comm.Send(np.arange(4, dtype=np.int32), dest=1, tag=12)
+        else:
+            st = comm.Probe(source=0, tag=11)
+            assert st.count == 80, st.count
+            buf = np.zeros(10, dtype=np.float64)
+            comm.Recv(buf, source=0, tag=11)
+            # truncation: 4-int message into 2-int buffer must raise
+            small = np.zeros(2, dtype=np.int32)
+            try:
+                comm.Recv(small, source=0, tag=12)
+                raise SystemExit(5)  # no error -> fail the test
+            except Exception:
+                pass
+    """, 2)
+
+
+def test_sendrecv_exchange():
+    run_ranks("""
+        peer = 1 - rank
+        sbuf = np.full(16, rank, dtype=np.int32)
+        rbuf = np.zeros(16, dtype=np.int32)
+        comm.Sendrecv(sbuf, dest=peer, recvbuf=rbuf, source=peer,
+                      sendtag=0, recvtag=0)
+        assert (rbuf == peer).all()
+    """, 2)
+
+
+def test_persistent_requests():
+    run_ranks("""
+        peer = 1 - rank
+        sbuf = np.zeros(4, dtype=np.int32)
+        rbuf = np.zeros(4, dtype=np.int32)
+        sreq = comm.Send_init(sbuf, dest=peer, tag=2)
+        rreq = comm.Recv_init(rbuf, source=peer, tag=2)
+        for it in range(5):
+            sbuf[:] = rank * 100 + it
+            rreq.start(); sreq.start()
+            rreq.wait(); sreq.wait()
+            assert (rbuf == peer * 100 + it).all()
+    """, 2)
+
+
+def test_mprobe_mrecv():
+    run_ranks("""
+        if rank == 0:
+            comm.Send(np.arange(6, dtype=np.int32), dest=1, tag=44)
+        else:
+            msg, st = comm.Mprobe(source=0, tag=44)
+            assert st.count == 24
+            buf = np.zeros(6, dtype=np.int32)
+            comm.Mrecv(msg, buf)
+            assert (buf == np.arange(6)).all()
+    """, 2)
+
+
+def test_tcp_only_transport():
+    run_ranks("""
+        peer = 1 - rank
+        data = np.arange(100_000, dtype=np.float32)  # rndv over tcp
+        out = np.zeros_like(data)
+        comm.Sendrecv(data, dest=peer, recvbuf=out, source=peer)
+        assert (out == data).all()
+    """, 2, mca={"btl": "self,tcp"})
+
+
+def test_derived_datatype_transfer():
+    """Send a strided column; receive contiguous."""
+    run_ranks("""
+        from ompi_tpu.datatype import vector, FLOAT
+        if rank == 0:
+            mat = np.arange(16, dtype=np.float32).reshape(4, 4)
+            col = vector(4, 1, 4, FLOAT).commit()
+            comm.Send((mat, 1, col), dest=1, tag=9)
+        else:
+            buf = np.zeros(4, dtype=np.float32)
+            comm.Recv(buf, source=0, tag=9)
+            assert (buf == [0, 4, 8, 12]).all(), buf
+    """, 2)
