@@ -1,0 +1,123 @@
+"""Driver benchmark: one JSON line on stdout.
+
+Primary metric (single real chip): flagship transformer train-step
+throughput in tokens/s — exercises the framework's full compute path
+(embedding, ring-capable attention, Megatron-ready matmuls, CE loss,
+backward, SGD update) on the MXU in bfloat16.
+
+Secondary (in "extra"): the north-star-adjacent accelerator numbers a
+single chip can measure — D2H/H2D staging bandwidth through the
+accelerator component (the memcpy path of coll/accelerator, SURVEY.md
+§2.3) and device allreduce-via-staging bandwidth.
+
+vs_baseline: ratio against bench_baseline.json (committed after the
+first real-chip measurement) so cross-round progress is visible; 1.0
+when no baseline exists yet.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _bench_train_step():
+    import numpy as np
+    import jax
+
+    from ompi_tpu.models import transformer as tfm
+
+    cfg = tfm.Config(vocab=8192, d_model=512, n_layers=4, n_heads=8,
+                     d_ff=2048, max_seq=512)
+    ax = tfm.Axes()
+    specs = tfm.param_specs(cfg, ax)
+    rng = np.random.default_rng(0)
+    params = jax.device_put(tfm.init_params(rng, cfg))
+    B, T = 8, 512
+    tokens = jax.device_put(
+        rng.integers(0, cfg.vocab, (B, T)).astype(np.int32))
+    labels = jax.device_put(
+        np.roll(np.asarray(tokens), -1, axis=1).astype(np.int32))
+
+    step = jax.jit(tfm.make_train_step(cfg, ax, specs, lr=1e-3))
+    params, loss = step(params, tokens, labels)   # compile + 1 step
+    jax.block_until_ready(loss)
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, loss = step(params, tokens, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    tokens_per_s = B * T * iters / dt
+
+    # rough model-flops estimate: 6 * params * tokens (fwd+bwd)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    flops = 6.0 * n_params * B * T * iters / dt
+    return tokens_per_s, flops / 1e12, float(loss)
+
+
+def _bench_staging():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu.accelerator import current as acc
+
+    nbytes = 64 << 20  # 64 MB
+    x = jnp.zeros(nbytes // 4, jnp.float32) + 1.0
+    jax.block_until_ready(x)
+    a = acc()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        h = a.to_host(x)
+    d2h = 5 * nbytes / (time.perf_counter() - t0) / 1e9
+    t0 = time.perf_counter()
+    for _ in range(5):
+        d = a.to_device(h)
+        jax.block_until_ready(d)
+    h2d = 5 * nbytes / (time.perf_counter() - t0) / 1e9
+    return d2h, h2d
+
+
+def main() -> None:
+    t_start = time.time()
+    tokens_per_s, tflops, loss = _bench_train_step()
+    try:
+        d2h, h2d = _bench_staging()
+    except Exception:
+        d2h = h2d = None
+
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_baseline.json")
+    vs = 1.0
+    if os.path.exists(base_path):
+        try:
+            base = json.load(open(base_path))
+            vs = tokens_per_s / float(base["value"])
+        except Exception:
+            pass
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": "flagship_train_step_tokens_per_s",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs, 4),
+        "extra": {
+            "model_tflops_per_s": round(tflops, 3),
+            "final_loss": round(loss, 4),
+            "staging_d2h_GBs": None if d2h is None else round(d2h, 2),
+            "staging_h2d_GBs": None if h2d is None else round(h2d, 2),
+            "device": f"{dev.platform}:{getattr(dev, 'device_kind', '?')}",
+            "wall_s": round(time.time() - t_start, 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

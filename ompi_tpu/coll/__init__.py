@@ -36,6 +36,11 @@ SLOTS = (
     "agree",
     # neighborhood (installed when a topology is attached)
     "neighbor_allgather", "neighbor_alltoall",
+    # device-buffer variants (coll/accelerator staging; return new
+    # device arrays — PJRT buffers are immutable)
+    "allreduce_dev", "bcast_dev", "reduce_dev", "allgather_dev",
+    "alltoall_dev", "reduce_scatter_block_dev", "scatter_dev",
+    "gather_dev",
 )
 
 
@@ -101,7 +106,9 @@ def comm_select(comm) -> None:
 
 
 def _register_builtin() -> None:
-    from ompi_tpu.coll import basic, libnbc, tuned  # noqa: F401
+    from ompi_tpu.coll import (  # noqa: F401
+        accelerator, basic, libnbc, tuned,
+    )
 
 
 _register_builtin()

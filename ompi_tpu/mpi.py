@@ -45,6 +45,14 @@ def _parse_buf(buf) -> Tuple[Any, int, Optional[Datatype]]:
     arr = buf
     if isinstance(arr, np.ndarray):
         return arr, arr.size, dtype_of(arr)
+    if type(arr).__module__.split(".")[0] in ("jax", "jaxlib"):
+        raise TypeError(
+            "device array passed to an operation without a device "
+            "path. Device-interposed collectives: Allreduce, Bcast, "
+            "Reduce, Allgather, Alltoall, Reduce_scatter_block, "
+            "Scatter, Gather (sendbuf device, recvbuf None -> returns "
+            "a new device array). For other operations stage manually "
+            "with np.asarray(arr) / jax.device_put.")
     mv = memoryview(arr)
     return arr, mv.nbytes, None
 
@@ -268,18 +276,36 @@ def _Recv_init(self, buf, source: int = ANY_SOURCE,
 
 # -- collectives (capitalized: buffers; lowercase: objects) --
 
+def _is_dev(buf) -> bool:
+    """True when buf is a device-resident array (reference:
+    accelerator check_addr on every collective entry,
+    coll_accelerator_allreduce.c check_buf)."""
+    if buf is None or buf is IN_PLACE or isinstance(buf, tuple):
+        return False
+    if isinstance(buf, (np.ndarray, bytes, bytearray, memoryview)):
+        return False
+    from ompi_tpu import accelerator
+
+    return accelerator.current().check_addr(buf)
+
+
 def _Barrier(self) -> None:
     self.check_revoked()
     self.coll.barrier(self)
 
 
-def _Bcast(self, buf, root: int = 0) -> None:
+def _Bcast(self, buf, root: int = 0):
     self.check_revoked()
+    if _is_dev(buf):
+        return self.coll.bcast_dev(self, buf, root)
     arr, count, dt = _parse_buf(buf)
     self.coll.bcast(self, arr, count, dt, root)
 
 
-def _Reduce(self, sendbuf, recvbuf, op=op_mod.SUM, root: int = 0) -> None:
+def _Reduce(self, sendbuf, recvbuf=None, op=op_mod.SUM, root: int = 0):
+    self.check_revoked()
+    if _is_dev(sendbuf):
+        return self.coll.reduce_dev(self, sendbuf, op, root)
     sarr, count, dt = _parse_buf(sendbuf) if sendbuf is not IN_PLACE \
         else (IN_PLACE, None, None)
     rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
@@ -288,8 +314,10 @@ def _Reduce(self, sendbuf, recvbuf, op=op_mod.SUM, root: int = 0) -> None:
     self.coll.reduce(self, sarr, rarr, count, dt, op, root)
 
 
-def _Allreduce(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
+def _Allreduce(self, sendbuf, recvbuf=None, op=op_mod.SUM):
     self.check_revoked()
+    if _is_dev(sendbuf):
+        return self.coll.allreduce_dev(self, sendbuf, op)
     if sendbuf is IN_PLACE:
         rarr, count, dt = _parse_buf(recvbuf)
         self.coll.allreduce(self, IN_PLACE, rarr, count, dt, op)
@@ -299,7 +327,10 @@ def _Allreduce(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
         self.coll.allreduce(self, sarr, rarr, count, dt, op)
 
 
-def _Gather(self, sendbuf, recvbuf, root: int = 0) -> None:
+def _Gather(self, sendbuf, recvbuf=None, root: int = 0):
+    self.check_revoked()
+    if _is_dev(sendbuf):
+        return self.coll.gather_dev(self, sendbuf, root)
     sarr, count, dt = _parse_buf(sendbuf)
     rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
     self.coll.gather(self, sarr, rarr, count, dt, root)
@@ -307,6 +338,7 @@ def _Gather(self, sendbuf, recvbuf, root: int = 0) -> None:
 
 def _Gatherv(self, sendbuf, recvbuf, counts, displs=None,
              root: int = 0) -> None:
+    self.check_revoked()
     sarr = _parse_buf(sendbuf)[0]
     rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
     if displs is None:
@@ -315,7 +347,13 @@ def _Gatherv(self, sendbuf, recvbuf, counts, displs=None,
                       dtype_of(sarr), root)
 
 
-def _Scatter(self, sendbuf, recvbuf, root: int = 0) -> None:
+def _Scatter(self, sendbuf, recvbuf=None, root: int = 0,
+             device: bool = False):
+    """``device=True`` lets non-roots (who pass no buffers) opt into the
+    device path explicitly; the root is auto-detected from sendbuf."""
+    self.check_revoked()
+    if _is_dev(sendbuf) or device:
+        return self.coll.scatter_dev(self, sendbuf, root)
     rarr, count, dt = _parse_buf(recvbuf)
     sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
     self.coll.scatter(self, sarr, rarr, count, dt, root)
@@ -323,6 +361,7 @@ def _Scatter(self, sendbuf, recvbuf, root: int = 0) -> None:
 
 def _Scatterv(self, sendbuf, recvbuf, counts, displs=None,
               root: int = 0) -> None:
+    self.check_revoked()
     rarr = _parse_buf(recvbuf)[0]
     sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
     if displs is None:
@@ -331,14 +370,17 @@ def _Scatterv(self, sendbuf, recvbuf, counts, displs=None,
                        dtype_of(rarr), root)
 
 
-def _Allgather(self, sendbuf, recvbuf) -> None:
+def _Allgather(self, sendbuf, recvbuf=None):
     self.check_revoked()
+    if _is_dev(sendbuf):
+        return self.coll.allgather_dev(self, sendbuf)
     sarr, count, dt = _parse_buf(sendbuf)
     rarr = _parse_buf(recvbuf)[0]
     self.coll.allgather(self, sarr, rarr, count, dt)
 
 
 def _Allgatherv(self, sendbuf, recvbuf, counts, displs=None) -> None:
+    self.check_revoked()
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if displs is None:
@@ -347,8 +389,10 @@ def _Allgatherv(self, sendbuf, recvbuf, counts, displs=None) -> None:
                          dtype_of(sarr))
 
 
-def _Alltoall(self, sendbuf, recvbuf) -> None:
+def _Alltoall(self, sendbuf, recvbuf=None):
     self.check_revoked()
+    if _is_dev(sendbuf):
+        return self.coll.alltoall_dev(self, sendbuf)
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     count = np.asarray(sarr).size // self.size
@@ -357,6 +401,7 @@ def _Alltoall(self, sendbuf, recvbuf) -> None:
 
 def _Alltoallv(self, sendbuf, recvbuf, scounts, rcounts,
                sdispls=None, rdispls=None) -> None:
+    self.check_revoked()
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if sdispls is None:
@@ -367,13 +412,17 @@ def _Alltoallv(self, sendbuf, recvbuf, scounts, rcounts,
                         rdispls, dtype_of(sarr))
 
 
-def _Reduce_scatter_block(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
+def _Reduce_scatter_block(self, sendbuf, recvbuf=None, op=op_mod.SUM):
+    self.check_revoked()
+    if _is_dev(sendbuf):
+        return self.coll.reduce_scatter_block_dev(self, sendbuf, op)
     rarr, count, dt = _parse_buf(recvbuf)
     sarr = _parse_buf(sendbuf)[0]
     self.coll.reduce_scatter_block(self, sarr, rarr, count, dt, op)
 
 
 def _Reduce_scatter(self, sendbuf, recvbuf, counts, op=op_mod.SUM) -> None:
+    self.check_revoked()
     rarr = _parse_buf(recvbuf)[0]
     sarr = _parse_buf(sendbuf)[0]
     self.coll.reduce_scatter(self, sarr, rarr, counts,
@@ -381,12 +430,14 @@ def _Reduce_scatter(self, sendbuf, recvbuf, counts, op=op_mod.SUM) -> None:
 
 
 def _Scan(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
+    self.check_revoked()
     sarr, count, dt = _parse_buf(sendbuf)
     rarr = _parse_buf(recvbuf)[0]
     self.coll.scan(self, sarr, rarr, count, dt, op)
 
 
 def _Exscan(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
+    self.check_revoked()
     sarr, count, dt = _parse_buf(sendbuf)
     rarr = _parse_buf(recvbuf)[0]
     self.coll.exscan(self, sarr, rarr, count, dt, op)

@@ -1,0 +1,16 @@
+"""Compute ops built on the device plane.
+
+Long-context and distributed-by-construction ops (SURVEY.md §5
+"Long-context / sequence parallelism"): the reference exposes segmented/
+pipelined ring schedules as collective algorithms; here those schedules
+carry *attention and MoE compute*, which is what a TPU framework actually
+runs over them.
+
+- :mod:`ompi_tpu.ops.ring_attention` — context-parallel attention: KV
+  blocks rotate around the ICI ring (ppermute) while each hop's block
+  feeds flash-style online-softmax accumulation.
+- :mod:`ompi_tpu.ops.moe` — expert-parallel dispatch/combine over
+  all_to_all (the MPI_Alltoallv MoE pattern of BASELINE.md config #5).
+- :mod:`ompi_tpu.ops.attention` — single-device attention kernels
+  (jax reference + pallas TPU kernel where available).
+"""

@@ -1,0 +1,78 @@
+"""Attention kernels — single-device reference implementations.
+
+The jax reference here is the correctness oracle for the distributed
+ring attention (:mod:`ompi_tpu.ops.ring_attention`) and the target the
+pallas TPU kernel must match. Shapes follow [batch, seq, heads, head_dim]
+throughout (the TPU-friendly layout: seq*heads tiles the MXU).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def mha(q, k, v, causal: bool = True, scale: Optional[float] = None,
+        q_offset: int = 0, k_offset: int = 0):
+    """Multi-head attention, full-softmax reference.
+
+    q: [B, Tq, H, D], k/v: [B, Tk, H, D] -> [B, Tq, H, D].
+    q_offset/k_offset give the global positions of the local blocks
+    (used when blocks are slices of a longer sequence).
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    p = jnp.exp(scores - lax.stop_gradient(
+        jnp.max(scores, axis=-1, keepdims=True)))
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    p = p / jnp.maximum(denom, 1e-30)
+    # bf16 operands + f32 accumulation: full MXU rate, f32 precision
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def online_softmax_block(q, k, v, o, l, m, mask=None,
+                         scale: Optional[float] = None):
+    """One flash-attention accumulation step over a KV block.
+
+    Carries (all float32 regardless of activation dtype):
+    o [B,Tq,H,D] numerator, l [B,H,Tq] denominator, m [B,H,Tq]
+    running max. Returns updated (o, l, m).
+    mask: [Tq, Tk] boolean (True = attend) or None.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d)
+    # matmul in the input dtype (MXU), softmax statistics in f32 —
+    # the flash-attention convention; bf16 stats drift with seq length
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1)  # [B,H,Tq]
+    m_new = jnp.maximum(m, m_blk)
+    # fully-masked block: keep everything finite
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)  # [B,H,Tq,Tk]
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = (o * corr.transpose(0, 2, 1)[..., None]
+             + jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32))
+    return o_new, l_new, m_new
+
+
+def finalize_online_softmax(o, l):
+    """o / l with fully-masked rows zeroed."""
+    denom = l.transpose(0, 2, 1)[..., None]  # [B,Tq,H,1]
+    return jnp.where(denom > 0, o / jnp.maximum(denom, 1e-30), 0.0)

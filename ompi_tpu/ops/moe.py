@@ -1,0 +1,79 @@
+"""Expert-parallel MoE dispatch/combine over all_to_all.
+
+BASELINE.md config #5 is the MPI_Alltoall(v) MoE expert-dispatch
+pattern; the reference implements the transport (bruck/pairwise/linear
+alltoall, coll_base_alltoall.c:180-616) and leaves the model math to the
+application. TPU-native, the two fuse: dispatch = one-hot matmul (MXU)
++ ``lax.all_to_all`` over the expert axis (ICI), experts run their FFN
+on dense [E_local, n*C, D] blocks, and combine is the inverse all_to_all
+weighted by the gates.
+
+Capacity-based top-1 (Switch-Transformer style) routing: static shapes
+(XLA requirement — no dynamic token counts), overflow tokens dropped,
+which is the standard TPU trade.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+
+class MoEDispatch(NamedTuple):
+    combine: jnp.ndarray   # [T, E, C] combine weights (gate at slot)
+    dispatch: jnp.ndarray  # [T, E, C] 0/1 dispatch assignment
+
+
+def top1_routing(logits, capacity: int) -> MoEDispatch:
+    """Switch top-1 router. logits: [T, E]; C slots per expert."""
+    t, e = logits.shape
+    gates = logits.astype(jnp.float32)
+    gates = jnp.exp(gates - lax.stop_gradient(
+        gates.max(-1, keepdims=True)))
+    gates = gates / gates.sum(-1, keepdims=True)          # softmax [T,E]
+    expert = jnp.argmax(gates, axis=-1)                   # [T]
+    onehot = jnp.eye(e, dtype=jnp.float32)[expert]        # [T,E]
+    # position of each token within its expert's queue (arrival order)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0       # [T,E]
+    keep = (pos >= 0) & (pos < capacity)                  # [T,E]
+    pos = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    posmask = jnp.eye(capacity, dtype=jnp.float32)[pos]   # [T,E,C]
+    dispatch = posmask * keep[..., None]                  # [T,E,C]
+    gate1 = (gates * onehot).sum(-1)                      # [T]
+    combine = dispatch * gate1[:, None, None]
+    return MoEDispatch(combine=combine, dispatch=dispatch)
+
+
+def moe_ffn(x, wg, w1, w2, axis: str, capacity_factor: float = 1.25):
+    """Expert-parallel MoE FFN layer inside ``shard_map``.
+
+    x: local tokens [T, D]; wg: router [D, E_total] (replicated);
+    w1/w2: this device's experts [E_local, D, F], [E_local, F, D].
+    E_total = E_local * axis_size(axis). Returns [T, D].
+    """
+    n = lax.axis_size(axis)
+    t, d = x.shape
+    e_local = w1.shape[0]
+    e_total = e_local * n
+    cap = max(int(capacity_factor * t / e_total), 1)
+
+    route = top1_routing(x @ wg, cap)
+    # pack tokens into per-expert slots: [E_total, C, D] (one-hot matmul
+    # -> MXU; also what makes dispatch differentiable w.r.t. x)
+    slots = jnp.einsum("tec,td->ecd", route.dispatch, x)
+    # exchange over the expert axis: dim0 split by destination device,
+    # received stacked by source -> [n_src, E_local, C, D]
+    slots = slots.reshape(n, e_local, cap, d)
+    slots = lax.all_to_all(slots, axis, split_axis=0, concat_axis=0)
+    slots = slots.transpose(1, 0, 2, 3).reshape(e_local, n * cap, d)
+    # local experts' FFN on dense blocks
+    hidden = jnp.maximum(jnp.einsum("ekd,edf->ekf", slots, w1), 0.0)
+    out = jnp.einsum("ekf,efd->ekd", hidden, w2)
+    # inverse exchange: back to the source devices
+    out = out.reshape(e_local, n, cap, d).transpose(1, 0, 2, 3)
+    out = lax.all_to_all(out, axis, split_axis=0, concat_axis=0)
+    # [n_expert_group, E_local, C, D] == [E_total, C, D] for this device
+    out = out.reshape(e_total, cap, d)
+    return jnp.einsum("tec,ecd->td", route.combine, out).astype(x.dtype)

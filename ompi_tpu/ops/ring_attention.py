@@ -1,0 +1,61 @@
+"""Ring attention — context/sequence parallelism over the ICI ring.
+
+SURVEY.md §5 "Long-context / sequence parallelism": the reference has no
+sequence-parallel layer; its ring collectives (segmented ring allreduce,
+chain/pipeline bcast) are the *schedules* such a layer runs. This module
+is that layer, TPU-native: the sequence is sharded along a mesh axis,
+KV blocks rotate around the ring (one ``ppermute`` hop per step —
+:func:`ompi_tpu.parallel.ring.ring_scan`), and each hop's block feeds
+flash-style online-softmax accumulation
+(:func:`ompi_tpu.ops.attention.online_softmax_block`). Compute at step s
+overlaps the transfer of step s+1 — the same overlap the reference's
+segmented pipelines achieve with eager/rndv fragment scheduling.
+
+Memory: O(T_local) per device — sequence length scales linearly with the
+ring size (the point of context parallelism).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from ompi_tpu.ops import attention as att
+from ompi_tpu.parallel import ring
+
+
+def ring_attention(q, k, v, axis: str, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Context-parallel attention inside ``shard_map``.
+
+    q/k/v: local blocks [B, T_local, H, D]; the global sequence is the
+    concatenation over the `axis` ring in rank order. Returns the local
+    output block [B, T_local, H, D].
+    """
+    n = lax.axis_size(axis)
+    r = lax.axis_index(axis)
+    b, t, h, d = q.shape
+    # accumulators in f32 (flash-attention convention) even for bf16
+    # activations; cast back at the end
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+
+    tpos = jnp.arange(t)
+
+    def body(s, src, blk, carry):
+        o, l, m = carry
+        kb, vb = blk
+        if causal:
+            qpos = r * t + tpos
+            kpos = src * t + tpos
+            mask = qpos[:, None] >= kpos[None, :]
+        else:
+            mask = None
+        return att.online_softmax_block(q, kb, vb, o, l, m, mask=mask,
+                                        scale=scale)
+
+    o, l, m = ring.ring_scan(body, (o0, l0, m0), (k, v), axis)
+    return att.finalize_online_softmax(o, l).astype(q.dtype)

@@ -1,0 +1,31 @@
+"""The device plane — SPMD collectives over the TPU ICI mesh.
+
+This package is the TPU-native answer to the reference's network stack
+(SURVEY.md §5 "Distributed communication backend"): where Open MPI runs
+BTL components (tcp/sm/ofi — opal/mca/btl/) under the ob1 matching engine
+and delegates device collectives to staging (ompi/mca/coll/accelerator),
+a TPU program expresses communication as *compiled collective ops over a
+device mesh* and lets XLA schedule them onto ICI links.
+
+Layering:
+
+- :mod:`ompi_tpu.parallel.mesh` — device mesh construction (the
+  "topology plane"; reference analog: hwloc + PRRTE mapping).
+- :mod:`ompi_tpu.parallel.collectives` — axis-keyed collective library
+  usable inside ``shard_map`` (reference analog: the coll framework's
+  algorithm library, ompi/mca/coll/base/).
+- :mod:`ompi_tpu.parallel.ring` — explicit ring schedules over
+  ``ppermute`` (reference analog: ring/segmented-ring algorithms,
+  coll_base_allreduce.c:974; also the substrate for ring attention).
+- :mod:`ompi_tpu.parallel.device_comm` — ``DeviceCommunicator``: the
+  MPI-communicator-shaped face over a mesh axis (reference analog:
+  ompi/communicator + per-comm coll table).
+"""
+
+from ompi_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh, mesh_shape_for, local_device_count, abstract_mesh,
+)
+from ompi_tpu.parallel.device_comm import (  # noqa: F401
+    DeviceCommunicator, world_comm,
+)
+from ompi_tpu.parallel import collectives, ring  # noqa: F401

@@ -1,0 +1,325 @@
+"""Axis-keyed collective library — usable inside ``shard_map``.
+
+Reference analog: the coll base algorithm library
+(ompi/mca/coll/base/coll_base_functions.h — ~70 `ompi_coll_base_*`
+variants) plus the tuned decision layer. TPU-first redesign: a
+"collective" is a traced op on per-device shards keyed by a mesh axis
+name; XLA lowers it to ICI transfers. The algorithm zoo collapses to
+
+- the XLA primitive (``psum``/``all_gather``/``psum_scatter``/
+  ``all_to_all``/``ppermute``) — let the compiler schedule; this is the
+  default, like coll/tuned's decision layer;
+- explicit ring schedules (:mod:`ompi_tpu.parallel.ring`) when the
+  *reduction order* must be fixed (bit-identical mode — the north-star
+  requirement of BASELINE.md) or when overlap must be hand-staged;
+- gather-then-fold ("linear") for ops XLA has no reduction primitive
+  for (PROD, bitwise) and for bit-identical-to-rank-order mode, the
+  analog of coll/basic's linear reduce (deterministic operand order).
+
+Every function here must be called inside ``shard_map``/``pjit`` tracing
+with the named axis bound (the SPMD region is the MPI "communicator
+context"; axis name plays the role of the CID).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ompi_tpu import op as op_mod
+
+# MPI_Op -> elementwise jnp combine fn (device-side kernels; reference
+# analog: ompi/mca/op base C loops / op/avx — on TPU the VPU does this).
+_JNP_FN = {
+    "MPI_SUM": jnp.add,
+    "MPI_PROD": jnp.multiply,
+    "MPI_MIN": jnp.minimum,
+    "MPI_MAX": jnp.maximum,
+    "MPI_LAND": jnp.logical_and,
+    "MPI_LOR": jnp.logical_or,
+    "MPI_LXOR": jnp.logical_xor,
+    "MPI_BAND": jnp.bitwise_and,
+    "MPI_BOR": jnp.bitwise_or,
+    "MPI_BXOR": jnp.bitwise_xor,
+}
+
+#: ops with a native XLA all-reduce lowering
+_XLA_REDUCE = {
+    "MPI_SUM": lax.psum,
+    "MPI_MIN": lax.pmin,
+    "MPI_MAX": lax.pmax,
+}
+
+
+def _op_of(op) -> op_mod.Op:
+    if isinstance(op, op_mod.Op):
+        return op
+    return op_mod.BUILTIN[op]
+
+
+def combine_fn(op):
+    """The jnp elementwise combiner for an MPI op (user ops use their
+    own fn, which must be jax-traceable to run on device)."""
+    op = _op_of(op)
+    fn = _JNP_FN.get(op.name)
+    if fn is not None:
+        return fn
+    return op.np_fn  # user-defined: must be traceable
+
+
+def axis_size(axis: str) -> int:
+    return lax.axis_size(axis)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+
+
+def allreduce(x, axis: str, op=op_mod.SUM,
+              deterministic: Optional[str] = None):
+    """MPI_Allreduce over a mesh axis.
+
+    deterministic=None  -> XLA primitive (compiler-scheduled, fastest);
+    deterministic='ring'   -> fixed ring order (bit-identical run-to-run
+                              and device-count-stable per chunk);
+    deterministic='linear' -> rank-order fold, bit-identical to
+                              coll/basic's linear reduce+bcast.
+    """
+    op = _op_of(op)
+    if deterministic not in (None, "ring", "linear"):
+        raise ValueError(
+            f"deterministic={deterministic!r}: expected None, 'ring' "
+            "or 'linear' (silent fallthrough would void the "
+            "fixed-reduction-order guarantee)")
+    logical = op.name in ("MPI_LAND", "MPI_LOR", "MPI_LXOR")
+    xin = x.astype(jnp.bool_) if logical else x
+    if deterministic == "ring":
+        from ompi_tpu.parallel import ring
+
+        out = ring.ring_allreduce(xin, axis, combine_fn(op))
+        return out.astype(x.dtype) if logical else out
+    if deterministic == "linear":
+        out = _allreduce_linear(xin, axis, op)
+        return out.astype(x.dtype) if logical else out
+    prim = _XLA_REDUCE.get(op.name)
+    if prim is not None:
+        return prim(x, axis_name=axis)
+    if op.name in ("MPI_LAND", "MPI_LOR"):
+        # logical and/or == min/max over {0,1}
+        red = lax.pmin if op.name == "MPI_LAND" else lax.pmax
+        return red(xin.astype(jnp.int32), axis_name=axis).astype(x.dtype)
+    out = _allreduce_linear(xin, axis, op)
+    return out.astype(x.dtype) if logical else out
+
+
+def _allreduce_linear(x, axis: str, op: op_mod.Op):
+    """Gather all shards, fold in rank order (statically unrolled so the
+    operand order is exactly rank 0..n-1, like coll/basic)."""
+    n = lax.axis_size(axis)
+    fn = combine_fn(op)
+    g = lax.all_gather(x, axis)  # [n, ...] new leading axis
+    acc = g[0]
+    for i in range(1, n):
+        acc = fn(acc, g[i])
+    return acc
+
+
+def reduce(x, axis: str, op=op_mod.SUM, root: int = 0,
+           deterministic: Optional[str] = None):
+    """MPI_Reduce: in SPMD every device computes the reduction (the
+    result is only *meaningful* on root; computing everywhere is free on
+    TPU and avoids a divergent program)."""
+    return allreduce(x, axis, op, deterministic)
+
+
+def reduce_scatter(x, axis: str, op=op_mod.SUM, scatter_dim: int = 0,
+                   tiled: bool = True,
+                   deterministic: Optional[str] = None):
+    """MPI_Reduce_scatter_block: reduce then scatter equal chunks.
+
+    With tiled=True, dim `scatter_dim` of x (size n*k) shrinks to k.
+    """
+    op = _op_of(op)
+    if deterministic not in (None, "ring", "linear"):
+        raise ValueError(
+            f"deterministic={deterministic!r}: expected None, 'ring' "
+            "or 'linear'")
+    if deterministic == "ring":
+        from ompi_tpu.parallel import ring
+
+        assert scatter_dim == 0, "ring reduce_scatter: dim 0 only"
+        return ring.ring_reduce_scatter(x, axis, combine_fn(op))
+    if op.name == "MPI_SUM":
+        return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
+                                tiled=tiled)
+    # no native lowering: allreduce then slice own chunk (same shape
+    # semantics as psum_scatter: tiled keeps the dim at size/n, untiled
+    # squeezes a size-n dim away)
+    full = allreduce(x, axis, op, deterministic)
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    if tiled:
+        k = x.shape[scatter_dim] // n
+        return lax.dynamic_slice_in_dim(full, idx * k, k,
+                                        axis=scatter_dim)
+    return lax.dynamic_index_in_dim(full, idx, axis=scatter_dim,
+                                    keepdims=False)
+
+
+# ---------------------------------------------------------------------------
+# data movement
+
+
+def allgather(x, axis: str, tiled: bool = True, gather_dim: int = 0):
+    """MPI_Allgather. tiled=True concatenates along gather_dim;
+    tiled=False stacks a new leading axis."""
+    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def alltoall(x, axis: str, split_dim: int = 0, concat_dim: int = 0):
+    """MPI_Alltoall: split dim `split_dim` n-ways, exchange, concat on
+    `concat_dim` (the MoE dispatch primitive)."""
+    return lax.all_to_all(x, axis, split_axis=split_dim,
+                          concat_axis=concat_dim, tiled=True)
+
+
+def bcast(x, axis: str, root: int = 0):
+    """MPI_Bcast: every device gets root's shard."""
+    n = lax.axis_size(axis)
+    # gather + static index: one all-gather, no divergence. For large
+    # buffers XLA rewrites broadcast-from-one as an ICI multicast.
+    g = lax.all_gather(x, axis)
+    return g[root]
+
+
+def scatter(x, axis: str, root: int = 0, dim: int = 0):
+    """MPI_Scatter from root's shard: every device holds x (same shape);
+    device i takes chunk i of root's value."""
+    full = bcast(x, axis, root)
+    n = lax.axis_size(axis)
+    k = full.shape[dim] // n
+    idx = lax.axis_index(axis)
+    return lax.dynamic_slice_in_dim(full, idx * k, k, axis=dim)
+
+
+def gather(x, axis: str, root: int = 0, dim: int = 0):
+    """MPI_Gather: root's result is the concatenation (SPMD: all ranks
+    compute it — same rationale as `reduce`)."""
+    return lax.all_gather(x, axis, axis=dim, tiled=True)
+
+
+def ppermute(x, axis: str, perm: Sequence[Tuple[int, int]]):
+    """Point-to-point permutation (the SPMD send/recv: reference analog
+    is MPI_Sendrecv rounds inside ring/bruck algorithms)."""
+    return lax.ppermute(x, axis, perm=list(perm))
+
+
+def shift(x, axis: str, offset: int = 1):
+    """Ring shift by `offset` (MPI_Cart_shift + Sendrecv on a ring)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm=perm)
+
+
+# ---------------------------------------------------------------------------
+# prefix ops
+
+
+def scan(x, axis: str, op=op_mod.SUM):
+    """MPI_Scan (inclusive prefix over rank order)."""
+    op = _op_of(op)
+    n = lax.axis_size(axis)
+    fn = combine_fn(op)
+    g = lax.all_gather(x, axis)  # [n, ...]
+    idx = lax.axis_index(axis)
+    # fold in rank order, select own prefix: O(n) compute, one
+    # collective — fine for the scan's typical tiny payloads.
+    acc = g[0]
+    outs = [acc]
+    for i in range(1, n):
+        acc = fn(acc, g[i])
+        outs.append(acc)
+    stacked = jnp.stack(outs)
+    return stacked[idx]
+
+
+def exscan(x, axis: str, op=op_mod.SUM, identity=None):
+    """MPI_Exscan (exclusive prefix; rank 0 gets `identity` or zeros)."""
+    op = _op_of(op)
+    n = lax.axis_size(axis)
+    fn = combine_fn(op)
+    g = lax.all_gather(x, axis)
+    idx = lax.axis_index(axis)
+    if identity is None:
+        ident = jnp.zeros_like(x)
+    else:
+        ident = jnp.broadcast_to(jnp.asarray(identity, x.dtype), x.shape)
+    acc = g[0]
+    outs = [ident, acc]
+    for i in range(1, n - 1):
+        acc = fn(acc, g[i])
+        outs.append(acc)
+    stacked = jnp.stack(outs)
+    return stacked[idx]
+
+
+# ---------------------------------------------------------------------------
+# AD-boundary collectives (Megatron's f/g pair)
+#
+# In manual tensor parallelism the forward/backward collectives are
+# conjugate: entering a sharded region is identity forward but must
+# all-reduce the partial cotangents backward; leaving it (row-parallel
+# matmul) is psum forward and identity backward. Defining both with
+# custom_vjp makes the pairing explicit rather than relying on psum's
+# transpose rule.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def region_enter(x, axis: str):
+    """Identity fwd / psum bwd: apply to a replicated activation as it
+    enters a column-parallel (sharded-feature) region."""
+    return x
+
+
+def _re_fwd(x, axis):
+    return x, None
+
+
+def _re_bwd(axis, _, g):
+    return (lax.psum(g, axis),)
+
+
+region_enter.defvjp(_re_fwd, _re_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def region_exit(x, axis: str):
+    """psum fwd / identity bwd: apply to the partial output of a
+    row-parallel matmul."""
+    return lax.psum(x, axis)
+
+
+def _rx_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _rx_bwd(axis, _, g):
+    return (g,)
+
+
+region_exit.defvjp(_rx_fwd, _rx_bwd)
+
+
+def barrier(axis: str):
+    """A data-dependence barrier: returns a scalar token that depends on
+    every device having reached this point. (MPI_Barrier's ordering
+    semantics only exist through data dependence under XLA.)"""
+    return lax.psum(jnp.zeros((), jnp.int32), axis_name=axis)

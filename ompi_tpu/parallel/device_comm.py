@@ -1,0 +1,142 @@
+"""DeviceCommunicator — the communicator face of the device plane.
+
+Reference analog: ompi/communicator (group + CID + per-comm coll table,
+comm_cid.c:297-463). TPU-first redesign: inside an SPMD program a
+"communicator" is a **mesh axis** — the axis name is the CID, the set of
+mesh positions along the axis is the group, and the per-comm function
+table is the collective library bound to that axis. Sub-communicators
+along other axes are free (a 2-D mesh gives every row/column communicator
+at once — what MPI_Cart_sub builds, ompi/mca/topo/base).
+
+The SURVEY.md §2.3/§2.8 `coll/xla` integration point is realised here:
+communicator -> replica_groups == mesh axis -> XLA `replica_groups`
+attribute, with collectives compiled once per (op, dtype, shape, axis)
+by jit's trace cache (the reference caches compiled schedules the same
+way, keyed on comm+ddt).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ompi_tpu import op as op_mod
+from ompi_tpu.parallel import collectives as C
+
+Axis = Union[str, Tuple[str, ...]]
+
+
+class DeviceCommunicator:
+    """A communicator bound to one or more axes of a device mesh.
+
+    Collective methods are *traced ops*: call them inside a
+    ``shard_map``/``run`` region over the mesh. ``size`` is static;
+    ``rank`` is a traced per-device value (``lax.axis_index``).
+    """
+
+    def __init__(self, mesh, axis: Axis) -> None:
+        self.mesh = mesh
+        self.axis = axis if isinstance(axis, str) else tuple(axis)
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def size(self) -> int:
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        if isinstance(self.axis, str):
+            return shape[self.axis]
+        return math.prod(shape[a] for a in self.axis)
+
+    @property
+    def rank(self):
+        """Traced: this device's rank along the axis."""
+        return C.axis_index(self.axis)
+
+    def sub(self, axis: Axis) -> "DeviceCommunicator":
+        """Communicator over a different axis subset of the same mesh
+        (MPI_Cart_sub analog)."""
+        return DeviceCommunicator(self.mesh, axis)
+
+    def replica_groups(self):
+        """Device-id groups along the axis — the XLA replica_groups this
+        communicator compiles to (debug/introspection)."""
+        names = self.mesh.axis_names
+        ids = np.arange(self.mesh.devices.size).reshape(
+            self.mesh.devices.shape)
+        ax = (self.axis,) if isinstance(self.axis, str) else self.axis
+        keep = [i for i, n in enumerate(names) if n not in ax]
+        move = [i for i, n in enumerate(names) if n in ax]
+        perm = keep + move
+        t = ids.transpose(perm).reshape(-1, math.prod(
+            [ids.shape[i] for i in move]) if move else 1)
+        return [list(row) for row in t]
+
+    # -- collectives (traced; MPI names, device semantics) ---------------
+    def Allreduce(self, x, op=op_mod.SUM,
+                  deterministic: Optional[str] = None):
+        return C.allreduce(x, self.axis, op, deterministic)
+
+    def Reduce(self, x, op=op_mod.SUM, root: int = 0,
+               deterministic: Optional[str] = None):
+        return C.reduce(x, self.axis, op, root, deterministic)
+
+    def Reduce_scatter_block(self, x, op=op_mod.SUM, dim: int = 0,
+                             deterministic: Optional[str] = None):
+        return C.reduce_scatter(x, self.axis, op, scatter_dim=dim,
+                                deterministic=deterministic)
+
+    def Allgather(self, x, dim: int = 0, tiled: bool = True):
+        return C.allgather(x, self.axis, tiled=tiled, gather_dim=dim)
+
+    def Alltoall(self, x, split_dim: int = 0, concat_dim: int = 0):
+        return C.alltoall(x, self.axis, split_dim, concat_dim)
+
+    def Bcast(self, x, root: int = 0):
+        return C.bcast(x, self.axis, root)
+
+    def Scatter(self, x, root: int = 0, dim: int = 0):
+        return C.scatter(x, self.axis, root, dim)
+
+    def Gather(self, x, root: int = 0, dim: int = 0):
+        return C.gather(x, self.axis, root, dim)
+
+    def Scan(self, x, op=op_mod.SUM):
+        return C.scan(x, self.axis, op)
+
+    def Exscan(self, x, op=op_mod.SUM):
+        return C.exscan(x, self.axis, op)
+
+    def Barrier(self):
+        return C.barrier(self.axis)
+
+    def Sendrecv(self, x, perm: Sequence[Tuple[int, int]]):
+        return C.ppermute(x, self.axis, perm)
+
+    def Shift(self, x, offset: int = 1):
+        return C.shift(x, self.axis, offset)
+
+    # -- launch -----------------------------------------------------------
+    def run(self, fn: Callable, in_specs, out_specs, **kw):
+        """shard_map `fn` over the mesh: the SPMD region inside which
+        this communicator's collectives execute. Compose with jax.jit
+        for compilation."""
+        import jax
+
+        # check_vma=False: collective results (all_gather/psum) are
+        # replicated by construction, but the static varying-axes check
+        # cannot see that through our op-dispatch indirection.
+        kw.setdefault("check_vma", False)
+        return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+
+
+def world_comm(axis_names: Sequence[str] = ("x",),
+               shape=None, devices=None) -> DeviceCommunicator:
+    """The device plane's COMM_WORLD: a communicator over every axis of
+    a fresh mesh of all local devices."""
+    from ompi_tpu.parallel import mesh as mesh_mod
+
+    m = mesh_mod.make_mesh(axis_names, shape, devices)
+    ax = axis_names[0] if len(axis_names) == 1 else tuple(axis_names)
+    return DeviceCommunicator(m, ax)

@@ -1,0 +1,86 @@
+"""Device mesh construction — the topology plane.
+
+Reference analog: process placement/topology is PRRTE + hwloc's job
+(SURVEY.md §1.4) and rank reordering is topo/treematch
+(ompi/mca/topo/treematch). On TPU the topology is the ICI torus exposed
+as ``jax.devices()``; a ``jax.sharding.Mesh`` with named axes is the
+object every parallelism strategy hangs off (dp/tp/pp/sp/ep are just
+axis names). XLA lays collectives onto ICI rings for each axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def local_device_count() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
+def mesh_shape_for(n: int, naxes: int = 1) -> Tuple[int, ...]:
+    """Factor n devices into `naxes` near-square mesh dims (largest
+    factors first). E.g. (8, 2) -> (4, 2); (16, 3) -> (4, 2, 2)."""
+    dims = [1] * naxes
+    remaining = n
+    for i in range(naxes - 1):
+        # biggest divisor of `remaining` <= the even split
+        target = int(round(remaining ** (1.0 / (naxes - i))))
+        best = 1
+        for d in range(1, remaining + 1):
+            if remaining % d == 0 and d <= max(target, 1):
+                best = d
+        dims[i] = best
+        remaining //= best
+    dims[naxes - 1] = remaining
+    dims.sort(reverse=True)
+    return tuple(dims)
+
+
+def make_mesh(axis_names: Sequence[str] = ("x",),
+              shape: Optional[Sequence[int]] = None,
+              devices=None):
+    """Build a ``jax.sharding.Mesh``.
+
+    - ``axis_names`` names the mesh axes (e.g. ``("dp", "tp")``).
+    - ``shape`` (optional) gives the per-axis sizes; by default all
+      local devices are factored near-square across the axes.
+    - ``devices`` (optional) restricts to a device subset.
+    """
+    import jax
+
+    devs = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = mesh_shape_for(len(devs), len(axis_names))
+    total = math.prod(shape)
+    if total > len(devs):
+        raise ValueError(
+            f"mesh shape {tuple(shape)} needs {total} devices, "
+            f"have {len(devs)}")
+    grid = np.array(devs[:total]).reshape(shape)
+    return jax.sharding.Mesh(grid, tuple(axis_names))
+
+
+def abstract_mesh(axis_names: Sequence[str], shape: Sequence[int]):
+    """An AbstractMesh for shape-only tracing (no devices needed)."""
+    import jax
+
+    return jax.sharding.AbstractMesh(tuple(shape), tuple(axis_names))
+
+
+def require_devices(n: int) -> None:
+    """Ensure >= n devices exist, forcing the virtual CPU platform when
+    the real platform cannot provide them (test/dryrun path; the driver
+    sets xla_force_host_platform_device_count)."""
+    import jax
+
+    if len(jax.devices()) >= n:
+        return
+    raise RuntimeError(
+        f"need {n} devices, have {len(jax.devices())}; set "
+        f"XLA_FLAGS=--xla_force_host_platform_device_count={n} "
+        f"JAX_PLATFORMS=cpu before the first jax use")

@@ -1,0 +1,133 @@
+"""Explicit ring schedules over ``ppermute``.
+
+Reference analog: the ring / segmented-ring collective algorithms
+(ompi/mca/coll/base/coll_base_allreduce.c:974 `ring`,
+`segmented ring`) — O(1/p) working sets, fixed neighbor pattern. On TPU
+the ring is the ICI torus ring along a mesh axis; each "send to
+neighbor" is a ``ppermute`` step that XLA maps to one ICI hop.
+
+Why hand-schedule when ``psum`` exists: (1) **determinism** — the
+accumulation order of a ring is fixed by construction, giving
+bit-identical results run-to-run and a defined operand order
+(BASELINE.md north-star requirement); (2) ring *dataflow* is the
+substrate of ring attention / context parallelism
+(:mod:`ompi_tpu.ops.ring_attention`), where each hop's block feeds
+compute that overlaps with the next hop's transfer.
+
+All functions run inside ``shard_map`` tracing with `axis` bound.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _ring_perm(n: int, offset: int = 1):
+    return [(i, (i + offset) % n) for i in range(n)]
+
+
+def ring_reduce_scatter(x, axis: str, fn: Callable = jnp.add):
+    """Reduce-scatter with fixed ring order: dim 0 of x (size n*k)
+    shrinks to k; rank r ends with chunk r reduced in ring-visit order
+    (ranks r+1, r+2, ..., r)."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    assert x.shape[0] % n == 0, (
+        f"ring_reduce_scatter: dim0 {x.shape[0]} not divisible by {n}")
+    k = x.shape[0] // n
+    chunks = x.reshape((n, k) + x.shape[1:])
+    r = lax.axis_index(axis)
+    perm = _ring_perm(n)
+
+    carry = lax.dynamic_index_in_dim(chunks, (r - 1) % n, keepdims=False)
+
+    def step(s, carry):
+        carry = lax.ppermute(carry, axis, perm=perm)
+        recv_idx = (r - 2 - s) % n
+        own = lax.dynamic_index_in_dim(chunks, recv_idx, keepdims=False)
+        return fn(carry, own)  # carry = earlier ring hosts -> left operand
+
+    carry = lax.fori_loop(0, n - 1, step, carry, unroll=True)
+    return carry
+
+
+def ring_allgather(x, axis: str):
+    """All-gather chunks around the ring: local [k, ...] -> [n*k, ...]
+    with rank i's chunk at block i."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    k = x.shape[0]
+    r = lax.axis_index(axis)
+    perm = _ring_perm(n)
+    out = jnp.zeros((n,) + x.shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(out, x, r, axis=0)
+
+    def step(s, state):
+        out, blk = state
+        blk = lax.ppermute(blk, axis, perm=perm)
+        recv_idx = (r - 1 - s) % n
+        out = lax.dynamic_update_index_in_dim(out, blk, recv_idx, axis=0)
+        return out, blk
+
+    out, _ = lax.fori_loop(0, n - 1, step, (out, x), unroll=True)
+    return out.reshape((n * k,) + x.shape[1:])
+
+
+def ring_allreduce(x, axis: str, fn: Callable = jnp.add):
+    """Bandwidth-optimal allreduce = ring reduce-scatter + ring
+    allgather (the NCCL-style 2(n-1)-step schedule; reference analog
+    coll_base_allreduce.c ring). Deterministic accumulation order.
+
+    Handles any dim-0 size by zero-padding to a multiple of n (pad lanes
+    never mix with data lanes — reductions are elementwise)."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    shape = x.shape
+    flat = x.reshape(-1)
+    m = flat.shape[0]
+    pad = (-m) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), x.dtype)])
+    chunk = ring_reduce_scatter(flat, axis, fn)
+    full = ring_allgather(chunk, axis)
+    return full[:m].reshape(shape)
+
+
+def ring_rotate(block, axis: str, reverse: bool = False):
+    """One ring hop: pass `block` to the next (or previous) rank.
+    The ring-attention KV rotation primitive."""
+    n = lax.axis_size(axis)
+    return lax.ppermute(block, axis,
+                        perm=_ring_perm(n, -1 if reverse else 1))
+
+
+def ring_scan(body: Callable, carry, block, axis: str):
+    """Run the n-step ring pipeline: at step s the local device holds
+    the block originally owned by rank (r - s) mod n and calls
+    ``carry = body(step, src_rank, block, carry)``; the block is then
+    rotated one hop. Compute at step s overlaps the hop s+1 transfer
+    (XLA schedules the ppermute concurrently with `body`).
+
+    This is the schedule under ring attention and pipelined
+    context-parallel ops (reference analog: segmented pipelines with
+    per-segment progress, coll_base_bcast.c chain/pipeline)."""
+    n = lax.axis_size(axis)
+    r = lax.axis_index(axis)
+    perm = _ring_perm(n)
+    carry = body(0, r, block, carry)
+
+    def step(s, state):
+        carry, blk = state
+        blk = lax.ppermute(blk, axis, perm=perm)
+        src = (r - s) % n
+        return body(s, src, blk, carry), blk
+
+    if n > 1:
+        carry, _ = lax.fori_loop(1, n, step, (carry, block), unroll=True)
+    return carry

@@ -6,11 +6,21 @@ the process), so this conftest is the import gate for every test.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The hosting image may inject a device plugin through sitecustomize that
+# force-overrides jax.config.jax_platforms after import; counter-override
+# so tests always run on the 8-device virtual CPU mesh.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import pytest  # noqa: E402
 

@@ -16,6 +16,13 @@ from typing import Dict, Optional
 from ompi_tpu.runtime import launcher
 
 _PRELUDE = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"  # N ranks share the host; no device fights
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 import numpy as np
 from ompi_tpu import mpi
 comm = mpi.Init()

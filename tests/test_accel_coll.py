@@ -1,0 +1,75 @@
+"""Device-buffer collectives on the multi-process plane.
+
+Reference analog: coll/accelerator staging tested via the null/host lane
+(SURVEY.md §4 "Accelerator testing" — the null component keeps
+accelerator-consuming code exercised on CPU-only machines). Here the
+"device" arrays are cpu-backed jax Arrays; the staging path (check_addr
+-> D2H -> host coll -> H2D) is identical to the TPU path.
+"""
+
+from tests.harness import run_ranks
+
+
+def test_device_allreduce_bcast():
+    run_ranks("""
+        import jax.numpy as jnp
+        x = jnp.arange(8, dtype=jnp.float32) + rank
+        out = comm.Allreduce(x)
+        import jax
+        assert isinstance(out, jax.Array)
+        expect = jnp.arange(8, dtype=jnp.float32) * size \
+            + sum(range(size))
+        assert jnp.allclose(out, expect), (out, expect)
+
+        b = jnp.full((4,), float(rank))
+        out = comm.Bcast(b, root=2)
+        assert jnp.allclose(out, jnp.full((4,), 2.0)), out
+    """, n=4)
+
+
+def test_device_allgather_alltoall_rsb():
+    run_ranks("""
+        import jax.numpy as jnp
+        x = jnp.array([rank, rank * 10], dtype=jnp.int32)
+        out = comm.Allgather(x)
+        assert out.shape == (size, 2)
+        for r in range(size):
+            assert out[r, 0] == r and out[r, 1] == r * 10
+
+        a = jnp.arange(size, dtype=jnp.int32) + rank * 100
+        out = comm.Alltoall(a)
+        for r in range(size):
+            assert out[r] == rank + r * 100, out
+
+        m = jnp.ones((size * 2,), jnp.float32) * (rank + 1)
+        out = comm.Reduce_scatter_block(m)
+        tot = sum(range(1, size + 1))
+        assert out.shape == (2,) and bool((out == tot).all()), out
+    """, n=4)
+
+
+def test_device_scatter_gather_reduce():
+    run_ranks("""
+        import jax.numpy as jnp
+        if rank == 0:
+            big = jnp.arange(size * 3, dtype=jnp.float32)
+            mine = comm.Scatter(big, root=0)
+        else:
+            mine = comm.Scatter(None, None, root=0, device=True)
+        assert mine.shape == (3,)
+        assert bool((mine == jnp.arange(3) + rank * 3).all()), mine
+
+        out = comm.Gather(mine, root=1)
+        if rank == 1:
+            assert out.shape == (size, 3)
+            assert bool((out.reshape(-1)
+                         == jnp.arange(size * 3)).all())
+        else:
+            assert out is None
+
+        r = comm.Reduce(jnp.full((2,), float(rank + 1)), root=0)
+        if rank == 0:
+            assert bool((r == sum(range(1, size + 1))).all()), r
+        else:
+            assert r is None
+    """, n=4)
