@@ -1,0 +1,466 @@
+"""One-sided communication (MPI RMA windows).
+
+Reference: ompi/mca/osc/ (osc.h module interface; osc/rdma implements
+windows over BTL remote atomics — osc_rdma_lock.h:26-61 exclusive/shared
+locks via remote fetch-add, active + passive target; 22 KLoC framework).
+
+TPU-native redesign: true remote HBM atomics do not exist on the ICI
+fabric — the device plane's RMA is compiled collectives (what XLA makes
+of one-sided patterns), and *host* windows are what MPI RMA semantics
+attach to. This component therefore implements windows the way the
+reference's pt2pt-emulation osc did: every window runs an active-message
+service on a private duplicated communicator, driven by the progress
+engine; puts/gets/accumulates are ordered per origin-target pair (our
+transports deliver per-pair FIFO), giving MPI's same-origin accumulate
+ordering for free. Passive-target progress happens whenever the target
+enters the library (progress engine sweep) — the same progress rule the
+reference documents for its non-RDMA paths.
+
+Epochs implemented: fence, lock/unlock (+lock_all), flush(+_all),
+post/start/complete/wait (PSCW), request-based Rput/Rget.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ompi_tpu import op as op_mod
+from ompi_tpu import pml
+from ompi_tpu.core import output, pvar
+from ompi_tpu.pml.request import ANY_SOURCE, Request
+
+_out = output.stream("osc")
+
+_SERVICE_TAG = -64  # on the window's private dup comm
+
+LOCK_EXCLUSIVE = "exclusive"
+LOCK_SHARED = "shared"
+
+
+class _WinRequest(Request):
+    """Request handle for Rput/Rget (completion = remote ack/data)."""
+
+    def __init__(self, win: "Window") -> None:
+        super().__init__()
+        self.win = win
+
+    def test(self) -> bool:
+        if not self.completed:
+            from ompi_tpu.core import progress
+
+            progress.progress()
+        return self.completed
+
+    def wait(self, timeout: Optional[float] = None):
+        from ompi_tpu.core import progress
+
+        progress.wait_until(lambda: self.completed, timeout)
+        return self.status
+
+
+class Window:
+    """MPI_Win over a local numpy buffer (Win_create semantics)."""
+
+    def __init__(self, comm, base: Optional[np.ndarray],
+                 disp_unit: int = 1) -> None:
+        self.comm = comm.dup()  # private comm: tag isolation
+        self.base = base
+        self.disp_unit = disp_unit
+        self.rank = self.comm.rank
+        self.size = self.comm.size
+        # exchange per-rank (nbytes, disp_unit) — MPI_Win_get_attr data
+        nbytes = 0 if base is None else base.nbytes
+        self.peer_info: List[Tuple[int, int]] = \
+            self.comm.coll.allgather_obj(self.comm, (nbytes, disp_unit))
+        self.attrs: Dict[str, Any] = {}
+        self.name = f"win#{self.comm.cid}"
+
+        # target-side state
+        self._lock_mode: Optional[str] = None
+        self._lock_holders: Set[int] = set()
+        self._lock_queue: List[Tuple[str, int]] = []
+        self._local_mutex = threading.Lock()
+        # origin-side state
+        self._next_id = 0
+        self._pending: Dict[int, Tuple[str, Any]] = {}  # id -> (kind, ctx)
+        self._op_counts: Dict[int, int] = {}   # target -> ops issued
+        self._ack_counts: Dict[int, int] = {}  # target -> acks seen
+        self._granted: Set[int] = set()        # targets we hold a lock on
+        self._flush_acked: Set[int] = set()
+        self._unlock_acked: Set[int] = set()
+        self._posted_from: Set[int] = set()    # PSCW: posts received
+        self._completes_from: Set[int] = set()
+        self._exposure_group: Optional[List[int]] = None
+        self._access_group: Optional[List[int]] = None
+
+        self._service_req = None
+        self._closed = False
+        from ompi_tpu.core import progress
+
+        self._progress_cb = self._progress
+        progress.register(self._progress_cb)
+        self.comm.coll.barrier(self.comm)  # creation is collective
+
+    # ------------------------------------------------------------------
+    # service plumbing
+
+    def _post_service_recv(self) -> None:
+        p = pml.current()
+        self._service_req = p.irecv_obj(self.comm, ANY_SOURCE,
+                                        _SERVICE_TAG)
+
+    def _progress(self) -> int:
+        if self._closed:
+            raise StopIteration
+        if self._service_req is None:
+            self._post_service_recv()
+        events = 0
+        # drain everything available, then re-post
+        while self._service_req.test():
+            msg = self._service_req._obj
+            src = self._service_req.status.source
+            self._post_service_recv()
+            self._handle(msg, src)
+            events += 1
+        return events
+
+    def _send(self, target: int, msg: tuple) -> None:
+        pml.current().send_obj(self.comm, msg, target, _SERVICE_TAG)
+
+    # ------------------------------------------------------------------
+    # target-side message handling
+
+    def _handle(self, msg: tuple, src: int) -> None:
+        kind = msg[0]
+        if kind == "put":
+            _, disp, data = msg
+            self._target_put(disp, data)
+            self._send(src, ("ack",))
+        elif kind == "get":
+            _, req_id, disp, count, dtstr = msg
+            flat = self._target_view(disp, count, dtstr)
+            self._send(src, ("get_reply", req_id, np.array(flat)))
+        elif kind == "acc":
+            _, disp, opname, data = msg
+            self._target_acc(disp, opname, data)
+            self._send(src, ("ack",))
+        elif kind == "get_acc":
+            _, req_id, disp, opname, data = msg
+            with self._local_mutex:
+                old = np.array(self._target_view(
+                    disp, data.size, data.dtype.str))
+                self._target_acc(disp, opname, data, locked=True)
+            self._send(src, ("get_reply", req_id, old))
+        elif kind == "fetch_op":
+            _, req_id, disp, opname, value = msg
+            with self._local_mutex:
+                old = np.array(self._target_view(
+                    disp, value.size, value.dtype.str))
+                self._target_acc(disp, opname, value, locked=True)
+            self._send(src, ("get_reply", req_id, old))
+        elif kind == "cas":
+            _, req_id, disp, compare, value = msg
+            with self._local_mutex:
+                view = self._target_view(disp, 1, value.dtype.str)
+                old = np.array(view)
+                if old[0] == compare[0]:
+                    view[0] = value[0]
+            self._send(src, ("get_reply", req_id, old))
+        elif kind == "lock_req":
+            _, mode = msg
+            self._try_grant(mode, src)
+        elif kind == "unlock_req":
+            self._release(src)
+            self._send(src, ("unlock_ack",))
+        elif kind == "flush_req":
+            # per-pair FIFO: every op src issued before this is done
+            self._send(src, ("flush_ack",))
+        elif kind == "post":
+            self._posted_from.add(src)
+        elif kind == "complete":
+            self._completes_from.add(src)
+        elif kind == "ack":
+            self._ack_counts[src] = self._ack_counts.get(src, 0) + 1
+        elif kind == "flush_ack":
+            self._flush_acked.add(src)
+        elif kind == "unlock_ack":
+            self._unlock_acked.add(src)
+        elif kind == "lock_grant":
+            self._granted.add(src)
+        elif kind == "get_reply":
+            _, req_id, data = msg
+            k, ctx = self._pending.pop(req_id)
+            buf, req = ctx
+            flat = np.asarray(buf).reshape(-1)
+            flat[:data.size] = data.astype(flat.dtype, copy=False)
+            if req is not None:
+                req.completed = True
+        else:
+            _out.verbose(1, "unknown osc message %r", kind)
+
+    def _target_view(self, disp: int, count: int, dtstr: str):
+        dt = np.dtype(dtstr)
+        start = disp * self.disp_unit
+        flat = self.base.reshape(-1).view(np.uint8)[start:]
+        return flat[:count * dt.itemsize].view(dt)
+
+    def _target_put(self, disp: int, data: np.ndarray) -> None:
+        with self._local_mutex:
+            view = self._target_view(disp, data.size, data.dtype.str)
+            view[:] = data.reshape(-1)
+
+    def _target_acc(self, disp: int, opname: str, data: np.ndarray,
+                    locked: bool = False) -> None:
+        ctx = self._local_mutex if not locked else None
+        op = op_mod.BUILTIN[opname]
+        if ctx:
+            ctx.acquire()
+        try:
+            view = self._target_view(disp, data.size, data.dtype.str)
+            if opname == "MPI_REPLACE":
+                view[:] = data.reshape(-1)
+            else:
+                view[:] = op.np_fn(data.reshape(-1), view)
+        finally:
+            if ctx:
+                ctx.release()
+
+    # lock management (reference: osc_rdma_lock.h exclusive/shared) ----
+    def _try_grant(self, mode: str, src: int) -> None:
+        grantable = (
+            self._lock_mode is None
+            or (mode == LOCK_SHARED and self._lock_mode == LOCK_SHARED))
+        if grantable:
+            self._lock_mode = mode
+            self._lock_holders.add(src)
+            self._send(src, ("lock_grant",))
+        else:
+            self._lock_queue.append((mode, src))
+
+    def _release(self, src: int) -> None:
+        self._lock_holders.discard(src)
+        if not self._lock_holders:
+            self._lock_mode = None
+            # grant queued requests (shared batch or one exclusive)
+            while self._lock_queue:
+                mode, nxt = self._lock_queue[0]
+                if self._lock_mode is None or (
+                        mode == LOCK_SHARED
+                        and self._lock_mode == LOCK_SHARED):
+                    self._lock_queue.pop(0)
+                    self._lock_mode = mode
+                    self._lock_holders.add(nxt)
+                    self._send(nxt, ("lock_grant",))
+                    if mode == LOCK_EXCLUSIVE:
+                        break
+                else:
+                    break
+
+    # ------------------------------------------------------------------
+    # origin-side API
+
+    def _count_op(self, target: int) -> None:
+        self._op_counts[target] = self._op_counts.get(target, 0) + 1
+
+    def _local_or_send(self, target: int, msg: tuple) -> None:
+        if target == self.rank:
+            self._handle(msg, self.rank)
+        else:
+            self._send(target, msg)
+
+    def Put(self, buf, target: int, disp: int = 0) -> None:
+        pvar.record("osc_put")
+        data = np.ascontiguousarray(buf)
+        self._count_op(target)
+        self._local_or_send(target, ("put", disp, data))
+
+    def Get(self, buf, target: int, disp: int = 0) -> None:
+        pvar.record("osc_get")
+        self.Rget(buf, target, disp).wait()
+
+    def Rput(self, buf, target: int, disp: int = 0) -> Request:
+        """Request completes when the put is applied at the target
+        (remote ack), stronger than MPI's local-completion minimum."""
+        self.Put(buf, target, disp)
+        want = self._op_counts.get(target, 0)
+        win = self
+
+        class _R(Request):
+            def test(s):
+                from ompi_tpu.core import progress
+
+                progress.progress()
+                s.completed = win._ack_counts.get(target, 0) >= want
+                return s.completed
+
+            def wait(s, timeout=None):
+                from ompi_tpu.core import progress
+
+                progress.wait_until(
+                    lambda: win._ack_counts.get(target, 0) >= want,
+                    timeout)
+                s.completed = True
+                return s.status
+
+        return _R()
+
+    def Rget(self, buf, target: int, disp: int = 0) -> Request:
+        req = _WinRequest(self)
+        req_id = self._alloc_id()
+        self._pending[req_id] = ("get", (buf, req))
+        self._count_op(target)
+        self._local_or_send(
+            target, ("get", req_id, disp, np.asarray(buf).size,
+                     np.asarray(buf).dtype.str))
+        return req
+
+    def Accumulate(self, buf, target: int, disp: int = 0,
+                   op: op_mod.Op = op_mod.SUM) -> None:
+        pvar.record("osc_acc")
+        data = np.ascontiguousarray(buf)
+        self._count_op(target)
+        self._local_or_send(target, ("acc", disp, op.name, data))
+
+    def Get_accumulate(self, origin, result, target: int, disp: int = 0,
+                       op: op_mod.Op = op_mod.SUM) -> None:
+        req = _WinRequest(self)
+        req_id = self._alloc_id()
+        self._pending[req_id] = ("get_acc", (result, req))
+        data = np.ascontiguousarray(origin)
+        self._count_op(target)
+        self._local_or_send(target,
+                            ("get_acc", req_id, disp, op.name, data))
+        req.wait()
+
+    def Fetch_and_op(self, value, result, target: int, disp: int = 0,
+                     op: op_mod.Op = op_mod.SUM) -> None:
+        req = _WinRequest(self)
+        req_id = self._alloc_id()
+        self._pending[req_id] = ("fetch_op", (result, req))
+        v = np.ascontiguousarray(value)
+        self._count_op(target)
+        self._local_or_send(target,
+                            ("fetch_op", req_id, disp, op.name, v))
+        req.wait()
+
+    def Compare_and_swap(self, value, compare, result, target: int,
+                         disp: int = 0) -> None:
+        req = _WinRequest(self)
+        req_id = self._alloc_id()
+        self._pending[req_id] = ("cas", (result, req))
+        self._count_op(target)
+        self._local_or_send(
+            target, ("cas", req_id, disp,
+                     np.ascontiguousarray(compare),
+                     np.ascontiguousarray(value)))
+        req.wait()
+
+    def _alloc_id(self) -> int:
+        self._next_id += 1
+        return self._next_id
+
+    # -- synchronization ------------------------------------------------
+    def Fence(self) -> None:
+        """Active-target fence: flush all, then barrier."""
+        pvar.record("osc_fence")
+        self.Flush_all()
+        self.comm.coll.barrier(self.comm)
+
+    def Lock(self, target: int, lock_type: str = LOCK_EXCLUSIVE) -> None:
+        """Self-locks flow through the same message path — the service
+        loop is the single serialization point."""
+        from ompi_tpu.core import progress
+
+        self._send(target, ("lock_req", lock_type))
+        progress.wait_until(lambda: target in self._granted)
+
+    def Unlock(self, target: int) -> None:
+        from ompi_tpu.core import progress
+
+        self._unlock_acked.discard(target)
+        self._send(target, ("unlock_req",))
+        progress.wait_until(lambda: target in self._unlock_acked)
+        self._granted.discard(target)
+
+    def Lock_all(self) -> None:
+        for t in range(self.size):
+            self.Lock(t, LOCK_SHARED)
+
+    def Unlock_all(self) -> None:
+        for t in range(self.size):
+            self.Unlock(t)
+
+    def Flush(self, target: int) -> None:
+        from ompi_tpu.core import progress
+
+        if target == self.rank:
+            return
+        self._flush_acked = getattr(self, "_flush_acked", set())
+        self._flush_acked.discard(target)
+        self._send(target, ("flush_req",))
+        progress.wait_until(lambda: target in self._flush_acked)
+
+    def Flush_all(self) -> None:
+        targets = [t for t in self._op_counts if t != self.rank]
+        for t in targets:
+            self.Flush(t)
+
+    # -- PSCW (active target, generalized) ------------------------------
+    def Post(self, group_ranks: List[int]) -> None:
+        """Expose the window to `group_ranks` (MPI_Win_post)."""
+        self._exposure_group = list(group_ranks)
+        self._completes_from.clear()
+        for r in group_ranks:
+            if r != self.rank:
+                self._send(r, ("post",))
+
+    def Start(self, group_ranks: List[int]) -> None:
+        """Begin access epoch to `group_ranks` (MPI_Win_start)."""
+        from ompi_tpu.core import progress
+
+        self._access_group = list(group_ranks)
+        need = set(r for r in group_ranks if r != self.rank)
+        progress.wait_until(lambda: need <= self._posted_from)
+        self._posted_from -= need
+
+    def Complete(self) -> None:
+        """End access epoch: flush, notify targets (MPI_Win_complete)."""
+        for r in self._access_group or []:
+            if r != self.rank:
+                self.Flush(r)
+                self._send(r, ("complete",))
+        self._access_group = None
+
+    def Wait(self) -> None:
+        """End exposure epoch (MPI_Win_wait)."""
+        from ompi_tpu.core import progress
+
+        need = set(r for r in self._exposure_group or []
+                   if r != self.rank)
+        progress.wait_until(lambda: need <= self._completes_from)
+        self._exposure_group = None
+
+    # -------------------------------------------------------------------
+    def Free(self) -> None:
+        self.comm.coll.barrier(self.comm)
+        self._closed = True
+        from ompi_tpu.core import progress
+
+        progress.unregister(self._progress_cb)
+        self.comm.free()
+
+
+def win_create(comm, base: np.ndarray, disp_unit: int = 1) -> Window:
+    """MPI_Win_create."""
+    return Window(comm, base, disp_unit)
+
+
+def win_allocate(comm, shape, dtype=np.uint8,
+                 disp_unit: Optional[int] = None) -> Window:
+    """MPI_Win_allocate."""
+    arr = np.zeros(shape, dtype)
+    du = disp_unit if disp_unit is not None else arr.dtype.itemsize
+    return Window(comm, arr, du)
