@@ -86,8 +86,13 @@ class Window:
         # origin-side state
         self._next_id = 0
         self._pending: Dict[int, Tuple[str, Any]] = {}  # id -> (kind, ctx)
-        self._op_counts: Dict[int, int] = {}   # target -> ops issued
+        self._targets: Set[int] = set()        # peers with ops outstanding
+        # put/acc only — the ops whose target replies with an 'ack';
+        # get-type ops complete via 'get_reply' and must not raise the
+        # Rput completion threshold (they would make it unreachable)
+        self._ackable_counts: Dict[int, int] = {}
         self._ack_counts: Dict[int, int] = {}  # target -> acks seen
+        self._in_progress = False
         self._granted: Set[int] = set()        # targets we hold a lock on
         self._flush_acked: Set[int] = set()
         self._unlock_acked: Set[int] = set()
@@ -115,16 +120,27 @@ class Window:
     def _progress(self) -> int:
         if self._closed:
             raise StopIteration
+        if self._in_progress:
+            # _handle may block (e.g. a reply send spinning the progress
+            # engine), which re-enters this callback; one service loop per
+            # window at a time keeps the recursion bounded.
+            return 0
         if self._service_req is None:
             self._post_service_recv()
         events = 0
-        # drain everything available, then re-post
-        while self._service_req.test():
-            msg = self._service_req._obj
-            src = self._service_req.status.source
-            self._post_service_recv()
-            self._handle(msg, src)
-            events += 1
+        self._in_progress = True
+        try:
+            # Poll .completed directly — the enclosing sweep already
+            # drives BTL/PML progress; calling test() here would re-enter
+            # progress.progress() and mutually recurse without bound.
+            while self._service_req.completed:
+                msg = self._service_req._obj
+                src = self._service_req.status.source
+                self._post_service_recv()
+                self._handle(msg, src)
+                events += 1
+        finally:
+            self._in_progress = False
         return events
 
     def _send(self, target: int, msg: tuple) -> None:
@@ -262,8 +278,11 @@ class Window:
     # ------------------------------------------------------------------
     # origin-side API
 
-    def _count_op(self, target: int) -> None:
-        self._op_counts[target] = self._op_counts.get(target, 0) + 1
+    def _count_op(self, target: int, ackable: bool = False) -> None:
+        self._targets.add(target)
+        if ackable:
+            self._ackable_counts[target] = \
+                self._ackable_counts.get(target, 0) + 1
 
     def _local_or_send(self, target: int, msg: tuple) -> None:
         if target == self.rank:
@@ -274,7 +293,7 @@ class Window:
     def Put(self, buf, target: int, disp: int = 0) -> None:
         pvar.record("osc_put")
         data = np.ascontiguousarray(buf)
-        self._count_op(target)
+        self._count_op(target, ackable=True)
         self._local_or_send(target, ("put", disp, data))
 
     def Get(self, buf, target: int, disp: int = 0) -> None:
@@ -285,7 +304,7 @@ class Window:
         """Request completes when the put is applied at the target
         (remote ack), stronger than MPI's local-completion minimum."""
         self.Put(buf, target, disp)
-        want = self._op_counts.get(target, 0)
+        want = self._ackable_counts.get(target, 0)
         win = self
 
         class _R(Request):
@@ -321,7 +340,7 @@ class Window:
                    op: op_mod.Op = op_mod.SUM) -> None:
         pvar.record("osc_acc")
         data = np.ascontiguousarray(buf)
-        self._count_op(target)
+        self._count_op(target, ackable=True)
         self._local_or_send(target, ("acc", disp, op.name, data))
 
     def Get_accumulate(self, origin, result, target: int, disp: int = 0,
@@ -404,7 +423,7 @@ class Window:
         progress.wait_until(lambda: target in self._flush_acked)
 
     def Flush_all(self) -> None:
-        targets = [t for t in self._op_counts if t != self.rank]
+        targets = [t for t in self._targets if t != self.rank]
         for t in targets:
             self.Flush(t)
 

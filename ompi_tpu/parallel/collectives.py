@@ -157,7 +157,10 @@ def reduce_scatter(x, axis: str, op=op_mod.SUM, scatter_dim: int = 0,
 
         assert scatter_dim == 0, "ring reduce_scatter: dim 0 only"
         return ring.ring_reduce_scatter(x, axis, combine_fn(op))
-    if op.name == "MPI_SUM":
+    # the native fast path is compiler-scheduled reduction order, so it
+    # is only valid when no determinism was requested ('linear' must go
+    # through the rank-order fold below to keep its bit-identical promise)
+    if deterministic is None and op.name == "MPI_SUM":
         return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
                                 tiled=tiled)
     # no native lowering: allreduce then slice own chunk (same shape

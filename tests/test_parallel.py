@@ -115,6 +115,24 @@ def test_reduce_scatter_ring(comm):
         np.testing.assert_allclose(out[r, 0], total[r:r + 1], rtol=1e-6)
 
 
+def test_reduce_scatter_linear_bit_identical(comm):
+    """Regression (advisor medium): deterministic='linear' must NOT
+    fall through to psum_scatter — it must be bit-identical to the
+    rank-order fold + slice."""
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((N, N * 3)).astype(np.float32) * 1e3
+    out = shards(
+        comm,
+        lambda a: comm.Reduce_scatter_block(
+            a[0, 0], deterministic="linear")[None, None],
+        x[:, None, :])
+    acc = x[0].copy()
+    for i in range(1, N):
+        acc = acc + x[i]
+    for r in range(N):
+        np.testing.assert_array_equal(out[r, 0], acc[r * 3:(r + 1) * 3])
+
+
 def test_allgather(comm):
     x = np.arange(N * 2, dtype=np.float32).reshape(N, 2)
     out = shards(comm, lambda a: comm.Allgather(a), x,
