@@ -4,9 +4,12 @@ Reference: ompi/mca/coll/ — coll.h:532-649 (the per-comm function table),
 coll_base_comm_select.c:236-330 (all enabled components stacked in
 ascending priority, each overriding the slots it implements; disqualify on
 priority<0). Components here: ``basic`` (linear reference algorithms),
-``tuned`` (decision rules over the base algorithm library), ``xla``
-(device-plane collectives on TPU-resident buffers), ``self``
-(COMM_SELF trivial).
+``tuned`` (decision rules over the base algorithm library), ``libnbc``
+(nonblocking schedules), ``accelerator`` (device-buffer staging
+fallback), ``xla`` (device-executed collectives over the
+multi-controller device plane — the north star). COMM_SELF/size-1 comms
+are served by basic's linear paths and xla's local fast path (no
+separate ``self`` component needed).
 
 Collective p2p traffic runs in the communicator's collective context
 (cid*2+1) with a per-comm monotonically increasing operation tag, so user
@@ -107,7 +110,7 @@ def comm_select(comm) -> None:
 
 def _register_builtin() -> None:
     from ompi_tpu.coll import (  # noqa: F401
-        accelerator, basic, libnbc, tuned,
+        accelerator, basic, libnbc, tuned, xla,
     )
 
 

@@ -45,7 +45,10 @@ def _stage_out(host, like):
     return acc_current().to_device(host, like=like)
 
 
-def allreduce_dev(comm, sendbuf, op=op_mod.SUM):
+def allreduce_dev(comm, sendbuf, op=op_mod.SUM, deterministic=None):
+    # `deterministic` accepted for slot-signature parity with coll/xla;
+    # the host path folds in whatever order the selected host algorithm
+    # uses (basic's linear fold is already rank-ordered)
     pvar.record("coll_accelerator_staged")
     host = _stage_in(sendbuf)
     recv = np.empty_like(host)
@@ -60,7 +63,7 @@ def bcast_dev(comm, buf, root=0):
     return _stage_out(host, buf)
 
 
-def reduce_dev(comm, sendbuf, op=op_mod.SUM, root=0):
+def reduce_dev(comm, sendbuf, op=op_mod.SUM, root=0, deterministic=None):
     pvar.record("coll_accelerator_staged")
     host = _stage_in(sendbuf)
     recv = np.empty_like(host)
@@ -91,7 +94,8 @@ def alltoall_dev(comm, sendbuf):
     return _stage_out(recv, sendbuf)
 
 
-def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM):
+def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
+                             deterministic=None):
     pvar.record("coll_accelerator_staged")
     host = _stage_in(sendbuf)
     n = comm.size

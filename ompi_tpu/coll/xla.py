@@ -1,0 +1,323 @@
+"""coll/xla — device-executed collectives on MPI communicators.
+
+THE north-star component (SURVEY.md §2.3/§2.8, BASELINE.md config #1):
+replaces the reference's coll/accelerator staging design
+(ompi/mca/coll/accelerator/coll_accelerator_allreduce.c:32-115 — D2H,
+host collective, H2D) with collectives that *never leave the device*.
+
+How: the communicator's group maps onto the multi-controller device
+plane (:mod:`ompi_tpu.runtime.device_plane` — one device per rank,
+bootstrapped like the accelerator modex in
+opal/mca/accelerator/accelerator.h:668-711). Per communicator we build a
+1-D mesh over the member devices ordered by comm rank; each collective
+compiles once per (kind, shape, dtype, op, mode) into an XLA program via
+``shard_map`` — psum/all_gather/all_to_all lower to ICI transfers on TPU
+and gloo on the CPU test backend. Compiled programs are cached on the
+communicator exactly as the reference caches per-comm algorithm
+schedules (coll_base_comm_select.c:236-330 stacking).
+
+Determinism contract (BASELINE.md "bit-identical vs basic"):
+``deterministic='linear'`` folds contributions in exact rank order —
+bit-identical to coll/basic's linear reduce (coll_basic_reduce.c
+semantics); ``deterministic='ring'`` fixes a ring chunk order that is
+stable run-to-run. Default lets XLA schedule (fastest).
+
+Fallback: any buffer/op the device path cannot express (e.g. MINLOC
+struct dtypes) falls through to the coll/accelerator staging functions —
+the same slot signature, one priority level down.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu import op as op_mod
+from ompi_tpu.coll import CollModule, accelerator as staging, framework
+from ompi_tpu.core import cvar, output, pvar
+
+_out = output.stream("coll_xla")
+
+AXIS = "mpi"  # the mesh axis name a communicator compiles to
+
+_default_det = cvar.register(
+    "coll_xla_deterministic", "", str,
+    help="default determinism mode for device collectives: '' (XLA "
+         "schedules, fastest), 'ring' (fixed ring chunk order), "
+         "'linear' (exact rank-order fold, bit-identical to coll/basic)",
+    choices=["", "ring", "linear"], level=4)
+
+#: ops whose reduction is expressible as a traced elementwise fold
+_TRACEABLE_OPS = {
+    "MPI_SUM", "MPI_PROD", "MPI_MIN", "MPI_MAX", "MPI_LAND", "MPI_LOR",
+    "MPI_LXOR", "MPI_BAND", "MPI_BOR", "MPI_BXOR",
+}
+
+
+def _det(deterministic: Optional[str]) -> Optional[str]:
+    if deterministic is not None:
+        return deterministic or None
+    return _default_det.get() or None
+
+
+class _Ctx:
+    """Per-communicator compiled-collective state (the analog of the
+    reference's per-comm coll module data)."""
+
+    def __init__(self, comm) -> None:
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from ompi_tpu.runtime import device_plane
+
+        self.jax = jax
+        self.P = P
+        devs = [device_plane.device_for_world_rank(w)
+                for w in comm.group.ranks]
+        self.mesh = Mesh(np.array(devs), (AXIS,))
+        self.my = device_plane.my_device()
+        self.n = len(devs)
+        self.in_sharding = NamedSharding(self.mesh, P(AXIS))
+        self.fns = {}  # (kind, shape, dtype, ...) -> compiled callable
+
+    def replica_groups(self):
+        """Device-id groups this comm's collectives compile to
+        (introspection parity with DeviceCommunicator.replica_groups)."""
+        return [[d.id for d in self.mesh.devices.tolist()]]
+
+    # -- plumbing ---------------------------------------------------------
+    def to_global(self, x):
+        """Local device array -> global array sharded (n, *shape) on
+        AXIS (rank r's contribution at index r)."""
+        jax = self.jax
+        x = jax.device_put(x, self.my)
+        return jax.make_array_from_single_device_arrays(
+            (self.n,) + x.shape, self.in_sharding, [x[None]])
+
+    def my_shard(self, out):
+        """This rank's shard of an AXIS-sharded result."""
+        return out.addressable_data(0)
+
+    def compiled(self, key, build):
+        fn = self.fns.get(key)
+        if fn is None:
+            fn = self.fns[key] = build()
+        return fn
+
+    def smap(self, body, out_varying: bool):
+        """jit(shard_map(body)) over the comm mesh. Body sees the local
+        (1, *shape) block; out_varying selects P(AXIS) vs replicated."""
+        jax, P = self.jax, self.P
+        out_spec = P(AXIS) if out_varying else P()
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh, in_specs=P(AXIS), out_specs=out_spec,
+            check_vma=False))
+
+
+def _ctx(comm) -> _Ctx:
+    ctx = getattr(comm, "_coll_xla_ctx", None)
+    if ctx is None:
+        ctx = comm._coll_xla_ctx = _Ctx(comm)
+    return ctx
+
+
+def _key(x, *extra):
+    return (x.shape, str(x.dtype)) + extra
+
+
+def _op_ok(op) -> bool:
+    op = op_mod.BUILTIN.get(op) if not isinstance(op, op_mod.Op) else op
+    if op is None:
+        return False
+    if op.name in _TRACEABLE_OPS:
+        return True
+    # user-defined ops run on device iff marked jax-traceable
+    return bool(getattr(op, "traceable", False))
+
+
+# ---------------------------------------------------------------------------
+# slots — signatures match coll/accelerator's *_dev (the fallback)
+
+
+def allreduce_dev(comm, sendbuf, op=op_mod.SUM,
+                  deterministic: Optional[str] = None):
+    det = _det(deterministic)
+    if not _op_ok(op):
+        return staging.allreduce_dev(comm, sendbuf, op)
+    pvar.record("coll_xla_device")
+    if comm.size == 1:
+        return sendbuf
+    from ompi_tpu.parallel import collectives as C
+
+    ctx = _ctx(comm)
+    opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
+
+    def build():
+        return ctx.smap(lambda a: C.allreduce(a[0], AXIS, opn, det),
+                        out_varying=False)
+
+    fn = ctx.compiled(_key(sendbuf, "allreduce", opn.name, det), build)
+    return ctx.my_shard(fn(ctx.to_global(sendbuf)))
+
+
+def reduce_dev(comm, sendbuf, op=op_mod.SUM, root: int = 0,
+               deterministic: Optional[str] = None):
+    if not _op_ok(op):
+        return staging.reduce_dev(comm, sendbuf, op, root)
+    # SPMD: every device computes the full reduction (free on-device;
+    # avoids a divergent program) — shares allreduce's compiled program
+    # and cache entry; only the root returns the result
+    out = allreduce_dev(comm, sendbuf, op, deterministic)
+    return out if comm.rank == root else None
+
+
+def bcast_dev(comm, buf, root: int = 0):
+    pvar.record("coll_xla_device")
+    if comm.size == 1:
+        return buf
+    ctx = _ctx(comm)
+
+    def build():
+        return ctx.smap(_bcast_body(root), out_varying=False)
+
+    fn = ctx.compiled(_key(buf, "bcast", root), build)
+    return ctx.my_shard(fn(ctx.to_global(buf)))
+
+
+def _bcast_body(root: int):
+    from ompi_tpu.parallel import collectives as C
+
+    return lambda a: C.bcast(a[0], AXIS, root)
+
+
+def allgather_dev(comm, sendbuf):
+    pvar.record("coll_xla_device")
+    ctx_free = comm.size == 1
+    if ctx_free:
+        return sendbuf[None] if hasattr(sendbuf, "shape") else sendbuf
+    from jax import lax
+
+    ctx = _ctx(comm)
+
+    def build():
+        return ctx.smap(lambda a: lax.all_gather(a[0], AXIS),
+                        out_varying=False)
+
+    fn = ctx.compiled(_key(sendbuf, "allgather"), build)
+    return ctx.my_shard(fn(ctx.to_global(sendbuf)))
+
+
+def gather_dev(comm, sendbuf, root: int = 0):
+    out = allgather_dev(comm, sendbuf)
+    return out if comm.rank == root else None
+
+
+def alltoall_dev(comm, sendbuf):
+    pvar.record("coll_xla_device")
+    if comm.size == 1:
+        return sendbuf
+    if sendbuf.shape[0] % comm.size:
+        raise ValueError(
+            f"alltoall: dim0 {sendbuf.shape[0]} not divisible by "
+            f"comm size {comm.size}")
+    from ompi_tpu.parallel import collectives as C
+
+    ctx = _ctx(comm)
+
+    def build():
+        return ctx.smap(lambda a: C.alltoall(a[0], AXIS, 0, 0),
+                        out_varying=True)
+
+    fn = ctx.compiled(_key(sendbuf, "alltoall"), build)
+    return ctx.my_shard(fn(ctx.to_global(sendbuf)))
+
+
+def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
+                             deterministic: Optional[str] = None):
+    det = _det(deterministic)
+    if not _op_ok(op):
+        return staging.reduce_scatter_block_dev(comm, sendbuf, op)
+    pvar.record("coll_xla_device")
+    if comm.size == 1:
+        return sendbuf
+    if sendbuf.shape[0] % comm.size:
+        raise ValueError(
+            f"reduce_scatter_block: dim0 {sendbuf.shape[0]} not "
+            f"divisible by comm size {comm.size}")
+    from ompi_tpu.parallel import collectives as C
+
+    ctx = _ctx(comm)
+    opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
+
+    def build():
+        return ctx.smap(
+            lambda a: C.reduce_scatter(a[0], AXIS, opn, scatter_dim=0,
+                                       tiled=True, deterministic=det),
+            out_varying=True)
+
+    fn = ctx.compiled(_key(sendbuf, "rsb", opn.name, det), build)
+    return ctx.my_shard(fn(ctx.to_global(sendbuf)))
+
+
+def scatter_dev(comm, sendbuf, root: int = 0):
+    pvar.record("coll_xla_device")
+    if comm.size == 1:
+        return sendbuf
+    # non-roots pass no buffer but SPMD needs same-shape operands on
+    # every device: one host metadata round ships (shape, dtype), then
+    # the data moves on-device (bcast-from-root + slice)
+    if comm.rank == root:
+        meta = (tuple(sendbuf.shape), str(sendbuf.dtype))
+        comm.coll.bcast_obj(comm, meta, root)
+        x = sendbuf
+    else:
+        shape, dtype = comm.coll.bcast_obj(comm, None, root)
+        import jax.numpy as jnp
+
+        ctx0 = _ctx(comm)
+        x = ctx0.jax.device_put(jnp.zeros(shape, dtype), ctx0.my)
+    if x.shape[0] % comm.size:
+        raise ValueError(
+            f"scatter: dim0 {x.shape[0]} not divisible by comm size "
+            f"{comm.size}")
+    from ompi_tpu.parallel import collectives as C
+
+    ctx = _ctx(comm)
+
+    def build():
+        return ctx.smap(lambda a: C.scatter(a[0], AXIS, root, 0),
+                        out_varying=True)
+
+    fn = ctx.compiled(_key(x, "scatter", root), build)
+    return ctx.my_shard(fn(ctx.to_global(x)))
+
+
+@framework.register
+class CollXla(CollModule):
+    NAME = "xla"
+    PRIORITY = 50  # above accelerator(40): device buffers stay on device
+
+    def query(self, comm) -> int:
+        if comm.size == 1:
+            return self.PRIORITY  # trivial local path, no plane needed
+        from ompi_tpu.runtime import device_plane
+
+        if not device_plane.active():
+            return -1
+        if any(device_plane.device_for_world_rank(w) is None
+               for w in comm.group.ranks):
+            return -1
+        return self.PRIORITY
+
+    def slots(self, comm):
+        return {
+            "allreduce_dev": allreduce_dev,
+            "reduce_dev": reduce_dev,
+            "bcast_dev": bcast_dev,
+            "allgather_dev": allgather_dev,
+            "gather_dev": gather_dev,
+            "alltoall_dev": alltoall_dev,
+            "reduce_scatter_block_dev": reduce_scatter_block_dev,
+            "scatter_dev": scatter_dev,
+        }

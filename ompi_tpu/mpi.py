@@ -302,10 +302,12 @@ def _Bcast(self, buf, root: int = 0):
     self.coll.bcast(self, arr, count, dt, root)
 
 
-def _Reduce(self, sendbuf, recvbuf=None, op=op_mod.SUM, root: int = 0):
+def _Reduce(self, sendbuf, recvbuf=None, op=op_mod.SUM, root: int = 0,
+            deterministic=None):
     self.check_revoked()
     if _is_dev(sendbuf):
-        return self.coll.reduce_dev(self, sendbuf, op, root)
+        return self.coll.reduce_dev(self, sendbuf, op, root,
+                                    deterministic=deterministic)
     sarr, count, dt = _parse_buf(sendbuf) if sendbuf is not IN_PLACE \
         else (IN_PLACE, None, None)
     rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
@@ -314,10 +316,15 @@ def _Reduce(self, sendbuf, recvbuf=None, op=op_mod.SUM, root: int = 0):
     self.coll.reduce(self, sarr, rarr, count, dt, op, root)
 
 
-def _Allreduce(self, sendbuf, recvbuf=None, op=op_mod.SUM):
+def _Allreduce(self, sendbuf, recvbuf=None, op=op_mod.SUM,
+               deterministic=None):
+    """deterministic (device buffers only): None lets XLA schedule the
+    reduction; 'ring'/'linear' fix the operand order (coll/xla) —
+    'linear' is bit-identical to the host linear fold."""
     self.check_revoked()
     if _is_dev(sendbuf):
-        return self.coll.allreduce_dev(self, sendbuf, op)
+        return self.coll.allreduce_dev(self, sendbuf, op,
+                                       deterministic=deterministic)
     if sendbuf is IN_PLACE:
         rarr, count, dt = _parse_buf(recvbuf)
         self.coll.allreduce(self, IN_PLACE, rarr, count, dt, op)
@@ -412,10 +419,12 @@ def _Alltoallv(self, sendbuf, recvbuf, scounts, rcounts,
                         rdispls, dtype_of(sarr))
 
 
-def _Reduce_scatter_block(self, sendbuf, recvbuf=None, op=op_mod.SUM):
+def _Reduce_scatter_block(self, sendbuf, recvbuf=None, op=op_mod.SUM,
+                          deterministic=None):
     self.check_revoked()
     if _is_dev(sendbuf):
-        return self.coll.reduce_scatter_block_dev(self, sendbuf, op)
+        return self.coll.reduce_scatter_block_dev(
+            self, sendbuf, op, deterministic=deterministic)
     rarr, count, dt = _parse_buf(recvbuf)
     sarr = _parse_buf(sendbuf)[0]
     self.coll.reduce_scatter_block(self, sarr, rarr, count, dt, op)

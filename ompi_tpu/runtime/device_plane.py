@@ -1,0 +1,190 @@
+"""Device plane bootstrap — multi-controller jax over the store.
+
+Reference analog: the reference's one-process-per-GPU model where NCCL
+communicators are bootstrapped through PMIx modex
+(ompi/runtime/ompi_rte.c:580 proc naming;
+opal/mca/btl/tcp/btl_tcp_component.c:1191-1240 endpoint exchange). The
+TPU-first equivalent is **multi-controller jax**: every MPI rank runs
+``jax.distributed.initialize`` against a coordinator brokered through
+the kv store, after which ``jax.devices()`` spans all ranks' chips and
+XLA collectives (psum/all_gather/...) execute directly over ICI/DCN —
+this is what :mod:`ompi_tpu.coll.xla` compiles communicator collectives
+onto.
+
+Deployment modes (cvar ``device_plane_platform``):
+
+- ``cpu`` (default): ranks use the virtual CPU backend with gloo
+  cross-process collectives — the single-host test/dev configuration
+  (and the CI stand-in for a pod).
+- ``tpu``: one rank per chip on a real pod/slice; jax's native TPU
+  bootstrap handles device assignment, we only broker the coordinator.
+
+The plane is opt-in (cvar ``device_plane=on``, e.g. ``tpurun --mca
+device_plane on``): initialization is collective over the world and
+pulls jax into every rank, which pure host-MPI jobs shouldn't pay for.
+Activation is agreed through the modex so every rank sees the same
+answer — a rank-divergent coll table would deadlock.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, Optional
+
+from ompi_tpu.core import cvar, output
+from ompi_tpu.runtime import rte
+
+_out = output.stream("device_plane")
+
+_enabled = cvar.register(
+    "device_plane", "off", str,
+    help="multi-controller device plane: 'on' initializes "
+         "jax.distributed across all ranks at MPI_Init so device-buffer "
+         "collectives execute on device (coll/xla); 'off' leaves device "
+         "buffers to the staging path (coll/accelerator)",
+    choices=["on", "off"], level=3)
+
+_platform = cvar.register(
+    "device_plane_platform", "cpu", str,
+    help="rank device platform: 'cpu' = virtual CPU devices with gloo "
+         "collectives (single-host/test), 'tpu' = one rank per real chip "
+         "(pod deployment, native ICI collectives)",
+    choices=["cpu", "tpu"], level=3)
+
+_timeout = cvar.register(
+    "device_plane_timeout", 60, int,
+    help="seconds to wait for jax.distributed bootstrap before a rank "
+         "reports failure (the modex agreement then disables the plane "
+         "job-wide instead of hanging MPI_Init)", level=6)
+
+_lock = threading.Lock()
+_state: Optional[dict] = None  # {"devices": {world_rank: Device}, "my": Device}
+
+_FAILED = "FAILED"  # coordinator-key sentinel: rank 0 could not bootstrap
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _my_ip() -> str:
+    """This host's address as reachable by peers: the outbound interface
+    toward the store (multi-host pods must not get loopback)."""
+    store = rte.client().addr if hasattr(rte.client(), "addr") else None
+    host = store[0] if store else "127.0.0.1"
+    if host in ("127.0.0.1", "localhost", ""):
+        return "127.0.0.1"
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((host, 1))
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
+def requested() -> bool:
+    return _enabled.get() == "on"
+
+
+def active() -> bool:
+    return _state is not None
+
+
+def my_device():
+    assert _state is not None
+    return _state["my"]
+
+
+def device_for_world_rank(world_rank: int):
+    """The device owned by a world rank (None if that rank has none)."""
+    if _state is None:
+        return None
+    return _state["devices"].get(world_rank)
+
+
+def init_plane() -> bool:
+    """Collective over the world job: bring up jax.distributed and
+    exchange the rank->device map. Returns True when every rank
+    succeeded (agreement via modex so the coll/xla qualification is
+    globally consistent)."""
+    global _state
+    with _lock:
+        if _state is not None:
+            return True
+        ok = True
+        dev_id = None
+        jax = None
+        try:
+            import jax
+
+            if _platform.get() == "cpu":
+                # config-level override: the host image's TPU plugin
+                # force-selects itself over JAX_PLATFORMS env alone
+                jax.config.update("jax_platforms", "cpu")
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+        except Exception as exc:  # noqa: BLE001 — must reach agreement
+            _out.verbose(1, "device plane: jax setup failed on rank "
+                         "%d: %s", rte.rank, exc)
+            ok = False
+        if rte.size > 1:
+            key = f"devplane:{rte.jobid}:coord"
+            if rte.rank == 0:
+                # publish BEFORE any blocking work: peers wait on this
+                # key, so rank 0 must never fail without writing it
+                # (a missing key would deadlock the whole job)
+                try:
+                    coord = f"{_my_ip()}:{_free_port()}" if ok else _FAILED
+                except Exception:  # noqa: BLE001
+                    coord = _FAILED
+                rte.client().put(key, coord)
+            else:
+                coord = rte.client().get(key, wait=True)
+            if coord == _FAILED:
+                ok = False
+            if ok:
+                try:
+                    jax.distributed.initialize(
+                        coordinator_address=coord,
+                        num_processes=rte.size, process_id=rte.rank,
+                        initialization_timeout=_timeout.get())
+                except Exception as exc:  # noqa: BLE001
+                    _out.verbose(1, "device plane bootstrap failed on "
+                                 "rank %d: %s", rte.rank, exc)
+                    ok = False
+        if ok:
+            try:
+                dev_id = jax.local_devices()[0].id
+            except Exception as exc:  # noqa: BLE001
+                _out.verbose(1, "device plane: no local device on rank "
+                             "%d: %s", rte.rank, exc)
+                ok = False
+        rte.modex_send("devplane", {"ok": ok, "device_id": dev_id})
+        rte.fence("devplane")
+        peers: Dict[int, dict] = {
+            r: rte.modex_recv("devplane", r) for r in range(rte.size)}
+        if not all(p and p.get("ok") for p in peers.values()):
+            bad = [r for r, p in peers.items() if not (p and p.get("ok"))]
+            _out.verbose(1, "device plane disabled: rank(s) %s failed "
+                         "init", bad)
+            return False
+        import jax
+
+        by_id = {d.id: d for d in jax.devices()}
+        try:
+            devices = {r: by_id[p["device_id"]] for r, p in peers.items()}
+        except KeyError as missing:
+            _out.verbose(1, "device plane disabled: device %s not in "
+                         "global set", missing)
+            return False
+        _state = {"devices": devices, "my": devices[rte.rank]}
+        _out.verbose(2, "device plane up: %d global device(s), mine=%s",
+                     len(by_id), _state["my"])
+        return True

@@ -55,6 +55,14 @@ def init(thread_level: int = 0):
         from ompi_tpu.accelerator import current as _accel_current
         _accel_current()
 
+        # multi-controller device plane (opt-in; collective over the
+        # world, must precede comm construction so coll/xla can qualify
+        # during COMM_WORLD's coll table selection)
+        from ompi_tpu.runtime import device_plane
+
+        if device_plane.requested():
+            device_plane.init_plane()
+
         from ompi_tpu import pml
         from ompi_tpu.comm import build_world
 

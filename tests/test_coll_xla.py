@@ -1,0 +1,210 @@
+"""coll/xla — device-executed collectives over the multi-controller
+device plane (the north-star component).
+
+Ranks run on the virtual CPU backend with gloo cross-process
+collectives (cvar device_plane_platform=cpu) — the CI stand-in for a
+pod; on real multi-chip hardware the same code lowers to ICI.
+"""
+
+import pytest
+
+from tests.harness import run_ranks
+
+MCA = {"device_plane": "on"}
+
+
+def test_allreduce_device_no_staging():
+    run_ranks("""
+    import jax
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    x = jnp.arange(64, dtype=jnp.float32) + rank
+    r = comm.Allreduce(x)
+    assert isinstance(r, jax.Array), type(r)
+    exp = size * np.arange(64, dtype=np.float32) + sum(range(size))
+    np.testing.assert_array_equal(np.asarray(r), exp)
+    # the whole point: the device path never staged through the host
+    assert pvar.read("coll_accelerator_staged") == 0
+    assert pvar.read("coll_xla_device") >= 1
+    assert comm.coll.providers["allreduce_dev"] == "xla"
+    """, 4, mca=MCA)
+
+
+def test_allreduce_ops_and_dtypes():
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import op as op_mod
+    for dt in (jnp.float32, jnp.float64, jnp.int32):
+        x = (jnp.arange(8) % 5 + rank + 1).astype(dt)
+        h = np.asarray(x)
+        for op, npf in ((op_mod.SUM, np.add), (op_mod.MAX, np.maximum),
+                        (op_mod.MIN, np.minimum), (op_mod.PROD, np.multiply)):
+            r = np.asarray(comm.Allreduce(x, op=op))
+            exp = h.copy()
+            for k in range(1, size):
+                peer = (np.arange(8) % 5 + ((rank + k) % size) + 1).astype(h.dtype)
+            # recompute exactly: contributions of every rank
+            conts = [(np.arange(8) % 5 + rr + 1).astype(h.dtype)
+                     for rr in range(size)]
+            exp = conts[0]
+            for c in conts[1:]:
+                exp = npf(exp, c)
+            np.testing.assert_array_equal(r, exp)
+    """, 3, mca=MCA)
+
+
+def test_allreduce_linear_bit_identical_to_basic():
+    """deterministic='linear' must match coll/basic's host rank-order
+    fold bit-for-bit (BASELINE.md config #1 contract)."""
+    run_ranks("""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(7)
+    h = (rng.standard_normal(257) * (10.0 ** rng.integers(-3, 4, 257))
+         ).astype(np.float32)
+    h = np.roll(h, rank)  # distinct per rank
+    x = jnp.asarray(h)
+    dev = np.asarray(comm.Allreduce(x, deterministic="linear"))
+    # host reference: coll/basic linear fold (rank-order, same adds)
+    host = np.empty_like(h)
+    comm.Allreduce(h, host)
+    assert comm.coll.providers["allreduce"] == "basic"
+    np.testing.assert_array_equal(dev, host)  # bitwise
+    """, 4, mca={**MCA, "coll": "basic,accelerator,xla,libnbc"})
+
+
+def test_allreduce_ring_deterministic():
+    """'ring' mode: stable run-to-run (same schedule recompiled) and
+    numerically correct."""
+    run_ranks("""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(rank)
+    h = rng.standard_normal(64 * size).astype(np.float32)
+    x = jnp.asarray(h)
+    r1 = np.asarray(comm.Allreduce(x, deterministic="ring"))
+    r2 = np.asarray(comm.Allreduce(x, deterministic="ring"))
+    np.testing.assert_array_equal(r1, r2)
+    allh = comm.allgather(h)
+    np.testing.assert_allclose(r1, np.sum(allh, axis=0), rtol=1e-5)
+    """, 4, mca=MCA)
+
+
+def test_bcast_reduce_device():
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    x = jnp.full((16,), float(rank), jnp.float32)
+    b = np.asarray(comm.Bcast(x, root=2))
+    np.testing.assert_array_equal(b, np.full(16, 2.0, np.float32))
+    r = comm.Reduce(x, root=1)
+    if rank == 1:
+        np.testing.assert_array_equal(
+            np.asarray(r), np.full(16, sum(range(size)), np.float32))
+    else:
+        assert r is None
+    assert pvar.read("coll_accelerator_staged") == 0
+    """, 4, mca=MCA)
+
+
+def test_allgather_alltoall_device():
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    x = jnp.arange(4, dtype=jnp.int32) + 10 * rank
+    g = np.asarray(comm.Allgather(x))
+    exp = np.stack([np.arange(4, dtype=np.int32) + 10 * r
+                    for r in range(size)])
+    np.testing.assert_array_equal(g, exp)
+
+    a = jnp.arange(size * 3, dtype=jnp.float32) + 100 * rank
+    t = np.asarray(comm.Alltoall(a))
+    exp = np.concatenate([np.arange(3, dtype=np.float32) + 3 * rank
+                          + 100 * r for r in range(size)])
+    np.testing.assert_array_equal(t, exp)
+    assert pvar.read("coll_accelerator_staged") == 0
+    """, 4, mca=MCA)
+
+
+def test_reduce_scatter_scatter_gather_device():
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    x = jnp.arange(size * 2, dtype=jnp.float32) + rank
+    rs = np.asarray(comm.Reduce_scatter_block(x))
+    full = size * np.arange(size * 2, dtype=np.float32) + sum(range(size))
+    np.testing.assert_array_equal(rs, full[rank * 2:(rank + 1) * 2])
+
+    if rank == 0:
+        s = jnp.arange(size * 3, dtype=jnp.float32)
+        mine = comm.Scatter(s, root=0)
+    else:
+        mine = comm.Scatter(None, root=0, device=True)
+    np.testing.assert_array_equal(
+        np.asarray(mine), np.arange(3, dtype=np.float32) + 3 * rank)
+
+    g = comm.Gather(jnp.full((2,), float(rank)), root=0)
+    if rank == 0:
+        np.testing.assert_array_equal(
+            np.asarray(g), np.arange(size, dtype=np.float32)[:, None]
+            * np.ones(2, np.float32))
+    else:
+        assert g is None
+    assert pvar.read("coll_accelerator_staged") == 0
+    """, 3, mca=MCA)
+
+
+def test_subset_comm_device():
+    """A split communicator (subset of world) compiles onto a sub-mesh."""
+    run_ranks("""
+    import jax.numpy as jnp
+    sub = comm.split(color=rank % 2, key=rank)
+    x = jnp.full((8,), float(rank), jnp.float32)
+    r = np.asarray(sub.Allreduce(x))
+    peers = [r2 for r2 in range(size) if r2 % 2 == rank % 2]
+    np.testing.assert_array_equal(r, np.full(8, float(sum(peers))))
+    assert sub.coll.providers["allreduce_dev"] == "xla"
+    """, 4, mca=MCA)
+
+
+def test_plane_off_falls_back_to_staging():
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    x = jnp.arange(8, dtype=jnp.float32) + rank
+    r = np.asarray(comm.Allreduce(x))
+    exp = size * np.arange(8, dtype=np.float32) + sum(range(size))
+    np.testing.assert_array_equal(r, exp)
+    assert comm.coll.providers["allreduce_dev"] == "accelerator"
+    assert pvar.read("coll_accelerator_staged") >= 1
+    """, 2)
+
+
+def test_singleton_size1_local_fast_path():
+    """size-1 comms (COMM_SELF, singleton world) take the local path with
+    no plane and no staging."""
+    import subprocess
+    import sys
+
+    code = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from ompi_tpu import mpi
+comm = mpi.Init()
+from ompi_tpu.core import pvar
+x = jnp.arange(8, dtype=jnp.float32)
+r = comm.Allreduce(x)
+np.testing.assert_array_equal(np.asarray(r), np.asarray(x))
+assert comm.coll.providers["allreduce_dev"] == "xla"
+assert pvar.read("coll_accelerator_staged") == 0
+g = mpi.COMM_SELF.Allgather(x)
+assert g.shape == (1, 8)
+mpi.Finalize()
+print("OK")
+"""
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
