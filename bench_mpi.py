@@ -1,0 +1,170 @@
+"""MPI-plane microbenchmarks — BASELINE.md configs #2-#5.
+
+Measures the process-plane collectives (sm/tcp BTLs + coll stack) and,
+when the device plane is up, the coll/xla device path side by side:
+
+  #2  Bcast    f32 1MB, 8 iters              (host + device)
+  #3  Allreduce MPI_SUM f32, 1KB..4MB sweep  (host + device)
+  #4  Reduce_scatter_block + Allgather ring decomposition
+  #5  Alltoall int32 (MoE expert-dispatch pattern)
+  p2p large-message bandwidth (rendezvous path); the active rndv
+  pipeline-depth cvar is reported alongside once the pml registers it
+
+Self-launching: run ``python bench_mpi.py [-n 4]`` — it re-execs itself
+under the launcher; rank 0 prints one JSON object. CI keeps sizes small
+(single-core host); the methodology follows the reference's
+docs/tuning-apps/benchmarking.rst:1-92 (barrier, timed loop, max over
+ranks).
+
+Results are committed to BENCH_MPI.json and referenced from BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _timed(comm, fn, iters: int) -> float:
+    """max-over-ranks seconds per op (reference methodology)."""
+    fn()  # warm (compile/connect)
+    comm.Barrier()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    dt = (time.perf_counter() - t0) / iters
+    return comm.allreduce(dt, op=max)
+
+
+def _rank_main() -> None:
+    import numpy as np
+
+    from ompi_tpu import mpi
+
+    comm = mpi.Init()
+    rank, size = comm.rank, comm.size
+    results = {}
+
+    try:
+        import jax.numpy as jnp
+
+        from ompi_tpu.runtime import device_plane
+
+        dev_ok = device_plane.active()
+    except Exception:
+        dev_ok = False
+
+    # -- #2 Bcast 1MB f32 --------------------------------------------------
+    n = (1 << 20) // 4
+    buf = np.zeros(n, np.float32)
+    if rank == 0:
+        buf[:] = np.arange(n, dtype=np.float32)
+    t = _timed(comm, lambda: comm.Bcast(buf, root=0), 8)
+    results["bcast_1MB_host"] = {"s_per_op": t, "GBs": buf.nbytes / t / 1e9}
+    if dev_ok:
+        dbuf = jnp.asarray(buf)
+        t = _timed(comm, lambda: comm.Bcast(dbuf, root=0), 8)
+        results["bcast_1MB_dev"] = {"s_per_op": t,
+                                    "GBs": buf.nbytes / t / 1e9}
+
+    # -- #3 Allreduce sweep ------------------------------------------------
+    for nbytes in (1 << 10, 32 << 10, 1 << 20, 4 << 20):
+        n = nbytes // 4
+        s = np.full(n, float(rank + 1), np.float32)
+        r = np.empty_like(s)
+        t = _timed(comm, lambda: comm.Allreduce(s, r), 8)
+        results[f"allreduce_{nbytes}B_host"] = {
+            "s_per_op": t, "GBs": nbytes / t / 1e9}
+        if dev_ok:
+            ds = jnp.asarray(s)
+            t = _timed(comm, lambda: comm.Allreduce(ds), 8)
+            results[f"allreduce_{nbytes}B_dev"] = {
+                "s_per_op": t, "GBs": nbytes / t / 1e9}
+
+    # -- #4 reduce_scatter_block + allgather (ring decomposition) ---------
+    n = (1 << 20) // 4 // size * size
+    s = np.full(n, float(rank + 1), np.float32)
+    chunk = np.empty(n // size, np.float32)
+    gat = np.empty(n, np.float32)
+
+    def ring_allreduce():
+        comm.Reduce_scatter_block(s, chunk)
+        comm.Allgather(chunk, gat)
+
+    t = _timed(comm, ring_allreduce, 8)
+    results["redscat_allgather_1MB_host"] = {
+        "s_per_op": t, "GBs": s.nbytes / t / 1e9}
+    if dev_ok:
+        ds = jnp.asarray(s)
+
+        def ring_allreduce_dev():
+            c = comm.Reduce_scatter_block(ds)
+            comm.Allgather(c)
+
+        t = _timed(comm, ring_allreduce_dev, 8)
+        results["redscat_allgather_1MB_dev"] = {
+            "s_per_op": t, "GBs": s.nbytes / t / 1e9}
+
+    # -- #5 Alltoall int32 (MoE dispatch pattern) -------------------------
+    n = (256 << 10) // 4 // size * size
+    s = (np.arange(n, dtype=np.int32) + rank)
+    r = np.empty_like(s)
+    t = _timed(comm, lambda: comm.Alltoall(s, r), 8)
+    results["alltoall_256KB_host"] = {"s_per_op": t,
+                                      "GBs": s.nbytes / t / 1e9}
+    if dev_ok:
+        ds = jnp.asarray(s)
+        t = _timed(comm, lambda: comm.Alltoall(ds), 8)
+        results["alltoall_256KB_dev"] = {"s_per_op": t,
+                                         "GBs": s.nbytes / t / 1e9}
+
+    # -- p2p rendezvous bandwidth (pipeline depth effect) -----------------
+    nbytes = 8 << 20
+    big = np.ones(nbytes, np.uint8)
+    rbuf = np.empty_like(big)
+    if size >= 2:
+        def pingpong():
+            if rank == 0:
+                comm.Send(big, dest=1, tag=9)
+                comm.Recv(rbuf, source=1, tag=9)
+            elif rank == 1:
+                comm.Recv(rbuf, source=0, tag=9)
+                comm.Send(big, dest=0, tag=9)
+            comm.Barrier()
+
+        t = _timed(comm, pingpong, 4)
+        results["p2p_rndv_8MB_pingpong"] = {
+            "s_per_op": t, "GBs": 2 * nbytes / t / 1e9}
+
+    if rank == 0:
+        from ompi_tpu.core import cvar
+
+        print(json.dumps({
+            "bench": "mpi_microbench",
+            "ranks": size,
+            "device_plane": dev_ok,
+            "rndv_pipeline_depth": cvar.get("pml_ob1_send_pipeline_depth",
+                                            None),
+            "results": {k: {kk: round(vv, 6) for kk, vv in v.items()}
+                        for k, v in results.items()},
+        }))
+    mpi.Finalize()
+
+
+def main() -> int:
+    from ompi_tpu.runtime import launcher, rte
+
+    if rte.is_launched():
+        _rank_main()
+        return 0
+    n = 4
+    if "-n" in sys.argv:
+        n = int(sys.argv[sys.argv.index("-n") + 1])
+    mca = {"device_plane": "on"}
+    return launcher.launch([sys.executable, __file__], n, mca=mca,
+                           timeout=600)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -240,7 +240,12 @@ def forward_local(params, tokens, cfg: Config, ax: Axes):
 
     h = _ln(h.astype(jnp.float32), params["ln_f"]["g"],
             params["ln_f"]["b"])
-    return h @ params["embed"].T  # weight-tied head, f32 logits
+    # weight-tied head: bf16 operands at full MXU rate, f32 accumulation
+    # (the vocab matmul is the single largest matmul in the model; an
+    # f32xf32 product here runs at half the systolic-array throughput)
+    return jnp.einsum("btd,vd->btv", h.astype(dt),
+                      params["embed"].astype(dt),
+                      preferred_element_type=jnp.float32)
 
 
 def _moe_dense(flat, lp, cfg: Config):
