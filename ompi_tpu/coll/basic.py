@@ -38,7 +38,7 @@ class CollBasic(CollModule):
         return self.PRIORITY
 
     def slots(self, comm):
-        return {
+        slots = {
             "barrier": barrier_linear,
             "bcast": bcast_linear,
             "reduce": reduce_linear,
@@ -63,6 +63,14 @@ class CollBasic(CollModule):
             "alltoall_obj": alltoall_obj,
             "allreduce_obj": allreduce_obj,
         }
+        # neighborhood slots exist only on topology communicators
+        # (reference: installed at topo comm creation,
+        # ompi/mca/coll/coll.h:600-618); _attach re-selects the table
+        # after setting comm.topo
+        if getattr(comm, "topo", None) is not None:
+            slots["neighbor_allgather"] = neighbor_allgather_linear
+            slots["neighbor_alltoall"] = neighbor_alltoall_linear
+        return slots
 
 
 # -- p2p building blocks (always collective context) ----------------------
@@ -399,3 +407,69 @@ def allreduce_obj(comm, obj, fn):
     for v in vals[1:]:
         acc = fn(acc, v)
     return acc
+
+
+# -- neighborhood collectives (topology comms only) -----------------------
+#
+# Reference: ompi/mca/coll/basic neighbor_allgather/alltoall — linear
+# isend/irecv over the topology's neighbor lists in MPI-standard order.
+# Cartesian degenerate case (periodic dim of size 2: both directions hit
+# the same rank) is disambiguated with per-edge conjugate tags: the
+# sender tags with its out-slot, the receiver matches its in-slot j
+# against the sender's conjugate slot (j ^ 1 — the (d,-1) in-edge is the
+# peer's (d,+1) out-edge).
+
+def _nbr_tags(comm, topo):
+    base = _tag(comm)
+    if getattr(topo, "kind", None) == "cart":
+        send_tag = lambda slot: (base + 1 + slot) & 0x3FFFFFFF
+        recv_tag = lambda slot: (base + 1 + (slot ^ 1)) & 0x3FFFFFFF
+    else:
+        # graph/dist_graph: duplicate edges match in posted order
+        # (FIFO per (ctx, src, tag) — the standard's behavior)
+        send_tag = recv_tag = lambda slot: base
+    return send_tag, recv_tag
+
+
+def neighbor_allgather_linear(comm, sendbuf, recvbuf, count, dtype):
+    from ompi_tpu.pml.request import PROC_NULL
+
+    pvar.record("neighbor_allgather")
+    topo = comm.topo
+    ins = topo.in_neighbors(comm.rank)
+    outs = topo.out_neighbors(comm.rank)
+    send_tag, recv_tag = _nbr_tags(comm, topo)
+    sb = np.asarray(sendbuf)
+    # zero-degree ranks are legal (receive-only/send-only dist graphs)
+    rb = np.asarray(recvbuf).reshape(len(ins), -1) if ins else None
+    rreqs = [q for q in (
+        _irecv(comm, rb[i], count, dtype, src, recv_tag(i))
+        for i, src in enumerate(ins) if src != PROC_NULL)]
+    sreqs = [_isend(comm, sb, count, dtype, dst, send_tag(i))
+             for i, dst in enumerate(outs) if dst != PROC_NULL]
+    for q in rreqs:
+        q.wait()
+    for q in sreqs:
+        q.wait()
+
+
+def neighbor_alltoall_linear(comm, sendbuf, recvbuf, count, dtype):
+    from ompi_tpu.pml.request import PROC_NULL
+
+    pvar.record("neighbor_alltoall")
+    topo = comm.topo
+    ins = topo.in_neighbors(comm.rank)
+    outs = topo.out_neighbors(comm.rank)
+    send_tag, recv_tag = _nbr_tags(comm, topo)
+    # zero-degree ranks are legal (receive-only/send-only dist graphs)
+    sb = np.asarray(sendbuf).reshape(len(outs), -1) if outs else None
+    rb = np.asarray(recvbuf).reshape(len(ins), -1) if ins else None
+    rreqs = [q for q in (
+        _irecv(comm, rb[i], count, dtype, src, recv_tag(i))
+        for i, src in enumerate(ins) if src != PROC_NULL)]
+    sreqs = [_isend(comm, sb[i], count, dtype, dst, send_tag(i))
+             for i, dst in enumerate(outs) if dst != PROC_NULL]
+    for q in rreqs:
+        q.wait()
+    for q in sreqs:
+        q.wait()

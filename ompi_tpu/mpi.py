@@ -589,6 +589,10 @@ _API = {
 for _name, _fn in _API.items():
     setattr(Communicator, _name, _fn)
 
+# topology API (Create_cart/Cart_sub/Neighbor_*) attaches its own
+# Communicator methods at import (ompi/mca/topo equivalent)
+from ompi_tpu import topo as _topo  # noqa: E402,F401
+
 
 # ---------------------------------------------------------------------------
 # module-level state: COMM_WORLD / COMM_SELF / init / finalize

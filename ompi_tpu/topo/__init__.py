@@ -1,0 +1,323 @@
+"""Process topologies — cartesian, graph, distributed graph.
+
+Reference: ompi/mca/topo/ — topo_base_cart_create.c:1 (cart construction
++ optional reorder), topo_base_cart_sub.c (sub-grids), base graph/dist
+graph bookkeeping, and the neighborhood collective slots they unlock
+(ompi/mca/coll/coll.h:600-618, implemented linearly in coll/basic).
+
+TPU-first bridge: a cartesian communicator is the host-plane face of a
+device mesh — ``Cart_sub`` keeps a subset of dims exactly as
+``DeviceCommunicator.sub`` keeps a subset of mesh axes
+(parallel/device_comm.py). ``cart_of_mesh``/``replica_groups`` make the
+correspondence testable: the groups Cart_sub produces equal the XLA
+replica_groups of the matching mesh axes.
+
+Neighbor ordering follows the MPI standard: cartesian neighbor lists are
+(-1, +1) per dimension in dimension order; graph lists use the stored
+adjacency order. PROC_NULL neighbors (open boundaries) contribute
+nothing and their recv slots are left untouched.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ompi_tpu.comm import Communicator, UNDEFINED
+from ompi_tpu.pml.request import PROC_NULL
+
+
+def dims_create(nnodes: int, ndims: int,
+                dims: Optional[Sequence[int]] = None) -> List[int]:
+    """MPI_Dims_create: balanced factorization of nnodes over ndims
+    (reference: ompi/mpi/c/dims_create.c). Nonzero entries in `dims`
+    are fixed constraints."""
+    out = list(dims) if dims is not None else [0] * ndims
+    fixed = math.prod(d for d in out if d > 0) or 1
+    if nnodes % fixed:
+        raise ValueError(
+            f"Dims_create: {nnodes} not divisible by fixed dims {out}")
+    rem = nnodes // fixed
+    free = [i for i, d in enumerate(out) if d == 0]
+    # greedy balanced: repeatedly give the largest prime factor to the
+    # currently-smallest free dim
+    factors: List[int] = []
+    n, p = rem, 2
+    while p * p <= n:
+        while n % p == 0:
+            factors.append(p)
+            n //= p
+        p += 1
+    if n > 1:
+        factors.append(n)
+    sizes = {i: 1 for i in free}
+    for f in sorted(factors, reverse=True):
+        tgt = min(free, key=lambda i: sizes[i]) if free else None
+        if tgt is None:
+            break
+        sizes[tgt] *= f
+    for i in free:
+        out[i] = sizes[i]
+    # MPI orders free dims non-increasing
+    vals = sorted((out[i] for i in free), reverse=True)
+    for i, v in zip(free, vals):
+        out[i] = v
+    return out
+
+
+class CartTopo:
+    """Cartesian topology attachment (comm.topo)."""
+
+    kind = "cart"
+
+    def __init__(self, dims: Sequence[int], periods: Sequence[bool]):
+        self.dims = tuple(int(d) for d in dims)
+        self.periods = tuple(bool(p) for p in periods)
+        if len(self.dims) != len(self.periods):
+            raise ValueError("dims/periods length mismatch")
+        self.size = math.prod(self.dims) if self.dims else 1
+
+    @property
+    def ndims(self) -> int:
+        return len(self.dims)
+
+    def coords(self, rank: int) -> List[int]:
+        """MPI_Cart_coords (row-major, like the reference)."""
+        c = []
+        for d in reversed(self.dims):
+            c.append(rank % d)
+            rank //= d
+        return list(reversed(c))
+
+    def rank_of(self, coords: Sequence[int]) -> int:
+        """MPI_Cart_rank (periodic dims wrap; open dims out-of-range ->
+        PROC_NULL)."""
+        r = 0
+        for c, d, per in zip(coords, self.dims, self.periods):
+            if not 0 <= c < d:
+                if not per:
+                    return PROC_NULL
+                c %= d
+            r = r * d + c
+        return r
+
+    def shift(self, rank: int, direction: int,
+              disp: int = 1) -> Tuple[int, int]:
+        """MPI_Cart_shift -> (source, dest)."""
+        c = self.coords(rank)
+        src = list(c)
+        dst = list(c)
+        src[direction] -= disp
+        dst[direction] += disp
+        return self.rank_of(src), self.rank_of(dst)
+
+    def neighbors(self, rank: int) -> List[int]:
+        """MPI-standard cart neighbor order: per dim, (-1, +1)."""
+        out = []
+        for d in range(self.ndims):
+            src, dst = self.shift(rank, d, 1)
+            out.extend((src, dst))
+        return out
+
+    in_neighbors = neighbors
+    out_neighbors = neighbors
+
+
+class GraphTopo:
+    """MPI_Graph_create topology (index/edges arrays)."""
+
+    kind = "graph"
+
+    def __init__(self, index: Sequence[int], edges: Sequence[int]):
+        self.index = tuple(index)
+        self.edges = tuple(edges)
+        self.size = len(self.index)
+
+    def neighbors(self, rank: int) -> List[int]:
+        lo = self.index[rank - 1] if rank > 0 else 0
+        return list(self.edges[lo:self.index[rank]])
+
+    in_neighbors = neighbors
+    out_neighbors = neighbors
+
+
+class DistGraphTopo:
+    """MPI_Dist_graph_create_adjacent topology (directed, per-rank)."""
+
+    kind = "dist_graph"
+
+    def __init__(self, sources: Sequence[int],
+                 destinations: Sequence[int]):
+        self.sources = tuple(sources)
+        self.destinations = tuple(destinations)
+
+    def in_neighbors(self, rank: int) -> List[int]:
+        return list(self.sources)
+
+    def out_neighbors(self, rank: int) -> List[int]:
+        return list(self.destinations)
+
+
+# ---------------------------------------------------------------------------
+# Communicator construction (attached as methods below)
+
+
+def _attach(comm: Communicator, topo) -> Communicator:
+    comm.topo = topo
+    # re-stack the coll table: components may install neighborhood
+    # slots only when a topology is present (reference re-selects at
+    # topo comm creation, topo_base_cart_create.c end)
+    from ompi_tpu.coll import comm_select
+
+    comm_select(comm)
+    return comm
+
+
+def _Create_cart(self, dims: Sequence[int],
+                 periods: Optional[Sequence[bool]] = None,
+                 reorder: bool = False) -> Optional[Communicator]:
+    """MPI_Cart_create. reorder is accepted and ignored (rank order is
+    already arbitrary under the launcher; the reference's reorder is a
+    hint too)."""
+    dims = list(dims)
+    periods = [False] * len(dims) if periods is None else list(periods)
+    n = math.prod(dims) if dims else 1
+    if n > self.size:
+        raise ValueError(f"cart size {n} exceeds comm size {self.size}")
+    color = 0 if self.rank < n else UNDEFINED
+    sub = self.split(color, key=self.rank)
+    if sub is None:
+        return None
+    return _attach(sub, CartTopo(dims, periods))
+
+
+def _Cart_sub(self, remain_dims: Sequence[bool]) -> Communicator:
+    """MPI_Cart_sub: split into sub-grids keeping `remain_dims`.
+
+    Device-plane analog: DeviceCommunicator.sub(axis_subset) — the
+    retained dims are the mesh axes of the sub-communicator."""
+    topo: CartTopo = self.topo
+    if topo is None or topo.kind != "cart":
+        raise ValueError("Cart_sub on a non-cartesian communicator")
+    remain = [bool(r) for r in remain_dims]
+    coords = topo.coords(self.rank)
+    # color = coordinates of the dropped dims; key = row-major rank of
+    # the kept dims (so sub-rank order matches the reference)
+    color = 0
+    for c, d, keep in zip(coords, topo.dims, remain):
+        if not keep:
+            color = color * d + c
+    sub = self.split(color, key=self.rank)
+    kept_dims = [d for d, keep in zip(topo.dims, remain) if keep]
+    kept_per = [p for p, keep in zip(topo.periods, remain) if keep]
+    return _attach(sub, CartTopo(kept_dims, kept_per))
+
+
+def _Cart_coords(self, rank: Optional[int] = None) -> List[int]:
+    return self.topo.coords(self.rank if rank is None else rank)
+
+
+def _Cart_rank(self, coords: Sequence[int]) -> int:
+    return self.topo.rank_of(coords)
+
+
+def _Cart_shift(self, direction: int, disp: int = 1) -> Tuple[int, int]:
+    return self.topo.shift(self.rank, direction, disp)
+
+
+def _Cart_get(self):
+    t: CartTopo = self.topo
+    return list(t.dims), list(t.periods), t.coords(self.rank)
+
+
+def _Create_graph(self, index: Sequence[int], edges: Sequence[int],
+                  reorder: bool = False) -> Optional[Communicator]:
+    """MPI_Graph_create (index/edges across all ranks, as the standard
+    defines)."""
+    n = len(index)
+    if n > self.size:
+        raise ValueError(f"graph size {n} exceeds comm size {self.size}")
+    color = 0 if self.rank < n else UNDEFINED
+    sub = self.split(color, key=self.rank)
+    if sub is None:
+        return None
+    return _attach(sub, GraphTopo(index, edges))
+
+
+def _Create_dist_graph_adjacent(
+        self, sources: Sequence[int], destinations: Sequence[int],
+        reorder: bool = False) -> Communicator:
+    """MPI_Dist_graph_create_adjacent: every rank supplies its own
+    in/out neighbor lists; no redistribution needed."""
+    sub = self.split(0, key=self.rank)
+    return _attach(sub, DistGraphTopo(sources, destinations))
+
+
+def _Graph_neighbors(self, rank: Optional[int] = None) -> List[int]:
+    return self.topo.neighbors(self.rank if rank is None else rank)
+
+
+def _Dist_graph_neighbors(self):
+    t = self.topo
+    return t.in_neighbors(self.rank), t.out_neighbors(self.rank)
+
+
+# -- neighborhood collectives (dispatch into the coll table) --------------
+
+def _Neighbor_allgather(self, sendbuf, recvbuf):
+    self.check_revoked()
+    from ompi_tpu.mpi import _parse_buf
+
+    sarr, count, dt = _parse_buf(sendbuf)
+    rarr = _parse_buf(recvbuf)[0]
+    self.coll.neighbor_allgather(self, sarr, rarr, count, dt)
+
+
+def _Neighbor_alltoall(self, sendbuf, recvbuf):
+    self.check_revoked()
+    from ompi_tpu.mpi import _parse_buf
+
+    sarr, _, dt = _parse_buf(sendbuf)
+    rarr = _parse_buf(recvbuf)[0]
+    # per-edge count: derive from whichever side has edges (a
+    # receive-only rank's sendbuf is empty and must not zero the count)
+    n_out = len(self.topo.out_neighbors(self.rank))
+    n_in = len(self.topo.in_neighbors(self.rank))
+    if n_out:
+        count = np.asarray(sarr).size // n_out
+    elif n_in:
+        count = np.asarray(rarr).size // n_in
+    else:
+        count = 0
+    self.coll.neighbor_alltoall(self, sarr, rarr, count, dt)
+
+
+_API = {
+    "Create_cart": _Create_cart,
+    "Cart_sub": _Cart_sub,
+    "Cart_coords": _Cart_coords,
+    "Cart_rank": _Cart_rank,
+    "Cart_shift": _Cart_shift,
+    "Cart_get": _Cart_get,
+    "Create_graph": _Create_graph,
+    "Create_dist_graph_adjacent": _Create_dist_graph_adjacent,
+    "Graph_neighbors": _Graph_neighbors,
+    "Dist_graph_neighbors": _Dist_graph_neighbors,
+    "Neighbor_allgather": _Neighbor_allgather,
+    "Neighbor_alltoall": _Neighbor_alltoall,
+}
+
+for _name, _fn in _API.items():
+    setattr(Communicator, _name, _fn)
+
+
+def cart_of_mesh(mesh, axis_order: Optional[Sequence[str]] = None):
+    """The (dims, axis_names) a device mesh corresponds to — for
+    asserting Cart_sub <-> DeviceCommunicator.sub equivalence (the
+    host-plane cart of an SPMD mesh has one dim per mesh axis, same
+    order, no periodicity)."""
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    names = list(axis_order or mesh.axis_names)
+    return [shape[n] for n in names], names

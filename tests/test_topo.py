@@ -1,0 +1,194 @@
+"""Topologies: cart/graph/dist_graph, neighborhood collectives, and the
+cart <-> device-mesh equivalence (Cart_sub == DeviceCommunicator.sub)."""
+
+import numpy as np
+import pytest
+
+from tests.harness import run_ranks
+
+
+def test_dims_create():
+    from ompi_tpu.topo import dims_create
+
+    assert sorted(dims_create(12, 2), reverse=True) == [4, 3]
+    assert dims_create(8, 3) == [2, 2, 2]
+    assert dims_create(6, 2, [3, 0]) == [3, 2]
+    with pytest.raises(ValueError):
+        dims_create(7, 2, [2, 0])
+
+
+def test_cart_coords_rank_shift_local():
+    from ompi_tpu.pml.request import PROC_NULL
+    from ompi_tpu.topo import CartTopo
+
+    t = CartTopo((2, 3), (False, True))
+    assert t.coords(0) == [0, 0]
+    assert t.coords(5) == [1, 2]
+    assert t.rank_of([1, 2]) == 5
+    # periodic dim wraps, open dim nulls
+    assert t.rank_of([0, 3]) == t.rank_of([0, 0])
+    assert t.rank_of([2, 0]) == PROC_NULL
+    src, dst = t.shift(0, direction=1, disp=1)  # along periodic dim
+    assert (src, dst) == (t.rank_of([0, 2]), t.rank_of([0, 1]))
+    src, dst = t.shift(0, direction=0, disp=1)  # open dim edges
+    assert src == PROC_NULL and dst == t.rank_of([1, 0])
+
+
+def test_cart_halo_exchange():
+    """1-D periodic ring halo exchange via Cart_shift + Sendrecv."""
+    run_ranks("""
+    cart = comm.Create_cart([size], periods=[True])
+    src, dst = cart.Cart_shift(0, 1)
+    me = np.full(4, float(rank), np.float32)
+    left = np.empty(4, np.float32)
+    cart.Sendrecv(me, dest=dst, recvbuf=left, source=src)
+    assert left[0] == float((rank - 1) % size), left
+    """, 4)
+
+
+def test_cart_sub_rows_cols():
+    run_ranks("""
+    from ompi_tpu.topo import dims_create
+    dims = dims_create(size, 2)
+    cart = comm.Create_cart(dims, periods=[False, False])
+    coords = cart.Cart_coords()
+    row = cart.Cart_sub([False, True])   # keep dim1: row comms
+    col = cart.Cart_sub([True, False])   # keep dim0: col comms
+    assert row.size == dims[1] and col.size == dims[0]
+    assert row.rank == coords[1] and col.rank == coords[0]
+    assert row.topo.dims == (dims[1],)
+    # row-wise allreduce sums my row only
+    v = np.array([float(rank)], np.float32)
+    out = np.empty(1, np.float32)
+    row.Allreduce(v, out)
+    expect = sum(cart.Cart_rank([coords[0], j]) for j in range(dims[1]))
+    assert out[0] == float(expect), (out, expect)
+    """, 4)
+
+
+def test_neighbor_allgather_cart():
+    run_ranks("""
+    cart = comm.Create_cart([size], periods=[True])
+    send = np.full(2, float(rank), np.float32)
+    recv = np.zeros((2, 2), np.float32)  # 2 neighbors x count 2
+    cart.Neighbor_allgather(send, recv)
+    left, right = (rank - 1) % size, (rank + 1) % size
+    np.testing.assert_array_equal(recv[0], np.full(2, float(left)))
+    np.testing.assert_array_equal(recv[1], np.full(2, float(right)))
+    """, 4)
+
+
+def test_neighbor_allgather_open_boundary():
+    """Non-periodic edges: PROC_NULL neighbors leave recv slots as-is."""
+    run_ranks("""
+    cart = comm.Create_cart([size], periods=[False])
+    send = np.full(1, float(rank), np.float32)
+    recv = np.full((2, 1), -1.0, np.float32)
+    cart.Neighbor_allgather(send, recv)
+    if rank > 0:
+        assert recv[0, 0] == float(rank - 1)
+    else:
+        assert recv[0, 0] == -1.0  # untouched
+    if rank < size - 1:
+        assert recv[1, 0] == float(rank + 1)
+    else:
+        assert recv[1, 0] == -1.0
+    """, 3)
+
+
+def test_neighbor_alltoall_cart_size2_degenerate():
+    """Periodic size-2 dim: both directions are the same rank — the
+    conjugate-tag pairing must still deliver direction-correct chunks."""
+    run_ranks("""
+    cart = comm.Create_cart([2], periods=[True])
+    # chunk 0 goes to my left neighbor, chunk 1 to my right
+    send = np.array([10.0 * rank + 1, 10.0 * rank + 2], np.float32)
+    recv = np.zeros(2, np.float32)
+    cart.Neighbor_alltoall(send, recv)
+    peer = 1 - rank
+    # my slot 0 (from left=peer) gets peer's to-right chunk (index 1);
+    # my slot 1 (from right=peer) gets peer's to-left chunk (index 0)
+    np.testing.assert_array_equal(
+        recv, np.array([10.0 * peer + 2, 10.0 * peer + 1], np.float32))
+    """, 2)
+
+
+def test_dist_graph_neighbor_alltoall():
+    run_ranks("""
+    # directed ring: receive from left, send to right
+    left, right = (rank - 1) % size, (rank + 1) % size
+    g = comm.Create_dist_graph_adjacent(sources=[left],
+                                        destinations=[right])
+    ins, outs = g.Dist_graph_neighbors()
+    assert ins == [left] and outs == [right]
+    send = np.full(3, float(rank), np.float32)
+    recv = np.empty(3, np.float32)
+    g.Neighbor_alltoall(send, recv)
+    np.testing.assert_array_equal(recv, np.full(3, float(left)))
+    """, 3)
+
+
+def test_dist_graph_zero_degree():
+    """Receive-only / send-only ranks (legal adjacent dist graphs)."""
+    run_ranks("""
+    if rank == 0:
+        g = comm.Create_dist_graph_adjacent(sources=[1], destinations=[])
+        recv = np.empty(3, np.float32)
+        g.Neighbor_alltoall(np.empty(0, np.float32), recv)
+        np.testing.assert_array_equal(recv, np.full(3, 7.0, np.float32))
+    else:
+        g = comm.Create_dist_graph_adjacent(sources=[], destinations=[0])
+        g.Neighbor_alltoall(np.full(3, 7.0, np.float32),
+                            np.empty(0, np.float32))
+    """, 2)
+
+
+def test_graph_create_neighbors():
+    run_ranks("""
+    # star graph: 0 <-> everyone (index/edges per MPI_Graph_create)
+    others = [r for r in range(size) if r != 0]
+    index, edges = [], []
+    for r in range(size):
+        nbrs = others if r == 0 else [0]
+        edges.extend(nbrs)
+        index.append(len(edges))
+    g = comm.Create_graph(index, edges)
+    nbrs = g.Graph_neighbors()
+    assert nbrs == (others if rank == 0 else [0])
+    send = np.full(1, float(rank), np.float32)
+    recv = np.zeros((len(nbrs), 1), np.float32)
+    g.Neighbor_allgather(send, recv)
+    np.testing.assert_array_equal(
+        recv[:, 0], np.array([float(n) for n in nbrs], np.float32))
+    """, 3)
+
+
+def test_cart_matches_device_mesh_groups():
+    """Cart_sub grouping == XLA replica_groups of the matching mesh
+    axes: the host topology and device mesh are one concept."""
+    import jax
+
+    from ompi_tpu.parallel import make_mesh
+    from ompi_tpu.parallel.device_comm import DeviceCommunicator
+    from ompi_tpu.topo import CartTopo, cart_of_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    mesh = make_mesh(("a", "b"), (2, 2))
+    dims, names = cart_of_mesh(mesh)
+    assert dims == [2, 2]
+    topo = CartTopo(dims, [False] * len(dims))
+    # groups along axis "b" (keep dim 1) == rows of the device grid
+    dc = DeviceCommunicator(mesh, "a").sub("b")
+    groups_mesh = dc.replica_groups()
+    n = mesh.devices.size
+    by_color = {}
+    for r in range(n):
+        c = topo.coords(r)
+        by_color.setdefault(c[0], []).append(r)
+    groups_cart = [sorted(v) for _, v in sorted(by_color.items())]
+    flat_ids = {d.id: i for i, d in
+                enumerate(mesh.devices.reshape(-1).tolist())}
+    groups_mesh_pos = [sorted(flat_ids[i] for i in g)
+                       for g in groups_mesh]
+    assert groups_mesh_pos == groups_cart
