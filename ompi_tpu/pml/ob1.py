@@ -12,7 +12,15 @@ Wire format (little-endian structs + raw convertor payload):
               [payload (eager only)]
   ACK:        <B type><Q msgid><Q recv_id>
   FRAG:       <B type><Q recv_id><Q offset>[payload]
+  FRAG_ACK:   <B type><Q msgid><Q bytes_received>
 ctx = cid*2 + (0 p2p | 1 collective); src is the sender's ctx-comm rank.
+
+RNDV flow control: the sender keeps at most ``pml_ob1_send_pipeline_depth``
+fragments un-acknowledged (reference: mca_pml_ob1.send_pipeline_depth,
+pml_ob1_component.c:207-208); the receiver FRAG_ACKs each fragment, which
+both paces GB-scale messages (bounded userspace queueing on tcp, bounded
+ring occupancy on sm) and overlaps the sender's pack with the receiver's
+unpack.
 """
 
 from __future__ import annotations
@@ -37,6 +45,7 @@ HDR_MATCH = 1
 HDR_RNDV = 2
 HDR_ACK = 3
 HDR_FRAG = 4
+HDR_FRAG_ACK = 5
 
 FLAG_SYNC = 1  # ssend: sender wants a match ack
 FLAG_OBJ = 2   # payload is a pickled python object
@@ -44,16 +53,35 @@ FLAG_OBJ = 2   # payload is a pickled python object
 _MATCH = struct.Struct("<BIiiQQBQ")
 _ACK = struct.Struct("<BQQ")
 _FRAG = struct.Struct("<BQQ")
+_FRAGACK = struct.Struct("<BQQ")
 
 _out = output.stream("pml_ob1")
 _msg_ids = itertools.count(1)
+
+from ompi_tpu.core import cvar as _cvar  # noqa: E402
+
+_pipeline_depth = _cvar.register(
+    "pml_ob1_send_pipeline_depth", 4, int,
+    help="min un-acknowledged RNDV fragments in flight per message "
+         "(reference default 3-4); bounds transport queueing and "
+         "overlaps sender pack with receiver unpack", level=4)
+
+_send_window = _cvar.register(
+    "pml_ob1_send_window_bytes", 1 << 20, int,
+    help="RNDV un-acked window floor in bytes: our FRAG_ACKs are "
+         "end-to-end (the reference's depth counts local BTL "
+         "completions), so the window must cover the ack round-trip "
+         "bandwidth-delay product or throughput collapses on "
+         "small-fragment BTLs; effective window = "
+         "max(depth * frag_size, this)", level=4)
 
 #: "no object" sentinel — None is a perfectly valid object to send
 NO_OBJ = object()
 
 
 class SendRequest(rq.Request):
-    __slots__ = ("conv", "dst_world", "ctx", "msgid")
+    __slots__ = ("conv", "dst_world", "ctx", "msgid", "recv_id",
+                 "acked_bytes", "pumping")
 
     def __init__(self) -> None:
         super().__init__()
@@ -61,11 +89,15 @@ class SendRequest(rq.Request):
         self.dst_world = -1
         self.ctx = 0
         self.msgid = 0
+        self.recv_id = 0       # RNDV: receiver's stream id
+        self.acked_bytes = 0   # RNDV: FRAG_ACK high-water mark
+        self.pumping = False   # re-entrancy guard (see _pump)
 
 
 class RecvRequest(rq.Request):
     __slots__ = ("ctx", "want_src", "want_tag", "buf", "count", "dtype",
-                 "conv", "total", "is_obj", "recv_id", "matched")
+                 "conv", "total", "is_obj", "recv_id", "matched",
+                 "src_world", "src_msgid")
 
     def __init__(self, ctx: int, src: int, tag: int, buf, count, dtype,
                  is_obj: bool) -> None:
@@ -81,6 +113,8 @@ class RecvRequest(rq.Request):
         self.is_obj = is_obj
         self.recv_id = 0
         self.matched = False
+        self.src_world = -1   # RNDV: where FRAG_ACKs go
+        self.src_msgid = 0    # RNDV: the sender request they address
 
     def _cancel(self) -> None:
         if not self.matched and not self.completed:
@@ -126,6 +160,7 @@ class Ob1:
         # in-flight protocol state
         self.pending_ack: Dict[int, SendRequest] = {}   # msgid -> req
         self.active_recv: Dict[int, RecvRequest] = {}   # recv_id -> req
+        self.streaming: Dict[int, SendRequest] = {}     # msgid -> rndv tx
         self._recv_ids = itertools.count(1)
         # frames for communicators this rank has not constructed yet
         # (a peer can finish comm creation and send before we do —
@@ -363,6 +398,9 @@ class Ob1:
         elif t == HDR_FRAG:
             _, recv_id, offset = _FRAG.unpack_from(data, 0)
             self._on_frag(recv_id, offset, data[_FRAG.size:])
+        elif t == HDR_FRAG_ACK:
+            _, msgid, nbytes = _FRAGACK.unpack_from(data, 0)
+            self._on_frag_ack(msgid, nbytes)
         else:
             _out.error("unknown frame type %d", t)
 
@@ -442,6 +480,8 @@ class Ob1:
             self._finish_recv(req)
         else:  # RNDV: allocate recv id, ack, wait for frags
             req.recv_id = next(self._recv_ids)
+            req.src_world = src_world
+            req.src_msgid = msgid
             self.active_recv[req.recv_id] = req
             ack = _ACK.pack(HDR_ACK, msgid, req.recv_id)
             self.bml.endpoint(src_world).send(src_world, ack)
@@ -461,15 +501,51 @@ class Ob1:
         if recv_id == 0:  # eager ssend ack
             req.complete()
             return
-        conv = req.conv
-        frag_size = self._frag_size(req.dst_world)
-        ep = self.bml.endpoint(req.dst_world)
-        while not conv.done:
-            offset = conv.position
-            data = conv.pack(max_bytes=frag_size)
-            ep.send(req.dst_world,
-                    _FRAG.pack(HDR_FRAG, recv_id, offset) + data)
-        req.complete()
+        req.recv_id = recv_id
+        self.streaming[msgid] = req
+        self._pump(req)
+
+    def _pump(self, req: SendRequest) -> None:
+        """Send fragments while the un-acked window has room
+        (reference: mca_pml_ob1_send_request_schedule with
+        send_pipeline_depth). Completion = all bytes handed to the BTL
+        (the send buffer is then reusable — MPI completion semantics);
+        FRAG_ACKs only pace the stream."""
+        # re-entrancy guard: ep.send can spin the progress engine when a
+        # transport is full, delivering a FRAG_ACK that re-enters _pump
+        # for this very request — the nested pump would enqueue a LATER
+        # fragment before the outer one, reordering the stream. The
+        # nested call just updates acked_bytes (in _on_frag_ack) and
+        # returns; the outer loop re-reads the window each iteration.
+        if req.pumping:
+            return
+        req.pumping = True
+        try:
+            conv = req.conv
+            frag_size = self._frag_size(req.dst_world)
+            window = max(max(1, _pipeline_depth.get()) * frag_size,
+                         _send_window.get())
+            ep = self.bml.endpoint(req.dst_world)
+            while not conv.done \
+                    and conv.position - req.acked_bytes < window:
+                offset = conv.position
+                data = conv.pack(max_bytes=frag_size)
+                pvar.record("rndv_frag")
+                ep.send(req.dst_world,
+                        _FRAG.pack(HDR_FRAG, req.recv_id, offset) + data)
+        finally:
+            req.pumping = False
+        if conv.done and not req.completed:
+            self.streaming.pop(req.msgid, None)
+            req.complete()
+
+    def _on_frag_ack(self, msgid: int, nbytes: int) -> None:
+        req = self.streaming.get(msgid)
+        if req is None:
+            return  # stream already fully sent — stale ack, fine
+        if nbytes > req.acked_bytes:
+            req.acked_bytes = nbytes
+        self._pump(req)
 
     def _on_frag(self, recv_id: int, offset: int, data: bytes) -> None:
         req = self.active_recv.get(recv_id)
@@ -485,8 +561,12 @@ class Ob1:
             assert offset == req.conv.position, \
                 f"frag offset {offset} != conv position {req.conv.position}"
             req.conv.unpack(data)
-        # completion when the sender's full size has streamed past us
+        # credit the sender's window (every fragment: the ack is tiny
+        # relative to frag_size and keeps the pipe full)
         end = offset + len(data)
+        fack = _FRAGACK.pack(HDR_FRAG_ACK, req.src_msgid, end)
+        self.bml.endpoint(req.src_world).send(req.src_world, fack)
+        # completion when the sender's full size has streamed past us
         if end >= req.total:
             req.status.count = min(req.total, req.conv.packed_size)
             del self.active_recv[recv_id]

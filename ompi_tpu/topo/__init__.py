@@ -94,6 +94,9 @@ class CartTopo:
     def rank_of(self, coords: Sequence[int]) -> int:
         """MPI_Cart_rank (periodic dims wrap; open dims out-of-range ->
         PROC_NULL)."""
+        if len(coords) != self.ndims:
+            raise ValueError(
+                f"Cart_rank: {len(coords)} coords for {self.ndims} dims")
         r = 0
         for c, d, per in zip(coords, self.dims, self.periods):
             if not 0 <= c < d:
@@ -272,6 +275,12 @@ def _Neighbor_allgather(self, sendbuf, recvbuf):
 
     sarr, count, dt = _parse_buf(sendbuf)
     rarr = _parse_buf(recvbuf)[0]
+    # a receive-only rank's sendbuf is empty: take the per-edge count
+    # from the recv side instead of posting count-0 (truncating) recvs
+    n_in = len(self.topo.in_neighbors(self.rank))
+    if count == 0 and n_in:
+        count = np.asarray(rarr).size // n_in
+        dt = _parse_buf(recvbuf)[2]
     self.coll.neighbor_allgather(self, sarr, rarr, count, dt)
 
 
