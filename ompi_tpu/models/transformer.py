@@ -63,6 +63,7 @@ class Axes:
     tp: Optional[str] = None
     sp: Optional[str] = None
     ep: Optional[str] = None
+    pp: Optional[str] = None  # pipeline stages (models/pipeline.py)
 
     def batch_axes(self):
         """Axes over which the *tokens* are sharded (dp, sp, and ep —
@@ -177,6 +178,58 @@ def _ln(x, g, b):
     return (x - mu) * lax.rsqrt(var + 1e-5) * g + b
 
 
+def layer_forward(lp, h, cfg: Config, ax: Axes, is_moe: bool):
+    """One transformer block on local shards: pre-LN attention (+tp
+    Megatron f/g pair, +sp ring attention) then FFN or MoE. Shared by
+    the layer loop below and the pipeline-parallel stage scan
+    (models/pipeline.py)."""
+    dt = cfg.dtype
+    b, t = h.shape[0], h.shape[1]
+    x = _ln(h.astype(jnp.float32), lp["ln1"]["g"],
+            lp["ln1"]["b"]).astype(dt)
+    if ax.tp:
+        x = region_enter(x, ax.tp)
+    q = x @ lp["wq"].astype(dt)   # [B,T,Hl*Dh] (tp-sharded cols)
+    k = x @ lp["wk"].astype(dt)
+    v = x @ lp["wv"].astype(dt)
+    hl = q.shape[-1] // cfg.head_dim  # local heads under tp
+    q = q.reshape(b, t, hl, cfg.head_dim)
+    k = k.reshape(b, t, hl, cfg.head_dim)
+    v = v.reshape(b, t, hl, cfg.head_dim)
+    if ax.sp:
+        o = ring_attention(q, k, v, ax.sp, causal=True)
+    else:
+        o = att.mha(q, k, v, causal=True)
+    o = o.reshape(b, t, hl * cfg.head_dim)
+    o = o @ lp["wo"].astype(dt)   # row parallel: partial sums
+    if ax.tp:
+        o = region_exit(o, ax.tp)
+    h = h + o
+
+    x = _ln(h.astype(jnp.float32), lp["ln2"]["g"],
+            lp["ln2"]["b"]).astype(dt)
+    if ax.tp:
+        x = region_enter(x, ax.tp)
+    if is_moe:
+        flat = x.reshape(b * t, cfg.d_model)
+        if ax.ep:
+            y = moe_mod.moe_ffn(
+                flat, lp["wg"].astype(dt), lp["w1"].astype(dt),
+                lp["w2"].astype(dt), ax.ep,
+                capacity_factor=cfg.capacity_factor)
+        else:
+            y = _moe_dense(flat, lp, cfg)
+        if ax.tp:
+            y = region_exit(y, ax.tp)
+        y = y.reshape(b, t, cfg.d_model)
+    else:
+        u = jnp.maximum(x @ lp["w1"].astype(dt), 0)
+        y = u @ lp["w2"].astype(dt)
+        if ax.tp:
+            y = region_exit(y, ax.tp)
+    return h + y
+
+
 def forward_local(params, tokens, cfg: Config, ax: Axes):
     """Forward pass on local shards (inside shard_map when any axis is
     set). tokens: [B_local, T_local] int32 -> logits [B_local, T_local,
@@ -194,49 +247,7 @@ def forward_local(params, tokens, cfg: Config, ax: Axes):
     h = h + pos.astype(dt)[None]
 
     for i, lp in enumerate(params["layers"]):
-        x = _ln(h.astype(jnp.float32), lp["ln1"]["g"],
-                lp["ln1"]["b"]).astype(dt)
-        if ax.tp:
-            x = region_enter(x, ax.tp)
-        q = x @ lp["wq"].astype(dt)   # [B,T,Hl*Dh] (tp-sharded cols)
-        k = x @ lp["wk"].astype(dt)
-        v = x @ lp["wv"].astype(dt)
-        hl = q.shape[-1] // cfg.head_dim  # local heads under tp
-        q = q.reshape(b, t, hl, cfg.head_dim)
-        k = k.reshape(b, t, hl, cfg.head_dim)
-        v = v.reshape(b, t, hl, cfg.head_dim)
-        if ax.sp:
-            o = ring_attention(q, k, v, ax.sp, causal=True)
-        else:
-            o = att.mha(q, k, v, causal=True)
-        o = o.reshape(b, t, hl * cfg.head_dim)
-        o = o @ lp["wo"].astype(dt)   # row parallel: partial sums
-        if ax.tp:
-            o = region_exit(o, ax.tp)
-        h = h + o
-
-        x = _ln(h.astype(jnp.float32), lp["ln2"]["g"],
-                lp["ln2"]["b"]).astype(dt)
-        if ax.tp:
-            x = region_enter(x, ax.tp)
-        if _is_moe(cfg, i):
-            flat = x.reshape(b * t, cfg.d_model)
-            if ax.ep:
-                y = moe_mod.moe_ffn(
-                    flat, lp["wg"].astype(dt), lp["w1"].astype(dt),
-                    lp["w2"].astype(dt), ax.ep,
-                    capacity_factor=cfg.capacity_factor)
-            else:
-                y = _moe_dense(flat, lp, cfg)
-            if ax.tp:
-                y = region_exit(y, ax.tp)
-            y = y.reshape(b, t, cfg.d_model)
-        else:
-            u = jnp.maximum(x @ lp["w1"].astype(dt), 0)
-            y = u @ lp["w2"].astype(dt)
-            if ax.tp:
-                y = region_exit(y, ax.tp)
-        h = h + y
+        h = layer_forward(lp, h, cfg, ax, _is_moe(cfg, i))
 
     h = _ln(h.astype(jnp.float32), params["ln_f"]["g"],
             params["ln_f"]["b"])

@@ -593,6 +593,9 @@ for _name, _fn in _API.items():
 # Communicator methods at import (ompi/mca/topo equivalent)
 from ompi_tpu import topo as _topo  # noqa: E402,F401
 
+# partitioned p2p (MPI-4 Psend_init/Precv_init — ompi/mca/part equiv)
+from ompi_tpu.pml import part as _part  # noqa: E402,F401
+
 
 # ---------------------------------------------------------------------------
 # module-level state: COMM_WORLD / COMM_SELF / init / finalize

@@ -274,13 +274,13 @@ def _Neighbor_allgather(self, sendbuf, recvbuf):
     from ompi_tpu.mpi import _parse_buf
 
     sarr, count, dt = _parse_buf(sendbuf)
-    rarr = _parse_buf(recvbuf)[0]
+    rarr, _, rdt = _parse_buf(recvbuf)
     # a receive-only rank's sendbuf is empty: take the per-edge count
     # from the recv side instead of posting count-0 (truncating) recvs
     n_in = len(self.topo.in_neighbors(self.rank))
     if count == 0 and n_in:
         count = np.asarray(rarr).size // n_in
-        dt = _parse_buf(recvbuf)[2]
+        dt = rdt
     self.coll.neighbor_allgather(self, sarr, rarr, count, dt)
 
 

@@ -21,9 +21,11 @@ def _xfer_body(nbytes: int) -> str:
 
 
 def test_rndv_pipelined_sm_depth1():
-    """depth=1: strict stop-and-wait still delivers correctly."""
-    run_ranks(_xfer_body(4 << 20), 2,
-              mca={"pml_ob1_send_pipeline_depth": "1"})
+    """depth=1 with the byte floor disabled: strict stop-and-wait
+    (every fragment waits for its FRAG_ACK) still delivers correctly."""
+    run_ranks(_xfer_body(2 << 20), 2,
+              mca={"pml_ob1_send_pipeline_depth": "1",
+                   "pml_ob1_send_window_bytes": "1"})
 
 
 def test_rndv_pipelined_sm_default_depth():
@@ -33,7 +35,8 @@ def test_rndv_pipelined_sm_default_depth():
 def test_rndv_pipelined_tcp():
     run_ranks(_xfer_body(4 << 20), 2,
               mca={"btl": "self,tcp",
-                   "pml_ob1_send_pipeline_depth": "3"})
+                   "pml_ob1_send_pipeline_depth": "3",
+                   "pml_ob1_send_window_bytes": "1"})
 
 
 def test_rndv_many_concurrent_streams():
