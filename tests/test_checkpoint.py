@@ -1,0 +1,109 @@
+"""Checkpoint/resume tests — the restart-reproduces-the-loss-curve gate
+(VERDICT r1 item 8: device-state snapshot must exceed the reference)."""
+
+import numpy as np
+
+from tests.harness import run_ranks
+
+
+def _tiny_train(params, steps, lr=0.1, seed=0):
+    """Deterministic toy training: quadratic loss on fixed data."""
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(steps, 4).astype(np.float32)
+    losses = []
+    w = params["w"].copy()
+    b = params["b"].copy()
+    for i in range(steps):
+        x = xs[i]
+        pred = w @ x + b
+        loss = float(pred ** 2)
+        losses.append(loss)
+        grad_w = 2 * pred * x
+        grad_b = 2 * pred
+        w = w - lr * grad_w
+        b = b - lr * grad_b
+    return {"w": w, "b": b}, losses
+
+
+def test_restart_reproduces_loss_curve(tmp_path):
+    from ompi_tpu.io import checkpoint
+
+    path = str(tmp_path / "ck.otck")
+    params = {"w": np.ones(4, dtype=np.float32),
+              "b": np.zeros((), dtype=np.float32)}
+    # uninterrupted run: 10 steps
+    _, full_losses = _tiny_train(params, 10)
+    # interrupted run: 5 steps, checkpoint, "crash", restore, 5 more
+    mid, first = _tiny_train(params, 5)
+    checkpoint.save(path, mid, step=5)
+    restored, step = checkpoint.restore(path)
+    assert step == 5
+    for k in params:
+        assert np.array_equal(np.asarray(restored[k]),
+                              np.asarray(mid[k])), k
+    # continue on the same data stream (steps 5..9)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(10, 4).astype(np.float32)
+    w, b = restored["w"].copy(), restored["b"].copy()
+    resumed_losses = []
+    for i in range(5, 10):
+        x = xs[i]
+        pred = w @ x + b
+        resumed_losses.append(float(pred ** 2))
+        w = w - 0.1 * (2 * pred * x)
+        b = b - 0.1 * (2 * pred)
+    assert np.allclose(first + resumed_losses, full_losses), \
+        (first + resumed_losses, full_losses)
+
+
+def test_jax_pytree_roundtrip(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu.io import checkpoint
+
+    path = str(tmp_path / "jax.otck")
+    tree = {"layer": {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+                      "b": jnp.ones(4, dtype=jnp.bfloat16)},
+            "step_scale": jnp.float32(0.5)}
+    checkpoint.save(path, tree, step=42)
+    back, step = checkpoint.restore(path)
+    assert step == 42
+    flat_a, def_a = jax.tree_util.tree_flatten(tree)
+    flat_b, def_b = jax.tree_util.tree_flatten(back)
+    assert def_a == def_b
+    for a, b in zip(flat_a, flat_b):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert np.asarray(a).dtype == np.asarray(b).dtype
+
+
+def test_async_save(tmp_path):
+    from ompi_tpu.io import checkpoint
+
+    path = str(tmp_path / "async.otck")
+    tree = {"x": np.random.randn(256, 256).astype(np.float32)}
+    h = checkpoint.save_async(path, tree, step=7)
+    h.wait()
+    back, step = checkpoint.restore(path)
+    assert step == 7
+    assert np.array_equal(back["x"], tree["x"])
+
+
+def test_sharded_collective_checkpoint(tmp_path):
+    """4 ranks each write their leading-axis shard via Write_at_all;
+    restore re-slices per rank and also reads back the global view."""
+    path = str(tmp_path / "sharded.otck")
+    run_ranks(f"""
+        from ompi_tpu.io import checkpoint
+        path = {path!r}
+        full = np.arange(32 * 6, dtype=np.float32).reshape(32, 6)
+        shard = np.array_split(full, size, axis=0)[rank]
+        checkpoint.save_sharded(path, {{"emb": shard}}, comm, step=3)
+        comm.Barrier()
+        tree, step = checkpoint.restore(path, comm=comm)
+        assert step == 3
+        assert np.array_equal(tree["emb"], shard), rank
+        # global view (no comm): the concatenation
+        tree_g, _ = checkpoint.restore(path)
+        assert np.array_equal(tree_g["emb"], full)
+    """, 4, timeout=120)
