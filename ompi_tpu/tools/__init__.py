@@ -1,0 +1,6 @@
+"""Tools — introspection and operator utilities.
+
+Reference: ompi/tools/ (ompi_info, mpirun wrapper, wrapper compilers).
+The launcher (tpurun) lives in ompi_tpu.runtime.launcher; this package
+holds ompi_info's equivalent (``python -m ompi_tpu.tools.info``).
+"""

@@ -1,0 +1,133 @@
+"""ompi_info equivalent — dump frameworks, components, cvars, pvars.
+
+Reference: opal/runtime/opal_info_support.c + ompi/tools/ompi_info —
+enumerates every framework's components and every registered MCA
+variable with type/default/current/source, gated by verbosity level
+(ompi_info -a / --level).
+
+Usage:
+    python -m ompi_tpu.tools.info              # components + level<=3 vars
+    python -m ompi_tpu.tools.info -a           # everything incl. pvars
+    python -m ompi_tpu.tools.info --level 9
+    python -m ompi_tpu.tools.info --param coll # one framework's vars
+    python -m ompi_tpu.tools.info --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+from ompi_tpu.core import cvar, pvar, registry
+
+_SOURCES = {0: "default", 1: "file", 2: "env", 3: "set"}
+
+
+def _import_component_universe() -> None:
+    """Import every package that registers components/cvars so the dump
+    is complete without bringing up the runtime (no rte/store init —
+    like ompi_info, which opens frameworks without calling MPI_Init)."""
+    import importlib
+
+    for mod in (
+            "ompi_tpu.accelerator",
+            "ompi_tpu.accelerator.null", "ompi_tpu.accelerator.tpu",
+            "ompi_tpu.btl.self_btl", "ompi_tpu.btl.sm", "ompi_tpu.btl.tcp",
+            "ompi_tpu.coll", "ompi_tpu.coll.accelerator",
+            "ompi_tpu.coll.basic", "ompi_tpu.coll.inter",
+            "ompi_tpu.coll.libnbc", "ompi_tpu.coll.tuned",
+            "ompi_tpu.coll.xla",
+            "ompi_tpu.core.progress",
+            "ompi_tpu.datatype",
+            "ompi_tpu.ft.detector",
+            "ompi_tpu.io",
+            "ompi_tpu.op",
+            "ompi_tpu.osc",
+            "ompi_tpu.pml.ob1", "ompi_tpu.pml.part",
+            "ompi_tpu.runtime.device_plane",
+            "ompi_tpu.topo",
+    ):
+        try:
+            importlib.import_module(mod)
+        except Exception as exc:  # noqa: BLE001 — a broken module should
+            print(f"# warning: {mod} failed to import: {exc}",
+                  file=sys.stderr)  # not hide the rest of the dump
+
+
+def collect(level: int = 3,
+            param: Optional[str] = None,
+            include_pvars: bool = False) -> Dict:
+    """Build the info tree (frameworks/components, cvars, pvars)."""
+    _import_component_universe()
+    out: Dict = {"frameworks": {}, "cvars": {}, "pvars": {}}
+    for fw_name, fw in sorted(registry.all_frameworks().items()):
+        out["frameworks"][fw_name] = fw.names()
+    for name, var in sorted(cvar.all_vars().items()):
+        if var.level > level:
+            continue
+        if param is not None and not name.startswith(param):
+            continue
+        out["cvars"][name] = {
+            "value": var.get(),
+            "default": var.default,
+            "type": var.typ.__name__,
+            "source": _SOURCES.get(var._source, "?"),
+            "level": var.level,
+            "help": var.help,
+        }
+        if var.choices is not None:
+            out["cvars"][name]["choices"] = list(var.choices)
+    if include_pvars:
+        out["pvars"] = pvar.snapshot()
+    return out
+
+
+def render(info: Dict, verbose_help: bool = False) -> List[str]:
+    lines: List[str] = []
+    lines.append("ompi_tpu info")
+    lines.append("=" * 60)
+    lines.append("")
+    lines.append("Frameworks and components:")
+    for fw, comps in info["frameworks"].items():
+        lines.append(f"  {fw:<14} {', '.join(comps) if comps else '(none)'}")
+    lines.append("")
+    lines.append(f"Control variables ({len(info['cvars'])}):")
+    for name, v in info["cvars"].items():
+        val = v["value"]
+        mark = "" if v["source"] == "default" else f"  [{v['source']}]"
+        lines.append(f"  {name:<34} {val!r:<14} "
+                     f"(type {v['type']}, level {v['level']}){mark}")
+        if verbose_help and v["help"]:
+            lines.append(f"      {v['help']}")
+    if info["pvars"]:
+        lines.append("")
+        lines.append(f"Performance variables ({len(info['pvars'])}):")
+        for name, val in sorted(info["pvars"].items()):
+            lines.append(f"  {name:<34} {val}")
+    return lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="ompi_tpu.tools.info",
+                                 description=__doc__)
+    ap.add_argument("-a", "--all", action="store_true",
+                    help="everything: level 9 + pvars + help text")
+    ap.add_argument("--level", type=int, default=None,
+                    help="max cvar verbosity level (1..9)")
+    ap.add_argument("--param", default=None, metavar="PREFIX",
+                    help="only cvars with this prefix (e.g. 'coll')")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ns = ap.parse_args(argv)
+    level = ns.level if ns.level is not None else (9 if ns.all else 3)
+    info = collect(level=level, param=ns.param, include_pvars=ns.all)
+    if ns.as_json:
+        print(json.dumps(info, indent=2, default=repr))
+    else:
+        print("\n".join(render(info, verbose_help=ns.all)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

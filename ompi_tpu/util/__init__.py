@@ -1,1 +1,14 @@
-"""Utility layer (reference: opal/util/)."""
+"""Utility layer (reference: opal/util/, 20,852 LoC of C).
+
+Most of the reference's util directory is portability scaffolding that
+Python's stdlib already provides (argv/cmdline -> argparse, opal_output
+-> ompi_tpu.core.output, json/sha/crc -> stdlib+zlib, printf -> str
+formatting). What remains genuinely needed is implemented here:
+
+- :mod:`ompi_tpu.util.show_help` — tagged, de-duplicated, framed user
+  diagnostics (opal/util/show_help.c + help-*.txt).
+- :mod:`ompi_tpu.util.net` — interface enumeration + address scoring
+  for the tcp BTL's modex (opal/util/net.c + mca/if + reachable).
+"""
+
+from ompi_tpu.util import net, show_help  # noqa: F401

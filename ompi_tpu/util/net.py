@@ -1,0 +1,114 @@
+"""Network interface enumeration + address selection.
+
+Reference: opal/util/net.c + opal/mca/if (NIC enumeration) and
+mca/reachable/weighted (pairwise address scoring): the tcp BTL publishes
+its candidate addresses through the modex and each peer picks the
+best-scored pair.
+
+Redesign: Linux-only (the TPU pod OS), read straight from
+/proc/net (no ioctls): enumerate interfaces with their IPv4 addresses,
+classify (loopback / private / public), and score candidate addresses so
+btl/tcp can prefer a pod-network address over loopback when ranks span
+hosts while still working single-host with only lo.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import socket
+import struct
+from typing import List, NamedTuple, Optional
+
+
+class Interface(NamedTuple):
+    name: str
+    address: str
+    is_loopback: bool
+    is_private: bool
+
+
+def interfaces() -> List[Interface]:
+    """IPv4 interfaces of this host (best effort; always includes lo)."""
+    out: List[Interface] = []
+    try:
+        # /proc/net/fib_trie is complex; getaddrinfo on the hostname +
+        # a UDP-connect probe cover the common cases without ioctls
+        seen = set()
+        for addr in _candidate_addrs():
+            if addr in seen:
+                continue
+            seen.add(addr)
+            ip = ipaddress.ip_address(addr)
+            out.append(Interface(
+                name=_guess_name(ip),
+                address=addr,
+                is_loopback=ip.is_loopback,
+                is_private=ip.is_private and not ip.is_loopback))
+    except OSError:
+        pass
+    if not any(i.is_loopback for i in out):
+        out.append(Interface("lo", "127.0.0.1", True, False))
+    return out
+
+
+def _candidate_addrs() -> List[str]:
+    addrs = ["127.0.0.1"]
+    try:
+        for info in socket.getaddrinfo(
+                socket.gethostname(), None, socket.AF_INET):
+            addrs.append(info[4][0])
+    except OSError:
+        pass
+    # default-route probe: the address the kernel would source from
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.254", 9))  # no packet is sent (UDP)
+            addrs.append(s.getsockname()[0])
+        finally:
+            s.close()
+    except OSError:
+        pass
+    return addrs
+
+
+def _guess_name(ip) -> str:
+    return "lo" if ip.is_loopback else "eth?"
+
+
+def score(addr: str, peer_hint: Optional[str] = None) -> int:
+    """Reachability score (higher = better), reachable/weighted style:
+    same-subnet > private > public > loopback for cross-host; loopback
+    wins only when the peer is local."""
+    ip = ipaddress.ip_address(addr)
+    if peer_hint is not None:
+        peer = ipaddress.ip_address(peer_hint)
+        if ip.is_loopback and peer.is_loopback:
+            return 100
+        if _same24(ip, peer):
+            return 90
+    if ip.is_loopback:
+        return 10
+    if ip.is_private:
+        return 70
+    return 50
+
+
+def _same24(a, b) -> bool:
+    pa = struct.unpack("!I", a.packed)[0] >> 8
+    pb = struct.unpack("!I", b.packed)[0] >> 8
+    return pa == pb
+
+
+def best_address(peer_hint: Optional[str] = None) -> str:
+    """The address this rank should publish/pick for TCP endpoints."""
+    cands = interfaces()
+    return max(cands, key=lambda i: score(i.address, peer_hint)).address
+
+
+def pick_peer_address(published: List[str],
+                      my_addr: Optional[str] = None) -> str:
+    """Choose which of a peer's published addresses to dial."""
+    if not published:
+        raise ValueError("peer published no addresses")
+    return max(published, key=lambda a: score(a, my_addr))

@@ -1,0 +1,86 @@
+"""show_help — tagged, templated user-facing diagnostics.
+
+Reference: opal/util/show_help.c + the help-*.txt ini files: user-visible
+errors are keyed (topic, tag), rendered from templates with %-style
+substitution, de-duplicated so a 512-rank job prints one copy instead of
+512, and framed so they stand out from debug noise.
+
+Redesign: topics are Python dicts registered by the owning module (no
+ini parsing), de-dup is per-process by (topic, tag) — the aggregation
+the reference does in the runtime daemon is served by the launcher
+only forwarding rank 0's stderr by default.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Dict, Set, Tuple
+
+_topics: Dict[str, Dict[str, str]] = {}
+_seen: Set[Tuple[str, str]] = set()
+_lock = threading.Lock()
+
+_FRAME = "-" * 64
+
+
+def add_topic(topic: str, messages: Dict[str, str]) -> None:
+    """Register a topic's tagged message templates."""
+    with _lock:
+        _topics.setdefault(topic, {}).update(messages)
+
+
+def render(topic: str, tag: str, **subst) -> str:
+    tpl = _topics.get(topic, {}).get(tag)
+    if tpl is None:
+        return (f"[{topic}:{tag}] (no help text registered) "
+                f"args={subst!r}")
+    try:
+        body = tpl % subst if subst else tpl
+    except (KeyError, ValueError):
+        body = f"{tpl}\n(help substitution failed: {subst!r})"
+    return f"{_FRAME}\n{body.rstrip()}\n{_FRAME}"
+
+
+def show(topic: str, tag: str, once: bool = True, **subst) -> None:
+    """Print a framed help message to stderr; once=True de-duplicates
+    repeats of the same (topic, tag) in this process."""
+    with _lock:
+        if once and (topic, tag) in _seen:
+            return
+        _seen.add((topic, tag))
+    print(render(topic, tag, **subst), file=sys.stderr)
+
+
+def reset_for_testing() -> None:
+    with _lock:
+        _seen.clear()
+
+
+# built-in topics for the runtime plane
+add_topic("launcher", {
+    "rank-died": (
+        "A rank exited abnormally and fault tolerance is not enabled,\n"
+        "so tpurun is terminating the whole job (mpirun behavior).\n"
+        "  rank:   %(rank)s\n"
+        "  cause:  %(cause)s\n"
+        "Enable ULFM-style survival with: tpurun --mca ft 1"),
+    "store-unreachable": (
+        "A rank could not reach the rendezvous store at %(addr)s.\n"
+        "The job cannot bootstrap without it (it is the PMIx-server\n"
+        "equivalent). Check that the launcher is still alive and that\n"
+        "no firewall blocks loopback/job-private traffic."),
+})
+add_topic("ft", {
+    "detector-dead": (
+        "ULFM failure detector on rank %(rank)s stopped after repeated\n"
+        "store RPC failures (%(error)s). This rank can no longer\n"
+        "observe failures or revocations, and peers may soon declare\n"
+        "it stale-dead. If the job is not shutting down, the\n"
+        "rendezvous store is unhealthy."),
+    "failure-detected": (
+        "ULFM failure detector: rank(s) %(ranks)s declared failed\n"
+        "(%(why)s). Surviving ranks keep running; use\n"
+        "comm.shrink()/comm.agree() to recover, comm.revoke() to\n"
+        "interrupt peers still blocked on the failed rank(s)."),
+})

@@ -1,0 +1,55 @@
+"""tools/info + util layer tests (reference analog: ompi_info runs in
+CI to validate component registration; test/util)."""
+
+import json
+import subprocess
+import sys
+
+
+def test_info_dumps_components_and_cvars():
+    from ompi_tpu.tools import info
+
+    data = info.collect(level=9, include_pvars=True)
+    fw = data["frameworks"]
+    assert set(fw["btl"]) == {"self", "sm", "tcp"}
+    assert {"basic", "tuned", "libnbc", "accelerator", "xla",
+            "inter"} <= set(fw["coll"])
+    assert "null" in fw["accelerator"]
+    # layered-config vars exist with metadata
+    assert "progress_spin_count" in data["cvars"]
+    v = data["cvars"]["progress_spin_count"]
+    assert v["type"] == "int" and v["help"]
+
+
+def test_info_cli_json():
+    out = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.info", "--json",
+         "--level", "9"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    data = json.loads(out.stdout)
+    assert "frameworks" in data and "cvars" in data
+
+
+def test_show_help_dedup(capsys):
+    from ompi_tpu.util import show_help
+
+    show_help.reset_for_testing()
+    show_help.show("launcher", "rank-died", rank=3, cause="signal 9")
+    show_help.show("launcher", "rank-died", rank=3, cause="signal 9")
+    err = capsys.readouterr().err
+    assert err.count("terminating the whole job") == 1
+    assert "rank:   3" in err
+
+
+def test_net_address_scoring():
+    from ompi_tpu.util import net
+
+    # loopback pairs beat everything; cross-host loopback loses
+    assert net.score("127.0.0.1", "127.0.0.1") == 100
+    assert net.score("127.0.0.1", "10.0.0.2") < net.score(
+        "10.0.0.1", "10.0.0.2")
+    assert net.pick_peer_address(
+        ["127.0.0.1", "10.0.0.5"], "10.0.0.1") == "10.0.0.5"
+    # always returns something usable
+    assert net.best_address()
