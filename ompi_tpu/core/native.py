@@ -1,0 +1,138 @@
+"""Loader for the native core (csrc/ompitpu_core.c) via ctypes.
+
+Reference rationale: the reference implements its entire runtime in C;
+here the Python plane keeps the logic and the native library owns the
+two paths where byte movement and memory ordering dominate — the sm
+SPSC ring (publish/consume with real acquire/release atomics instead
+of the x86-TSO+GIL assumption) and the datatype span gather/scatter
+(opal_datatype_pack.c's hot loop).
+
+Build-on-first-use (``make -C csrc``); every entry point degrades to
+the pure-Python implementation when no compiler is available, so the
+framework stays importable anywhere (the accelerator/null pattern).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from ompi_tpu.core import cvar, output
+
+_out = output.stream("native")
+
+_enabled_var = cvar.register(
+    "native", True, bool,
+    help="Use the native C core (csrc/) for sm-ring and datatype "
+         "pack hot paths when buildable; pure Python otherwise.",
+    level=4)
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "libompitpu_core.so")
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first call; None if disabled
+    or unbuildable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not _enabled_var.get():
+            return None
+        if not os.path.exists(_SO) and not _build():
+            return None
+        L = None
+        try:
+            L = _bind(ctypes.CDLL(_SO))
+        except OSError as exc:
+            _out.verbose(1, "native core unavailable: %s", exc)
+        except AttributeError:
+            # stale .so from an older checkout (gitignored, so it
+            # survives checkout switches): rebuild once, else fall
+            # back to pure Python
+            _out.verbose(1, "native core stale; rebuilding")
+            if _build():
+                try:
+                    L = _bind(ctypes.CDLL(_SO))
+                except (OSError, AttributeError) as exc:
+                    _out.verbose(1, "native rebuild unusable: %s", exc)
+        _lib = L
+        if L is not None:
+            _out.verbose(2, "native core loaded: %s", _SO)
+        return _lib
+
+
+def _bind(L: ctypes.CDLL) -> Optional[ctypes.CDLL]:
+    """Declare signatures; raises AttributeError on missing symbols
+    (stale library); returns None on ABI-version mismatch."""
+    L.otpu_ring_push.restype = ctypes.c_int
+    L.otpu_ring_push.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+        ctypes.c_uint32]
+    L.otpu_ring_pop.restype = ctypes.c_int64
+    L.otpu_ring_pop.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+        ctypes.c_uint64]
+    L.otpu_ring_readable.restype = ctypes.c_uint64
+    L.otpu_ring_readable.argtypes = [ctypes.c_void_p]
+    L.otpu_gather_spans.restype = ctypes.c_int64
+    L.otpu_gather_spans.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p]
+    L.otpu_scatter_spans.restype = ctypes.c_int64
+    L.otpu_scatter_spans.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p]
+    if L.otpu_abi_version() != 1:
+        _out.verbose(1, "native core ABI mismatch; ignoring")
+        return None
+    return L
+
+
+def _build() -> bool:
+    """Compile to a private temp file, then atomically publish — N
+    ranks may race here on first use and each must either see no .so
+    or a complete one (concurrent `make` on a shared output can be
+    dlopened half-written)."""
+    import tempfile
+
+    src = os.path.join(_CSRC, "ompitpu_core.c")
+    cc = os.environ.get("CC", "cc")
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_CSRC)
+        os.close(fd)
+        r = subprocess.run(
+            [cc, "-O3", "-fPIC", "-std=c11", "-shared", src, "-o", tmp],
+            capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            _out.verbose(1, "native build failed:\n%s", r.stderr)
+            os.unlink(tmp)
+            return False
+        os.replace(tmp, _SO)  # atomic: racers each publish a whole file
+        return True
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        _out.verbose(1, "native build unavailable: %s", exc)
+        return False
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def reset_for_testing() -> None:
+    global _lib, _tried
+    with _lock:
+        _lib = None
+        _tried = False

@@ -101,13 +101,39 @@ class Convertor:
         if self._spans is None:
             out = src[start:end].tobytes()
         elif start == 0 and end == self.packed_size:
-            out = src[self._gather_index()].tobytes()
+            out = self._move_full(src, scatter=False)
         else:
             out = self._gather(src, start, end)
         self.position = end
         if self.checksum is not None:
             self.checksum = zlib.crc32(out, self.checksum)
         return out
+
+    def _move_full(self, flat: np.ndarray, scatter: bool,
+                   wire: Optional[np.ndarray] = None):
+        """Whole-layout byte movement: per-span memcpy in the native
+        core when built (the opal_datatype_pack.c hot loop), else the
+        vectorized fancy-index fallback. flat must be a contiguous
+        uint8 view of the user buffer."""
+        from ompi_tpu.core import native
+
+        L = native.lib()
+        n = self.packed_size
+        if L is not None and flat.flags["C_CONTIGUOUS"]:
+            spans = np.ascontiguousarray(self._spans, dtype=np.int64)
+            if scatter:
+                w = np.ascontiguousarray(wire)
+                L.otpu_scatter_spans(w.ctypes.data, spans.ctypes.data,
+                                     len(spans), flat.ctypes.data)
+                return None
+            out = np.empty(n, dtype=np.uint8)
+            L.otpu_gather_spans(flat.ctypes.data, spans.ctypes.data,
+                                len(spans), out.ctypes.data)
+            return out.tobytes()
+        if scatter:
+            flat[self._gather_index()] = wire
+            return None
+        return flat[self._gather_index()].tobytes()
 
     def _gather_index(self) -> np.ndarray:
         """Flat byte-index vector for the whole layout — one vectorized
@@ -148,7 +174,7 @@ class Convertor:
         if self._spans is None:
             dst[start:end] = src
         elif start == 0 and end == self.packed_size:
-            dst[self._gather_index()] = src
+            self._move_full(dst, scatter=True, wire=src)
         else:
             self._scatter(dst, src, start, end)
         self.position = end
