@@ -7,9 +7,12 @@ priority<0). Components here: ``basic`` (linear reference algorithms),
 ``tuned`` (decision rules over the base algorithm library), ``libnbc``
 (nonblocking schedules), ``accelerator`` (device-buffer staging
 fallback), ``xla`` (device-executed collectives over the
-multi-controller device plane — the north star). COMM_SELF/size-1 comms
-are served by basic's linear paths and xla's local fast path (no
-separate ``self`` component needed).
+multi-controller device plane — the north star), ``inter``
+(group-vs-group algorithms for intercommunicators), ``han``
+(hierarchical node×network compositions), ``sync`` (barrier-injection
+debug interposition). COMM_SELF/size-1 comms are served by basic's
+linear paths and xla's local fast path (no separate ``self`` component
+needed).
 
 Collective p2p traffic runs in the communicator's collective context
 (cid*2+1) with a per-comm monotonically increasing operation tag, so user
@@ -50,6 +53,13 @@ SLOTS = (
 class CollModule(registry.Component):
     """A coll component instance; query() returns per-comm priority."""
 
+    #: intra-group algorithms are wrong on intercommunicators — only
+    #: components that implement group-vs-group semantics (coll/inter)
+    #: opt in. Enforced centrally by comm_select, so components that
+    #: override query() cannot forget the check (reference: the inter
+    #: component's comm_query gate).
+    INTER_OK = False
+
     def query(self, comm) -> int:
         """Return priority for this comm, or <0 to disqualify
         (reference: coll_base_comm_select.c:456-471)."""
@@ -86,9 +96,13 @@ def comm_select(comm) -> None:
     table = CollTable()
     comps = framework.open_components()
     ranked = []
+    is_inter = getattr(comm, "is_inter", False)
     for comp in comps:
         if not isinstance(comp, CollModule):
             continue
+        if is_inter and not comp.INTER_OK:
+            continue  # central gate: intra algorithms never stack on
+            # an intercomm, regardless of the component's own query()
         try:
             pri = comp.query(comm)
         except Exception as exc:
@@ -103,6 +117,12 @@ def comm_select(comm) -> None:
         for slot, fn in comp.slots(comm).items():
             table.fns[slot] = fn
             table.providers[slot] = comp.NAME
+    # interposition hook: components like coll/sync wrap the finished
+    # table rather than installing slots of their own
+    for pri, comp in ranked:
+        hook = getattr(comp, "post_stack", None)
+        if hook is not None:
+            hook(comm, table)
     comm.coll = table
     _out.verbose(5, "comm %s coll table: %s", getattr(comm, "name", "?"),
                  {s: table.providers.get(s) for s in table.fns})
@@ -110,7 +130,7 @@ def comm_select(comm) -> None:
 
 def _register_builtin() -> None:
     from ompi_tpu.coll import (  # noqa: F401
-        accelerator, basic, libnbc, tuned, xla,
+        accelerator, basic, han, inter, libnbc, sync, tuned, xla,
     )
 
 

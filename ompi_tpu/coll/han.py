@@ -1,0 +1,257 @@
+"""coll/han — hierarchical two-level collectives.
+
+Reference: ompi/mca/coll/han/coll_han.h:22-33,62-63 — split each
+communicator into an intra-node ``low_comm`` and an inter-node
+``up_comm`` of node leaders, then compose per-level algorithms so
+inter-node traffic is minimized (one message per node instead of one
+per rank). The reference's default priority is 35, above tuned.
+
+TPU mapping: "node" is the unit of cheap transport — sm rings
+intra-host, tcp/DCN inter-host; once multi-host lands the same split is
+the ICI-slice × DCN hierarchy (SURVEY §2.10 row "hierarchical").
+
+Sub-communicators are built lazily on the first collective (the
+reference does the same — han's comm_create on first use), which is
+safe because every member reaches that first collective together.
+Testing aid: cvar ``coll_han_split=modulo:K`` fakes K-node topology on
+one host (the reference pins algorithms with forced cvars the same
+way).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+
+import numpy as np
+
+from ompi_tpu.core import cvar, pvar
+from ompi_tpu.coll import CollModule, framework
+
+_IN_PLACE = "MPI_IN_PLACE"  # sentinel shared with mpi.py / coll/basic
+
+_split_var = cvar.register(
+    "coll_han_split", "auto", str,
+    help="Node-split strategy: 'auto' (by hostname), 'modulo:K' "
+         "(fake K nodes for single-host testing), 'off'.", level=6)
+_prio_var = cvar.register(
+    "coll_han_priority", 35, int,
+    help="coll/han selection priority (reference default 35, above "
+         "tuned).", level=6)
+
+
+def _node_color(comm) -> int:
+    spec = _split_var.get()
+    if spec.startswith("modulo:"):
+        k = max(1, int(spec.split(":", 1)[1]))
+        # contiguous blocks of ranks pretend to share a node
+        per = -(-comm.size // k)
+        return comm.rank // per
+    host = socket.gethostname()
+    return int.from_bytes(
+        hashlib.sha1(host.encode()).digest()[:4], "little") & 0x7FFFFFFF
+
+
+class _Levels:
+    """low = my node's ranks; up = node leaders (or None if not one)."""
+
+    def __init__(self, comm) -> None:
+        from ompi_tpu.comm import UNDEFINED
+
+        color = _node_color(comm)
+        self.low = comm.split(color, key=comm.rank)
+        is_leader = self.low.rank == 0
+        self.up = comm.split(0 if is_leader else UNDEFINED,
+                             key=comm.rank)
+        # map: which comm-rank leads my node / each node's leader list
+        self.leader_commrank = self._bcast_low_obj(
+            comm.rank if is_leader else None)
+
+    def _bcast_low_obj(self, obj):
+        low = self.low
+        if low.rank == 0:
+            for r in range(1, low.size):
+                low.send(obj, dest=r, tag=1)
+            return obj
+        return low.recv(source=0, tag=1)
+
+
+def _levels(comm) -> _Levels:
+    lv = getattr(comm, "_han_levels", None)
+    if lv is None:
+        lv = _Levels(comm)
+        comm._han_levels = lv
+    return lv
+
+
+@framework.register
+class CollHan(CollModule):
+    NAME = "han"
+
+    def query(self, comm) -> int:
+        spec = _split_var.get()
+        if spec == "off" or comm.size < 4:
+            return -1
+        if spec == "auto":
+            # single-host job => every rank same node => hierarchy is
+            # pure overhead; disqualify (reference han does the same
+            # one-node check)
+            return -1 if _single_node() else _prio_var.get()
+        return _prio_var.get()
+
+    def slots(self, comm):
+        return {
+            "barrier": barrier_han,
+            "bcast": bcast_han,
+            "reduce": reduce_han,
+            "allreduce": allreduce_han,
+            "allgather": allgather_han,
+        }
+
+
+def _single_node() -> bool:
+    # all ranks of this job share local_size == size (launcher contract)
+    from ompi_tpu.runtime import rte
+
+    return rte.local_size >= rte.size
+
+
+# -- composed algorithms (coll_han_*_intra two-level compositions) ---------
+
+def allreduce_han(comm, sendbuf, recvbuf, count, dtype, op):
+    """low reduce -> up allreduce among leaders -> low bcast
+    (coll_han_allreduce.c's default composition)."""
+    pvar.record("han_allreduce")
+    lv = _levels(comm)
+    if sendbuf is _IN_PLACE:
+        # materialize: comm-level IN_PLACE would confuse the low
+        # reduce when the comm root is not the low root
+        sendbuf = np.array(recvbuf, copy=True)
+    lv.low.coll.reduce(lv.low, sendbuf, recvbuf, count, dtype, op, 0)
+    if lv.up is not None:
+        tmp = np.array(recvbuf, copy=True)
+        lv.up.coll.allreduce(lv.up, tmp, recvbuf, count, dtype, op)
+    lv.low.coll.bcast(lv.low, recvbuf, count, dtype, 0)
+
+
+def reduce_han(comm, sendbuf, recvbuf, count, dtype, op, root):
+    """low reduce to node leaders -> up reduce to root's leader -> ship
+    to root if the root is not a leader."""
+    pvar.record("han_reduce")
+    lv = _levels(comm)
+    if sendbuf is _IN_PLACE:  # only legal at root, which has recvbuf
+        sendbuf = np.array(recvbuf, copy=True)
+    tmp = np.empty_like(np.asarray(sendbuf))
+    lv.low.coll.reduce(lv.low, sendbuf, tmp, count, dtype, op, 0)
+    root_leader = _leader_of(comm, root)
+    if lv.up is not None:
+        up_root = _up_rank_of(comm, lv, root_leader)
+        lv.up.coll.reduce(lv.up, tmp, tmp, count, dtype, op, up_root)
+    # root's node leader forwards to root (one hop, intra-node)
+    if comm.rank == root_leader and root != root_leader:
+        comm.Send(tmp, dest=root, tag=_han_tag(comm))
+    if comm.rank == root:
+        if root == root_leader:
+            np.copyto(np.asarray(recvbuf), tmp)
+        else:
+            comm.Recv(recvbuf, source=root_leader, tag=_han_tag(comm))
+
+
+def bcast_han(comm, buf, count, dtype, root):
+    """root -> its leader -> up bcast -> low bcast."""
+    pvar.record("han_bcast")
+    lv = _levels(comm)
+    root_leader = _leader_of(comm, root)
+    if comm.rank == root and root != root_leader:
+        comm.Send(buf, dest=root_leader, tag=_han_tag(comm))
+    if comm.rank == root_leader and root != root_leader:
+        comm.Recv(buf, source=root, tag=_han_tag(comm))
+    if lv.up is not None:
+        up_root = _up_rank_of(comm, lv, root_leader)
+        lv.up.coll.bcast(lv.up, buf, count, dtype, up_root)
+    lv.low.coll.bcast(lv.low, buf, count, dtype, 0)
+
+
+def barrier_han(comm):
+    pvar.record("han_barrier")
+    lv = _levels(comm)
+    # gather at leaders, leaders rendezvous, release
+    lv.low.coll.barrier(lv.low)
+    if lv.up is not None:
+        lv.up.coll.barrier(lv.up)
+    lv.low.coll.barrier(lv.low)
+
+
+def allgather_han(comm, sendbuf, recvbuf, count, dtype):
+    """low gather -> up allgather (node blocks) -> low bcast, then
+    reorder node blocks into comm-rank order."""
+    pvar.record("han_allgather")
+    # han's allgather needs rank-reordering bookkeeping; the simple
+    # correct composition: allreduce a one-hot assembled buffer would
+    # waste bandwidth, so fall back to gather+bcast through leaders.
+    lv = _levels(comm)
+    if sendbuf is _IN_PLACE:  # my block already sits in recvbuf
+        flat = np.asarray(recvbuf).reshape(comm.size, -1)
+        sendbuf = np.array(flat[comm.rank], copy=True)
+    send = np.asarray(sendbuf)
+    n = send.size
+    low_buf = (np.empty(n * lv.low.size, dtype=send.dtype)
+               if lv.low.rank == 0 else None)
+    lv.low.coll.gather(lv.low, send, low_buf, n, dtype, 0)
+    full = np.asarray(recvbuf).reshape(-1)
+    if lv.up is not None:
+        # leaders exchange (node_ranks, block) and place by comm rank
+        my_ranks = _low_commranks(comm, lv)
+        pieces = lv.up.allgather((my_ranks, low_buf))
+        for ranks, block in pieces:
+            block = np.asarray(block).reshape(len(ranks), -1)
+            for i, r in enumerate(ranks):
+                full[r * n:(r + 1) * n] = block[i].view(send.dtype)
+    lv.low.coll.bcast(lv.low, full, full.size, dtype, 0)
+    np.asarray(recvbuf).reshape(-1)[:] = full
+
+
+# -- helpers ---------------------------------------------------------------
+
+def _han_tag(comm) -> int:
+    return 78100
+
+
+def _leader_of(comm, rank: int) -> int:
+    """comm rank of `rank`'s node leader (deterministic: lowest comm
+    rank with the same node color — recomputed, no exchange needed)."""
+    colors = _color_table(comm)
+    c = colors[rank]
+    return min(i for i, col in enumerate(colors) if col == c)
+
+
+def _up_rank_of(comm, lv, leader_commrank: int) -> int:
+    """rank within up_comm of a leader, derived from color order."""
+    colors = _color_table(comm)
+    leaders = sorted(
+        min(i for i, c in enumerate(colors) if c == col)
+        for col in sorted(set(colors)))
+    return leaders.index(leader_commrank)
+
+
+def _color_table(comm):
+    tbl = getattr(comm, "_han_colors", None)
+    if tbl is None:
+        spec = _split_var.get()
+        if spec.startswith("modulo:"):
+            k = max(1, int(spec.split(":", 1)[1]))
+            per = -(-comm.size // k)
+            tbl = [r // per for r in range(comm.size)]
+        else:
+            # single-color fallback; 'auto' multi-host exchanges
+            # hostnames once via allgather
+            tbl = comm.allgather(_node_color(comm))
+        comm._han_colors = tbl
+    return tbl
+
+
+def _low_commranks(comm, lv):
+    """comm ranks belonging to my node, in low-comm rank order."""
+    colors = _color_table(comm)
+    mine = colors[comm.rank]
+    return [i for i, c in enumerate(colors) if c == mine]

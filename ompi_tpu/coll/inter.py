@@ -1,0 +1,127 @@
+"""coll/inter — group-vs-group collectives for intercommunicators.
+
+Reference: ompi/mca/coll/inter (leader-based algorithms: local phase on
+c_local_comm, leader exchange across the bridge, local redistribution)
+and coll/basic's inter variants. Root arguments follow the MPI inter
+convention: the root group passes ``intercomm.ROOT`` at the root and
+``PROC_NULL`` elsewhere; the other group passes the root's rank within
+the remote group.
+
+Only this component qualifies on intercomms; the intra components
+(basic/tuned/libnbc/accelerator/xla) disqualify themselves — their
+algorithms assume a single group.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu import op as op_mod
+from ompi_tpu.coll import CollModule, framework
+from ompi_tpu.comm.intercomm import ROOT
+from ompi_tpu.core import pvar
+from ompi_tpu.pml.request import PROC_NULL
+
+
+def _leader(comm) -> bool:
+    return comm.rank == 0
+
+
+def inter_barrier(comm) -> None:
+    """Local barrier, leader token exchange, local barrier
+    (coll_inter_barrier semantics)."""
+    pvar.record("inter_barrier")
+    comm.local_comm.Barrier()
+    if _leader(comm):
+        comm.sendrecv(None, dest=0, source=0, sendtag=-22, recvtag=-22)
+    comm.local_comm.Barrier()
+
+
+def inter_bcast_obj(comm, obj, root):
+    pvar.record("inter_bcast")
+    if root == PROC_NULL:
+        return None  # non-root member of the root group
+    if root == ROOT:
+        comm.send(obj, dest=0, tag=-23)  # to remote leader
+        return obj
+    # receiving group: leader pulls from the remote root, local bcast
+    if _leader(comm):
+        obj = comm.recv(source=root, tag=-23)
+    return comm.local_comm.bcast(obj, root=0)
+
+
+def inter_bcast(comm, buf, count, dtype, root) -> None:
+    if root == PROC_NULL:
+        return
+    if root == ROOT:
+        comm.Send((buf, count, dtype), dest=0, tag=-23)
+        return
+    if _leader(comm):
+        comm.Recv((buf, count, dtype), source=root, tag=-23)
+    comm.local_comm.Bcast((buf, count, dtype), root=0)
+
+
+def inter_allreduce(comm, sendbuf, recvbuf, count, dtype, op) -> None:
+    """Each group receives the reduction of the OTHER group's vectors
+    (MPI inter-allreduce): local reduce -> leader swap -> local bcast."""
+    pvar.record("inter_allreduce")
+    local = comm.local_comm
+    sb = np.asarray(sendbuf)
+    mine = np.empty_like(sb)
+    local.Reduce(sb, mine, op=op, root=0)
+    rb = np.asarray(recvbuf)
+    if _leader(comm):
+        rreq = comm.Irecv((rb, count, dtype), source=0, tag=-24)
+        comm.Send((mine, count, dtype), dest=0, tag=-24)
+        rreq.wait()
+    local.Bcast((rb, count, dtype), root=0)
+
+
+def inter_allgather(comm, sendbuf, recvbuf, count, dtype) -> None:
+    """recvbuf receives the REMOTE group's contributions
+    (remote_size * count elements)."""
+    pvar.record("inter_allgather")
+    local = comm.local_comm
+    sb = np.asarray(sendbuf)
+    gathered = np.empty((local.size,) + sb.shape, sb.dtype) \
+        if _leader(comm) else None
+    local.Gather(sb, gathered, root=0)
+    rb = np.asarray(recvbuf)
+    if _leader(comm):
+        rreq = comm.Irecv((rb, rb.size, dtype), source=0, tag=-25)
+        comm.Send((gathered, gathered.size, dtype), dest=0, tag=-25)
+        rreq.wait()
+    local.Bcast((rb, rb.size, dtype), root=0)
+
+
+def inter_allgather_obj(comm, obj):
+    pvar.record("inter_allgather")
+    local = comm.local_comm
+    mine = local.gather(obj, root=0)
+    if _leader(comm):
+        theirs = comm.sendrecv(mine, dest=0, source=0,
+                               sendtag=-26, recvtag=-26)
+    else:
+        theirs = None
+    return local.bcast(theirs, root=0)
+
+
+@framework.register
+class CollInter(CollModule):
+    NAME = "inter"
+    PRIORITY = 45
+    INTER_OK = True  # the whole point: group-vs-group algorithms
+
+    def query(self, comm) -> int:
+        # the only component that serves intercomms; never intra
+        return self.PRIORITY if getattr(comm, "is_inter", False) else -1
+
+    def slots(self, comm):
+        return {
+            "barrier": inter_barrier,
+            "bcast": inter_bcast,
+            "bcast_obj": inter_bcast_obj,
+            "allreduce": inter_allreduce,
+            "allgather": inter_allgather,
+            "allgather_obj": inter_allgather_obj,
+        }

@@ -1,0 +1,68 @@
+"""coll/sync — barrier-injection debug component (race smoker).
+
+Reference: ompi/mca/coll/sync (925 LoC): when enabled, interposes on
+collectives and injects an MPI_Barrier before every Nth operation, to
+flush out applications relying on unsynchronized collective timing
+(e.g. a bcast racing a later p2p). Priority puts it ABOVE every real
+component; the installed slot wraps whatever was stacked underneath.
+
+Enable: --mca coll_sync_barrier_before N  (0 = off, the default).
+"""
+
+from __future__ import annotations
+
+from ompi_tpu.core import cvar, pvar
+from ompi_tpu.coll import CollModule, SLOTS, framework
+
+_before_var = cvar.register(
+    "coll_sync_barrier_before", 0, int,
+    help="Inject a barrier before every Nth collective (0=off). "
+         "Debug aid for flushing collective/p2p races "
+         "(reference: coll/sync).", level=7)
+
+#: slots never wrapped: wrapping barrier with barrier is recursion,
+#: and *_dev device slots take different signatures
+_SKIP = {"barrier", "ibarrier"}
+
+
+class _Wrapped:
+    """One wrapped slot; counts calls per comm, barriers every Nth."""
+
+    def __init__(self, inner, table) -> None:
+        self._inner = inner
+        self._table = table  # the table's real barrier (post-stack)
+
+    def __call__(self, comm, *args, **kwargs):
+        n = _before_var.get()
+        if n > 0:
+            self._table.calls += 1
+            if self._table.calls % n == 0:
+                pvar.record("sync_injected_barriers")
+                self._table.fns["barrier"](comm)
+        return self._inner(comm, *args, **kwargs)
+
+
+@framework.register
+class CollSync(CollModule):
+    NAME = "sync"
+    PRIORITY = 90  # above everything: interposition (reference: sync
+    # must out-prioritize the components it wraps)
+    INTER_OK = True
+
+    def query(self, comm) -> int:
+        return self.PRIORITY if _before_var.get() > 0 else -1
+
+    def slots(self, comm):
+        return {}  # interposition happens in post_stack, which sees
+        # the fully-stacked table (slots() would see a partial one)
+
+    def post_stack(self, comm, table) -> None:
+        """Wrap every host collective slot already stacked."""
+        table.calls = 0  # explicit: CollTable.__getattr__ raises for
+        # unknown names, so getattr-with-default doesn't apply
+        for name in list(table.fns):
+            if name in _SKIP or name.endswith("_dev"):
+                continue
+            if name in SLOTS or name.startswith("i"):
+                table.fns[name] = _Wrapped(table.fns[name], table)
+                table.providers[name] = f"sync({table.providers[name]})"

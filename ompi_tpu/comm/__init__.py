@@ -218,6 +218,39 @@ class Communicator:
         if self.revoked:
             raise errors.RevokedError()
 
+    def check_failed(self) -> None:
+        """Collective-entry FT gate (see ft.check_comm_failed); p2p
+        paths must NOT call this — sends/recvs among survivors stay
+        legal after a failure."""
+        from ompi_tpu.ft import check_comm_failed
+
+        check_comm_failed(self)
+
+    def shrink(self) -> "Communicator":
+        """MPIX_Comm_shrink."""
+        from ompi_tpu.ft import shrink as _shrink
+
+        return _shrink(self)
+
+    def agree(self, flag: int):
+        """MPIX_Comm_agree -> (flag AND-combined over survivors,
+        failed comm ranks)."""
+        from ompi_tpu.ft import agree as _agree
+
+        return _agree(self, flag)
+
+    def get_failed(self):
+        """MPIX_Comm_get_failed -> sorted failed comm ranks."""
+        from ompi_tpu.ft import get_failed as _get_failed
+
+        return _get_failed(self)
+
+    def ack_failed(self) -> int:
+        """MPIX_Comm_ack_failed -> number of failures acknowledged."""
+        from ompi_tpu.ft import ack_failed as _ack_failed
+
+        return _ack_failed(self)
+
     # -- internal p2p helpers used before coll exists ---------------------
     def _gather_obj(self, obj, root: int):
         from ompi_tpu import pml

@@ -1,0 +1,169 @@
+"""Intercommunicators + dynamic process connect/accept (dpm-lite).
+
+Reference: ompi/communicator/comm.c (intercomm create/merge),
+ompi/mca/coll/inter + coll/basic's inter algorithms (local reduce ->
+leader exchange -> local bcast), ompi/dpm/dpm.c:386 (connect/accept
+rendezvous through the naming service — here the kv store plays ompi's
+PMIx publish/lookup role).
+
+An intercommunicator binds a *local* group and a *remote* group under
+one CID: p2p ranks address the remote group; collectives have
+group-vs-group semantics (each side receives the other side's
+contribution). A private local intracomm (built from the local group at
+creation, as the reference's comm->c_local_comm) carries the
+local phases of the inter algorithms.
+
+Scope note: connect/accept pairs any two disjoint rank sets *within a
+job's store* (the launcher can also share one store across jobs via
+``tpurun --store``); MPI_Comm_spawn's process-starting side is the
+launcher's domain, not the communicator layer's.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ompi_tpu import errors
+from ompi_tpu.comm import (Communicator, Group, alloc_cid,
+                           comm_create_from_group)
+from ompi_tpu.runtime import rte
+
+#: MPI_ROOT / MPI_PROC_NULL sentinels for inter-collective root args
+ROOT = -4
+
+
+class Intercommunicator(Communicator):
+    """Communicator with distinct local and remote groups."""
+
+    is_inter = True
+
+    def __init__(self, local_group: Group, remote_group: Group,
+                 cid: int, errhandler=None) -> None:
+        if set(local_group.ranks) & set(remote_group.ranks):
+            raise ValueError(
+                "intercomm groups must be disjoint (MPI_ERR_COMM)")
+        # remote_group must exist before Communicator.__init__ runs
+        # comm_select (components may inspect it)
+        self.remote_group = remote_group
+        super().__init__(local_group, cid,
+                         errhandler or errors.ERRORS_ARE_FATAL)
+        self.name = f"intercomm#{cid}"
+        # the local phases of inter collectives ride a private
+        # intracomm over the local group (reference: c_local_comm)
+        self.local_comm = comm_create_from_group(
+            local_group, tag=f"icl:{cid}")
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def remote_size(self) -> int:
+        return self.remote_group.size
+
+    def world_rank(self, rank: int) -> int:
+        """p2p destination ranks index the REMOTE group."""
+        return self.remote_group.ranks[rank]
+
+    # -- MPI_Intercomm_merge ---------------------------------------------
+    def merge(self, high: bool = False) -> Communicator:
+        """Union intracomm; the `low` side's ranks come first. Ties
+        (both sides claim the same polarity) break by smallest world
+        rank, as the reference does."""
+        flags = self.local_comm.allgather(bool(high))
+        my_high = flags[0]
+        # exchange polarity with the remote side (leaders, then bcast)
+        if self.rank == 0:
+            their_high = self.sendrecv(my_high, dest=0, source=0,
+                                       sendtag=-21, recvtag=-21)
+        else:
+            their_high = None
+        their_high = self.local_comm.bcast(their_high, root=0)
+        mine, theirs = list(self.group.ranks), list(self.remote_group.ranks)
+        if my_high == their_high:
+            first = mine if min(mine) < min(theirs) else theirs
+        else:
+            first = theirs if my_high else mine
+        second = theirs if first is mine else mine
+        merged = Group(first + second)
+        return comm_create_from_group(merged, tag=f"imerge:{self.cid}")
+
+
+def intercomm_create(local_comm: Communicator, local_leader: int,
+                     peer_comm: Communicator, remote_leader: int,
+                     tag: int = 0) -> Intercommunicator:
+    """MPI_Intercomm_create: leaders exchange groups through peer_comm,
+    agree a CID, then broadcast locally (comm.c:ompi_intercomm_create)."""
+    me_leader = local_comm.rank == local_leader
+    if me_leader:
+        mine = list(local_comm.group.ranks)
+        other = peer_comm.sendrecv(mine, dest=remote_leader,
+                                   source=remote_leader,
+                                   sendtag=tag, recvtag=tag)
+        # disjoint groups guarantee distinct minima: smaller-min leader
+        # allocates the shared CID
+        if min(mine) < min(other):
+            cid = alloc_cid()
+            peer_comm.send(cid, remote_leader, tag)
+        else:
+            cid = peer_comm.recv(source=remote_leader, tag=tag)
+        data = (other, cid)
+    else:
+        data = None
+    other, cid = local_comm.bcast(data, root=local_leader)
+    return Intercommunicator(Group(local_comm.group.ranks),
+                             Group(other), cid)
+
+
+# ---------------------------------------------------------------------------
+# dpm-lite: Open_port / Comm_accept / Comm_connect over the store
+# (reference: ompi/dpm/dpm.c:386 connect/accept; the store's atomic
+# keyspace replaces PMIx publish/lookup)
+
+
+def open_port(name: Optional[str] = None) -> str:
+    """MPI_Open_port: a store-unique rendezvous name."""
+    if name is None:
+        name = f"port:{rte.jobid}:{rte.next_id('port')}"
+    return name
+
+
+def _port_rendezvous(port: str, comm: Communicator, root: int,
+                     side: str) -> Intercommunicator:
+    """Publish my group on my side's key, wait for the peer's, agree
+    the CID through the store (accept side allocates)."""
+    client = rte.client()
+    me_root = comm.rank == root
+    if me_root:
+        client.put(f"{port}:{side}", list(comm.group.ranks))
+        other_side = "connect" if side == "accept" else "accept"
+        other = client.get(f"{port}:{other_side}", wait=True)
+        if side == "accept":
+            cid = alloc_cid()
+            client.put(f"{port}:cid", cid)
+        else:
+            cid = client.get(f"{port}:cid", wait=True)
+        data = (other, cid)
+    else:
+        data = None
+    other, cid = comm.bcast(data, root=root)
+    return Intercommunicator(Group(comm.group.ranks), Group(other), cid)
+
+
+def comm_accept(port: str, comm: Communicator,
+                root: int = 0) -> Intercommunicator:
+    return _port_rendezvous(port, comm, root, "accept")
+
+
+def comm_connect(port: str, comm: Communicator,
+                 root: int = 0) -> Intercommunicator:
+    return _port_rendezvous(port, comm, root, "connect")
+
+
+def _attach() -> None:
+    Communicator.is_inter = False
+    Communicator.remote_group = None
+    Communicator.Intercomm_merge = lambda self, high=False: \
+        self.merge(high)
+
+
+_attach()

@@ -59,10 +59,21 @@ class RankError(MPIError):
 class ProcFailedError(MPIError):
     """ULFM MPI_ERR_PROC_FAILED."""
 
-    def __init__(self, ranks=(), msg: str = "") -> None:
+    def __init__(self, msg: str = "", ranks=()) -> None:
         self.failed_ranks = tuple(ranks)
         super().__init__(ERR_PROC_FAILED,
                          msg or f"process failure: ranks {ranks}")
+
+
+class ProcFailedPendingError(ProcFailedError):
+    """ULFM MPI_ERR_PROC_FAILED_PENDING — a wildcard receive is parked
+    by an unacknowledged failure; MPIX_Comm_ack_failed + repost
+    recovers it (unlike the permanent ERR_PROC_FAILED)."""
+
+    def __init__(self, msg: str = "", ranks=()) -> None:
+        super().__init__(msg or "unacknowledged process failure "
+                         "pending on a wildcard receive", ranks)
+        self.error_class = ERR_PROC_FAILED_PENDING
 
 
 class RevokedError(MPIError):
@@ -76,6 +87,8 @@ _CLASS_MAP = {
     ERR_TRUNCATE: TruncateError,
     ERR_RANK: RankError,
     ERR_REVOKED: RevokedError,
+    ERR_PROC_FAILED: ProcFailedError,
+    ERR_PROC_FAILED_PENDING: ProcFailedPendingError,
 }
 
 

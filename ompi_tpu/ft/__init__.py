@@ -1,31 +1,94 @@
-"""Fault tolerance — ULFM-style revoke/shrink/agree + failure detector.
+"""Fault tolerance — ULFM revoke/shrink/agree + failure detector.
 
 Reference: ompi/communicator/ft/ (heartbeat ring detector
 comm_ft_detector.c:30-74, reliable failure propagation
-comm_ft_propagator.c, revoke) and ompi/mpiext/ftmpi (MPIX API),
-coll/ftagree (early-returning agreement).
+comm_ft_propagator.c, revoke comm_ft_revoke.c), ompi/mpiext/ftmpi
+(the MPIX_* API surface), coll/ftagree (early-returning agreement, ERA).
 
-This module starts as revoke propagation + shrink + agreement over the
-store; the heartbeat detector lands with the detector submodule.
+TPU-first redesign: the rendezvous store is the reliable always-on
+daemon (the PRRTE/PMIx-server analog), so
+  - detection is launcher waitpid + star heartbeats (ft.detector),
+  - revocation propagates via a store key + job-wide epoch counter
+    instead of a flooded reliable broadcast,
+  - agreement consistency comes from the store freezing ONE result per
+    (comm, epoch) — every caller observes the same value/failure split,
+    which is exactly the guarantee ERA's resilient tree provides.
+A store failure takes the job down — the same single-point contract the
+reference has with its PMIx server.
 """
 
 from __future__ import annotations
 
-from typing import List, Set
+from typing import Dict, List, Tuple
 
+from ompi_tpu import errors
 from ompi_tpu.runtime import rte
+from ompi_tpu.ft import detector  # noqa: F401  (re-export)
+
+# per-comm operation epochs: ULFM requires all members to call
+# agree/shrink in the same order, so local counters align globally
+_agree_epochs: Dict[int, int] = {}
+_shrink_epochs: Dict[int, int] = {}
 
 
 def _revoke_key(comm) -> str:
     return f"ft:revoked:{rte.jobid}:{comm.cid}"
 
 
+def _hb_timeout() -> float:
+    return detector._timeout_var.get()
+
+
+# -- failure observation --------------------------------------------------
+
+def faults() -> Dict[int, str]:
+    """World ranks known failed (launcher-declared + heartbeat-stale)."""
+    d = detector.get()
+    if d is not None:
+        # fresh query on the detector's own connection; also promotes
+        # stale ranks so the answer is current, not one period old
+        return d._client.faults(d.hb_timeout)
+    return rte.client().faults(None)
+
+
+def get_failed(comm) -> List[int]:
+    """MPIX_Comm_get_failed: failed ranks of this comm's group, as comm
+    ranks, sorted."""
+    dead = faults()
+    return sorted(i for i, world in enumerate(comm.group.ranks)
+                  if world in dead)
+
+
+def ack_failed(comm) -> int:
+    """MPIX_Comm_ack_failed: acknowledge current failures so wildcard
+    receives may be reposted; returns the number acknowledged. The
+    PML's acked set is the single source of truth (its wildcard-post
+    gate reads it)."""
+    failed = get_failed(comm)
+    from ompi_tpu import pml
+
+    inst = pml.instance()
+    if inst is not None and hasattr(inst, "acked"):
+        inst.acked |= {comm.group.ranks[i] for i in failed}
+    return len(failed)
+
+
+# -- revocation -----------------------------------------------------------
+
 def revoke(comm) -> None:
-    """MPIX_Comm_revoke: mark + propagate through the store (the
-    reference floods a reliable bcast; the store is our reliable
-    propagation channel)."""
+    """MPIX_Comm_revoke: mark + propagate. The store key is the
+    reliable-broadcast payload; the epoch counter is the doorbell
+    observers poll (ft.detector._run)."""
     comm.revoked = True
-    rte.client().put(_revoke_key(comm), True)
+    client = rte.client()
+    client.put(_revoke_key(comm), True)
+    client.inc(f"ft:rev_epoch:{rte.jobid}")
+    # drain our own in-flight requests immediately
+    from ompi_tpu import pml
+
+    fn = getattr(pml.instance(), "on_revoke", None)
+    if fn is not None:
+        fn(comm.cid)
 
 
 def check_remote_revoked(comm) -> bool:
@@ -36,24 +99,70 @@ def check_remote_revoked(comm) -> bool:
     return comm.revoked
 
 
+# -- agreement + shrink ---------------------------------------------------
+
+def agree(comm, flag: int) -> Tuple[int, List[int]]:
+    """MPIX_Comm_agree: returns (AND of all live contributions, failed
+    comm ranks at decision time). Every caller gets the SAME answer —
+    the store freezes one result per (comm, epoch) (see kvstore
+    ftgather). Works on revoked communicators, per ULFM."""
+    epoch = _agree_epochs.get(comm.cid, 0)
+    _agree_epochs[comm.cid] = epoch + 1
+    tag = f"ftagree:{rte.jobid}:{comm.cid}:{epoch}"
+    contribs, dead = rte.client().ftgather(
+        tag, rte.rank, int(flag), comm.group.ranks,
+        hb_timeout=_hb_timeout())
+    result = ~0
+    for v in contribs.values():
+        result &= v
+    failed = sorted(i for i, world in enumerate(comm.group.ranks)
+                    if world in dead)
+    return result, failed
+
+
 def shrink(comm):
-    """MPIX_Comm_shrink: agree on the alive group, build a new comm."""
+    """MPIX_Comm_shrink: agree on the surviving group, build a new comm.
+    The contributor set IS the agreed alive set — consistent across all
+    callers by the ftgather freeze."""
+    epoch = _shrink_epochs.get(comm.cid, 0)
+    _shrink_epochs[comm.cid] = epoch + 1
+    tag = f"ftshrink:{rte.jobid}:{comm.cid}:{epoch}"
+    contribs, dead = rte.client().ftgather(
+        tag, rte.rank, True, comm.group.ranks,
+        hb_timeout=_hb_timeout())
     from ompi_tpu import comm as comm_mod
 
-    alive: List[int] = sorted(agree_alive(comm))
+    # a rank can contribute and THEN die before the gather freezes —
+    # it appears in both sets and must not enter the survivor group
+    alive = sorted(r for r in contribs if r not in dead)
     group = comm_mod.Group(alive)
     return comm_mod.comm_create_from_group(
-        group, tag=f"shrink:{comm.cid}")
+        group, tag=f"shrink:{comm.cid}:{epoch}")
 
 
-def agree_alive(comm) -> Set[int]:
-    """Best-effort alive-set agreement via store heartbeat keys."""
-    client = rte.client()
-    key = f"ft:alive:{rte.jobid}:{comm.cid}:{rte.rank}"
-    client.put(key, True)
-    alive = set()
-    for r in comm.group.ranks:
-        if client.get(f"ft:alive:{rte.jobid}:{comm.cid}:{r}",
-                      wait=False):
-            alive.add(r)
-    return alive
+def check_comm_failed(comm) -> None:
+    """Per-API FT check for collectives (reference: the FT gate every
+    blocking API runs, ompi/mpi/c/allreduce.c:101-109): a collective
+    over a group with a failed member raises ERR_PROC_FAILED — the app
+    must shrink to keep doing collectives (acknowledgement only
+    revives wildcard p2p, per ULFM). Cheap: reads the detector's local
+    snapshot via the PML's failed set — no store RPC.
+
+    failed_ranks reports COMM ranks (matching get_failed); on an
+    intercommunicator both groups are checked and remote failures are
+    reported as remote-group indices."""
+    from ompi_tpu import pml
+
+    failed = getattr(pml.instance(), "failed", None)
+    if not failed:
+        return
+    bad = [i for i, w in enumerate(comm.group.ranks) if w in failed]
+    where = "local group"
+    if not bad and getattr(comm, "is_inter", False):
+        bad = [i for i, w in enumerate(comm.remote_group.ranks)
+               if w in failed]
+        where = "remote group"
+    if bad:
+        raise errors.ProcFailedError(
+            ranks=tuple(bad),
+            msg=f"process failure in {where}: comm ranks {bad}")

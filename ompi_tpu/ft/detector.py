@@ -1,0 +1,188 @@
+"""Failure detector — heartbeat emitter + fault/revocation observer.
+
+Reference: ompi/communicator/ft/comm_ft_detector.c:30-74 — a ring where
+each process emits heartbeats to its successor and observes its
+predecessor, with tunable period/timeout; failure news then spreads via
+reliable broadcast (comm_ft_propagator.c). Runtime-level detection is
+PRTE's job (docs/features/ulfm.rst:260-262).
+
+TPU-first redesign: the rendezvous store is the always-on daemon plane
+(the PRRTE analog), so detection is star-shaped rather than a ring —
+every rank heartbeats the store, the store judges staleness with ONE
+monotonic clock (no cross-host clock skew), and the launcher's waitpid
+feeds instant, authoritative death notices into the same dead set. The
+observer half polls the store from a dedicated thread and leaves a
+snapshot; a progress-engine callback applies it on the MPI thread (the
+PML is single-threaded, like the reference's progress sweep).
+
+Revocation rides the same poll: MPIX_Comm_revoke bumps a job-wide
+epoch counter; observers re-read per-comm revoke keys only when the
+epoch moves (the reliable-bcast equivalent, one RPC per period).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Set
+
+from ompi_tpu.core import cvar, output, progress
+from ompi_tpu.runtime import kvstore, rte
+
+_out = output.stream("ft")
+
+_ft_var = cvar.register(
+    "ft", False, bool,
+    help="Enable ULFM fault tolerance: heartbeat detector + failure "
+         "sweeps. Set by tpurun --mca ft 1.", level=3)
+_period_var = cvar.register(
+    "ft_heartbeat_period", 0.05, float,
+    help="Heartbeat emission/observation period in seconds "
+         "(reference: detector period, comm_ft_detector.c).", level=6)
+_timeout_var = cvar.register(
+    "ft_heartbeat_timeout", 1.0, float,
+    help="Seconds without a heartbeat before a rank is declared dead "
+         "(reference: detector timeout).", level=6)
+
+_detector: Optional["Detector"] = None
+
+
+def enabled() -> bool:
+    return _ft_var.get()
+
+
+def start() -> "Detector":
+    """Start (or return) the process-wide detector."""
+    global _detector
+    if _detector is None:
+        _detector = Detector()
+        _detector.start()
+    return _detector
+
+
+def stop() -> None:
+    global _detector
+    if _detector is not None:
+        _detector.stop()
+        _detector = None
+
+
+def get() -> Optional["Detector"]:
+    return _detector
+
+
+class Detector:
+    """Emitter thread + observer snapshot + progress-side applier."""
+
+    def __init__(self) -> None:
+        self.period = _period_var.get()
+        self.hb_timeout = _timeout_var.get()
+        # observer snapshot (written by the thread, read by the sweep)
+        self.dead: Dict[int, str] = {}
+        self.revoked_cids: Set[int] = set()
+        self._applied_dead: Set[int] = set()
+        self._applied_revokes: Set[int] = set()
+        self._rev_epoch = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # a dedicated store connection: the emitter must never queue
+        # behind a blocking RPC on the shared rte client socket
+        self._client = kvstore.Client(rte.client().addr)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="ompi-tpu-ft-detector", daemon=True)
+        self._thread.start()
+        progress.register(self._sweep)
+
+    def stop(self) -> None:
+        self._stop.set()
+        progress.unregister(self._sweep)
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.period + 1)
+        self._client.close()
+
+    # -- emitter/observer thread -----------------------------------------
+    def _run(self) -> None:
+        failures = 0
+        while not self._stop.wait(self.period):
+            try:
+                self._client.heartbeat(rte.rank)
+                self.dead = self._client.faults(self.hb_timeout)
+                epoch = self._client.inc(
+                    f"ft:rev_epoch:{rte.jobid}", 0)
+                if epoch != self._rev_epoch:
+                    self._rev_epoch = epoch
+                    self._poll_revokes()
+                failures = 0
+            except Exception as exc:  # noqa: BLE001
+                if self._stop.is_set():
+                    return  # normal shutdown race
+                failures += 1
+                _out.verbose(1, "detector RPC failed (%d/3): %s",
+                             failures, exc)
+                if failures < 3:
+                    # transient (reset, timeout under load): reconnect
+                    # and keep observing — silently dying here would
+                    # blind this rank to failures AND let peers declare
+                    # it stale-dead
+                    try:
+                        self._client.close()
+                        self._client = kvstore.Client(rte.client().addr)
+                        continue
+                    except Exception:  # noqa: BLE001
+                        pass
+                from ompi_tpu.util import show_help
+
+                show_help.show(
+                    "ft", "detector-dead", rank=rte.rank, error=str(exc))
+                return  # store unreachable: the job is coming down
+
+    def _poll_revokes(self) -> None:
+        from ompi_tpu import comm as comm_mod
+        from ompi_tpu.ft import _revoke_key
+
+        with comm_mod._comms_lock:
+            cids = {c.cid: c for c in comm_mod._comms.values()}
+        for cid, c in cids.items():
+            if cid in self.revoked_cids:
+                continue
+            if self._client.get(_revoke_key(c), wait=False):
+                self.revoked_cids.add(cid)
+
+    # -- progress-engine applier (MPI thread) ----------------------------
+    def _sweep(self) -> int:
+        """Apply new faults/revocations to PML + communicator state."""
+        events = 0
+        new_dead = {r: why for r, why in self.dead.items()
+                    if r not in self._applied_dead}
+        if new_dead:
+            self._applied_dead.update(new_dead)
+            _out.verbose(1, "rank %d: failures detected: %s",
+                         rte.rank, new_dead)
+            events += self._apply_faults(set(new_dead))
+        new_rev = self.revoked_cids - self._applied_revokes
+        if new_rev:
+            self._applied_revokes |= new_rev
+            events += self._apply_revokes(new_rev)
+        return events
+
+    def _apply_faults(self, dead: Set[int]) -> int:
+        from ompi_tpu import pml
+
+        fn = getattr(pml.instance(), "on_fault", None)
+        return fn(dead) if fn is not None else 0
+
+    def _apply_revokes(self, cids: Set[int]) -> int:
+        from ompi_tpu import comm as comm_mod, pml
+
+        events = 0
+        fn = getattr(pml.instance(), "on_revoke", None)
+        for cid in cids:
+            c = comm_mod.lookup_cid(cid)
+            if c is not None and not c.revoked:
+                c.revoked = True
+                events += 1
+            if fn is not None:
+                events += fn(cid)
+        return events

@@ -111,7 +111,10 @@ def _check_rank(comm, rank: int, allow_null: bool = True) -> None:
         return
     if rank == ANY_SOURCE:
         return
-    if not 0 <= rank < comm.size:
+    # intercomm p2p addresses the remote group
+    n = comm.remote_group.size if getattr(comm, "is_inter", False) \
+        else comm.size
+    if not 0 <= rank < n:
         raise errors.RankError(f"rank {rank} out of range for {comm}")
 
 
@@ -291,11 +294,13 @@ def _is_dev(buf) -> bool:
 
 def _Barrier(self) -> None:
     self.check_revoked()
+    self.check_failed()
     self.coll.barrier(self)
 
 
 def _Bcast(self, buf, root: int = 0):
     self.check_revoked()
+    self.check_failed()
     if _is_dev(buf):
         return self.coll.bcast_dev(self, buf, root)
     arr, count, dt = _parse_buf(buf)
@@ -305,6 +310,7 @@ def _Bcast(self, buf, root: int = 0):
 def _Reduce(self, sendbuf, recvbuf=None, op=op_mod.SUM, root: int = 0,
             deterministic=None):
     self.check_revoked()
+    self.check_failed()
     if _is_dev(sendbuf):
         return self.coll.reduce_dev(self, sendbuf, op, root,
                                     deterministic=deterministic)
@@ -322,6 +328,7 @@ def _Allreduce(self, sendbuf, recvbuf=None, op=op_mod.SUM,
     reduction; 'ring'/'linear' fix the operand order (coll/xla) —
     'linear' is bit-identical to the host linear fold."""
     self.check_revoked()
+    self.check_failed()
     if _is_dev(sendbuf):
         return self.coll.allreduce_dev(self, sendbuf, op,
                                        deterministic=deterministic)
@@ -336,6 +343,7 @@ def _Allreduce(self, sendbuf, recvbuf=None, op=op_mod.SUM,
 
 def _Gather(self, sendbuf, recvbuf=None, root: int = 0):
     self.check_revoked()
+    self.check_failed()
     if _is_dev(sendbuf):
         return self.coll.gather_dev(self, sendbuf, root)
     sarr, count, dt = _parse_buf(sendbuf)
@@ -346,6 +354,7 @@ def _Gather(self, sendbuf, recvbuf=None, root: int = 0):
 def _Gatherv(self, sendbuf, recvbuf, counts, displs=None,
              root: int = 0) -> None:
     self.check_revoked()
+    self.check_failed()
     sarr = _parse_buf(sendbuf)[0]
     rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
     if displs is None:
@@ -359,6 +368,7 @@ def _Scatter(self, sendbuf, recvbuf=None, root: int = 0,
     """``device=True`` lets non-roots (who pass no buffers) opt into the
     device path explicitly; the root is auto-detected from sendbuf."""
     self.check_revoked()
+    self.check_failed()
     if _is_dev(sendbuf) or device:
         return self.coll.scatter_dev(self, sendbuf, root)
     rarr, count, dt = _parse_buf(recvbuf)
@@ -369,6 +379,7 @@ def _Scatter(self, sendbuf, recvbuf=None, root: int = 0,
 def _Scatterv(self, sendbuf, recvbuf, counts, displs=None,
               root: int = 0) -> None:
     self.check_revoked()
+    self.check_failed()
     rarr = _parse_buf(recvbuf)[0]
     sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
     if displs is None:
@@ -379,6 +390,7 @@ def _Scatterv(self, sendbuf, recvbuf, counts, displs=None,
 
 def _Allgather(self, sendbuf, recvbuf=None):
     self.check_revoked()
+    self.check_failed()
     if _is_dev(sendbuf):
         return self.coll.allgather_dev(self, sendbuf)
     sarr, count, dt = _parse_buf(sendbuf)
@@ -388,6 +400,7 @@ def _Allgather(self, sendbuf, recvbuf=None):
 
 def _Allgatherv(self, sendbuf, recvbuf, counts, displs=None) -> None:
     self.check_revoked()
+    self.check_failed()
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if displs is None:
@@ -398,6 +411,7 @@ def _Allgatherv(self, sendbuf, recvbuf, counts, displs=None) -> None:
 
 def _Alltoall(self, sendbuf, recvbuf=None):
     self.check_revoked()
+    self.check_failed()
     if _is_dev(sendbuf):
         return self.coll.alltoall_dev(self, sendbuf)
     sarr = _parse_buf(sendbuf)[0]
@@ -409,6 +423,7 @@ def _Alltoall(self, sendbuf, recvbuf=None):
 def _Alltoallv(self, sendbuf, recvbuf, scounts, rcounts,
                sdispls=None, rdispls=None) -> None:
     self.check_revoked()
+    self.check_failed()
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if sdispls is None:
@@ -422,6 +437,7 @@ def _Alltoallv(self, sendbuf, recvbuf, scounts, rcounts,
 def _Reduce_scatter_block(self, sendbuf, recvbuf=None, op=op_mod.SUM,
                           deterministic=None):
     self.check_revoked()
+    self.check_failed()
     if _is_dev(sendbuf):
         return self.coll.reduce_scatter_block_dev(
             self, sendbuf, op, deterministic=deterministic)
@@ -432,6 +448,7 @@ def _Reduce_scatter_block(self, sendbuf, recvbuf=None, op=op_mod.SUM,
 
 def _Reduce_scatter(self, sendbuf, recvbuf, counts, op=op_mod.SUM) -> None:
     self.check_revoked()
+    self.check_failed()
     rarr = _parse_buf(recvbuf)[0]
     sarr = _parse_buf(sendbuf)[0]
     self.coll.reduce_scatter(self, sarr, rarr, counts,
@@ -440,6 +457,7 @@ def _Reduce_scatter(self, sendbuf, recvbuf, counts, op=op_mod.SUM) -> None:
 
 def _Scan(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
     self.check_revoked()
+    self.check_failed()
     sarr, count, dt = _parse_buf(sendbuf)
     rarr = _parse_buf(recvbuf)[0]
     self.coll.scan(self, sarr, rarr, count, dt, op)
@@ -447,6 +465,7 @@ def _Scan(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
 
 def _Exscan(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
     self.check_revoked()
+    self.check_failed()
     sarr, count, dt = _parse_buf(sendbuf)
     rarr = _parse_buf(recvbuf)[0]
     self.coll.exscan(self, sarr, rarr, count, dt, op)
@@ -510,6 +529,7 @@ def _barrier(self) -> None:
 
 def _bcast(self, obj=None, root: int = 0):
     self.check_revoked()
+    self.check_failed()
     return self.coll.bcast_obj(self, obj, root)
 
 
@@ -595,6 +615,20 @@ from ompi_tpu import topo as _topo  # noqa: E402,F401
 
 # partitioned p2p (MPI-4 Psend_init/Precv_init — ompi/mca/part equiv)
 from ompi_tpu.pml import part as _part  # noqa: E402,F401
+
+# intercommunicators + dynamic processes (ompi/communicator + dpm)
+from ompi_tpu.comm.intercomm import (  # noqa: E402,F401
+    ROOT, Intercommunicator, comm_accept as Comm_accept,
+    comm_connect as Comm_connect, intercomm_create as Intercomm_create,
+    open_port as Open_port,
+)
+
+# MPI-IO (ompio equivalent: ompi/mca/io + fs/fbtl/fcoll/sharedfp)
+from ompi_tpu.io import (  # noqa: E402,F401
+    File, File_delete, File_open, MODE_APPEND, MODE_CREATE,
+    MODE_DELETE_ON_CLOSE, MODE_EXCL, MODE_RDONLY, MODE_RDWR,
+    MODE_SEQUENTIAL, MODE_WRONLY, SEEK_CUR, SEEK_END, SEEK_SET,
+)
 
 
 # ---------------------------------------------------------------------------

@@ -31,6 +31,11 @@ def current():
     return _pml
 
 
+def instance() -> Optional[object]:
+    """The selected PML, or None if none selected yet (no side effects)."""
+    return _pml
+
+
 def set_current(pml) -> None:
     """Install an interposition PML (reference: pml/monitoring, pml/v)."""
     global _pml

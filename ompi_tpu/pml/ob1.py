@@ -166,6 +166,12 @@ class Ob1:
         # (a peer can finish comm creation and send before we do —
         # reference ob1 queues "non-existing communicator" fragments)
         self.early_frames: Dict[int, list] = {}
+        # ULFM: world ranks known to have failed (fed by ft.detector;
+        # reference: ob1 request FT sweep, ompi/request/req_ft.c) and
+        # failures the app acknowledged (MPIX_Comm_ack_failed) — acked
+        # failures no longer poison wildcard receives
+        self.failed: set = set()
+        self.acked: set = set()
 
     # -- lifecycle --------------------------------------------------------
     def enable(self) -> None:
@@ -213,6 +219,9 @@ class Ob1:
         if sync:
             flags |= FLAG_SYNC
         dst_world = comm.world_rank(dst)
+        if dst_world in self.failed:
+            req.complete(errors.ERR_PROC_FAILED)
+            return req
         src_commrank = comm.rank
         seq = self._next_seq(ctx, dst)
         size = conv.packed_size
@@ -271,6 +280,10 @@ class Ob1:
             dtype = dtype_of(buf)
         req = RecvRequest(ctx, src, tag, buf, count, dtype, False)
         pvar.record("irecv")
+        err = self._recv_src_failed(comm, src)
+        if err:
+            req.complete(err)
+            return req
         self._post(req)
         return req
 
@@ -279,8 +292,30 @@ class Ob1:
         ctx = self._ctx(comm, collective)
         req = RecvRequest(ctx, src, tag, None, 0, None, True)
         pvar.record("irecv")
+        err = self._recv_src_failed(comm, src)
+        if err:
+            req.complete(err)
+            return req
         self._post(req)
         return req
+
+    def _recv_src_failed(self, comm, src: int) -> int:
+        """Error class for a recv that can/should not be posted: a named
+        recv towards a failed sender can never match (PROC_FAILED); a
+        wildcard recv while unacknowledged failures exist in the comm
+        must fail PENDING (ULFM ANY_SOURCE semantics)."""
+        if not self.failed:
+            return 0
+        g = comm.remote_group if getattr(comm, "is_inter", False) \
+            else comm.group
+        if src == rq.ANY_SOURCE:
+            unacked = self.failed - self.acked
+            if any(r in unacked for r in g.ranks):
+                return errors.ERR_PROC_FAILED_PENDING
+            return 0
+        if g.ranks[src] in self.failed:
+            return errors.ERR_PROC_FAILED
+        return 0
 
     def recv(self, comm, buf, count, dtype, src: int, tag: int,
              collective: bool = False) -> rq.Status:
@@ -452,7 +487,10 @@ class Ob1:
         if c is None:
             raise errors.MPIError(errors.ERR_COMM,
                                   f"message for unknown cid {ctx // 2}")
-        return c.group.ranks[src_commrank]
+        # intercomm: inbound src ranks are the sender's LOCAL ranks,
+        # which index OUR remote group
+        g = c.remote_group if getattr(c, "is_inter", False) else c.group
+        return g.ranks[src_commrank]
 
     def _match(self, req: RecvRequest, hdr, payload, src_world: int) -> None:
         typ, ctx, src, tag, _, size, flags, msgid = hdr
@@ -585,3 +623,71 @@ class Ob1:
         if q is not None and req in q:
             q.remove(req)
         req._cancel()
+
+    # -- ULFM fault sweep (reference: ompi/request/req_ft.c) --------------
+    def on_fault(self, dead_world: set) -> int:
+        """Error every in-flight request that involves a failed rank.
+        Called from the progress sweep by ft.detector."""
+        from ompi_tpu import comm as comm_mod
+
+        self.failed |= dead_world
+        events = 0
+        # posted (unmatched) recvs: named sources towards the dead fail;
+        # wildcards fail PENDING once any group member is gone (ULFM
+        # MPI_ERR_PROC_FAILED_PENDING — the app may ack and repost)
+        for ctx, q in list(self.posted.items()):
+            c = comm_mod.lookup_cid(ctx // 2)
+            if c is None:
+                continue
+            g = c.remote_group if getattr(c, "is_inter", False) else c.group
+            dead_in_comm = [r for r in g.ranks if r in dead_world]
+            if not dead_in_comm:
+                continue
+            for req in list(q):
+                if req.want_src == rq.ANY_SOURCE:
+                    q.remove(req)
+                    req.complete(errors.ERR_PROC_FAILED_PENDING)
+                    events += 1
+                elif g.ranks[req.want_src] in dead_world:
+                    q.remove(req)
+                    req.complete(errors.ERR_PROC_FAILED)
+                    events += 1
+        # matched RNDV recvs streaming from a dead sender
+        for recv_id, req in list(self.active_recv.items()):
+            if req.src_world in dead_world:
+                del self.active_recv[recv_id]
+                req.complete(errors.ERR_PROC_FAILED)
+                events += 1
+        # sends awaiting ACK / streaming frags towards a dead receiver
+        for table in (self.pending_ack, self.streaming):
+            for msgid, req in list(table.items()):
+                if req.dst_world in dead_world:
+                    del table[msgid]
+                    if not req.completed:
+                        req.complete(errors.ERR_PROC_FAILED)
+                        events += 1
+        return events
+
+    def on_revoke(self, cid: int) -> int:
+        """Error every in-flight request on a revoked communicator
+        (reference: ompi/communicator/ft/comm_ft_revoke.c drains the
+        match queues)."""
+        events = 0
+        for ctx in (cid * 2, cid * 2 + 1):
+            q = self.posted.get(ctx)
+            for req in list(q or ()):
+                q.remove(req)
+                req.complete(errors.ERR_REVOKED)
+                events += 1
+            for recv_id, req in list(self.active_recv.items()):
+                if req.ctx == ctx:
+                    del self.active_recv[recv_id]
+                    req.complete(errors.ERR_REVOKED)
+                    events += 1
+            for table in (self.pending_ack, self.streaming):
+                for msgid, req in list(table.items()):
+                    if req.ctx == ctx and not req.completed:
+                        del table[msgid]
+                        req.complete(errors.ERR_REVOKED)
+                        events += 1
+        return events

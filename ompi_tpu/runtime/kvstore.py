@@ -18,6 +18,20 @@ Commands:
   ("inc", key, amount)           -> ("val", new_value)   # atomic counter
   ("abort", rank, reason)        -> ("ok",)  # marks job aborted
   ("aborted?",)                  -> ("val", reason | None)
+
+Fault tolerance (the PRRTE-daemon side of ULFM — the reference delegates
+runtime-level failure detection to PRTE, docs/features/ulfm.rst:260-262;
+here the store IS the daemon):
+  ("hb", rank)                   -> ("ok",)   # heartbeat timestamp
+  ("dead", rank, reason)         -> ("ok",)   # declare a rank failed
+  ("faults?", hb_timeout|None)   -> ("val", {rank: reason})
+  ("ftgather", tag, rank, value, ranks, hb_timeout)
+      -> ("val", (contribs: {rank: value}, dead: {rank: reason}))
+      FT rendezvous: releases when every rank in `ranks` has either
+      contributed or failed; the result is frozen once, so every caller
+      of the same tag observes the SAME contribution/failure split —
+      the consistency guarantee ERA agreement provides in the reference
+      (ompi/mca/coll/ftagree/), achieved here via the reliable store.
 """
 
 from __future__ import annotations
@@ -26,6 +40,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 _LEN = struct.Struct("!I")
@@ -61,6 +76,12 @@ class Store:
         self._fences: Dict[str, list] = {}  # tag -> [arrived, released]
         self._cond = threading.Condition()
         self._aborted: Optional[str] = None
+        # fault state: declared-dead ranks (monotonic — once failed,
+        # always failed, per ULFM semantics) + last heartbeat times
+        self._dead: Dict[int, str] = {}
+        self._hb: Dict[int, float] = {}
+        # tag -> {"contribs": {rank: val}, "result": frozen | None}
+        self._gathers: Dict[str, dict] = {}
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -128,18 +149,31 @@ class Store:
         if op == "fence":
             # tags must be unique per epoch (the rte client appends an
             # epoch counter, mirroring PMIx fence instance uniqueness)
-            _, tag, nprocs = msg
+            _, tag, nprocs, rank = msg
             with self._cond:
-                entry = self._fences.setdefault(tag, [0, 0])
-                entry[0] += 1
+                entry = self._fences.setdefault(tag, [set(), 0])
+                entry[0].add(rank)
                 self._cond.notify_all()
-                while entry[0] < nprocs and not self._aborted:
+
+                def dead_absent():  # dead ranks release the fence
+                    # (PMIx fence over failed procs errors, never
+                    # hangs). Only plausible participants count: world
+                    # fences span ranks [0, nprocs), so a dead rank
+                    # outside that range (or one that arrived and THEN
+                    # died) must not release someone else's fence.
+                    return sum(1 for r in self._dead
+                               if 0 <= r < nprocs and r not in entry[0])
+
+                while (len(entry[0]) + dead_absent() < nprocs
+                       and not self._aborted):
                     self._cond.wait(timeout=1.0)
                 if self._aborted:
                     return ("aborted", self._aborted)
                 entry[1] += 1
-                if entry[1] >= nprocs:  # last releaser reclaims the entry
-                    self._fences.pop(tag, None)
+                if entry[1] >= nprocs - dead_absent():
+                    self._fences.pop(tag, None)  # last releaser reclaims
+                if len(entry[0]) < nprocs:
+                    return ("okdead", dict(self._dead))
                 return ("ok",)
         if op == "inc":
             _, key, amount = msg
@@ -155,7 +189,78 @@ class Store:
         if op == "aborted?":
             with self._cond:
                 return ("val", self._aborted)
+        if op == "hb":
+            _, rank = msg
+            with self._cond:
+                self._hb[rank] = time.monotonic()
+            return ("ok",)
+        if op == "dead":
+            _, rank, reason = msg
+            self.mark_dead(rank, reason)
+            return ("ok",)
+        if op == "faults?":
+            _, hb_timeout = msg
+            with self._cond:
+                self._promote_stale(hb_timeout)
+                return ("val", dict(self._dead))
+        if op == "ftgather":
+            _, tag, rank, value, ranks, hb_timeout = msg
+            return self._ftgather(tag, rank, value, ranks, hb_timeout)
         return ("err", f"unknown op {op!r}")
+
+    # -- fault-tolerance internals ---------------------------------------
+    def mark_dead(self, rank: int, reason: str) -> None:
+        """Declare a rank failed (launcher waitpid or peer report)."""
+        with self._cond:
+            if rank not in self._dead:
+                self._dead[rank] = reason
+                self._cond.notify_all()
+
+    def _promote_stale(self, hb_timeout: Optional[float]) -> None:
+        """Promote heartbeat-stale ranks into the permanent dead set.
+        Caller holds self._cond. Only ranks that ever emitted a
+        heartbeat can go stale (detector-enabled ranks)."""
+        if not hb_timeout:
+            return
+        now = time.monotonic()
+        for rank, last in self._hb.items():
+            if rank not in self._dead and now - last > hb_timeout:
+                self._dead[rank] = f"heartbeat stale >{hb_timeout}s"
+                self._cond.notify_all()
+
+    def _ftgather(self, tag: str, rank: int, value: Any,
+                  ranks, hb_timeout: Optional[float]) -> Tuple:
+        with self._cond:
+            entry = self._gathers.setdefault(
+                tag, {"contribs": {}, "result": None, "left": 0})
+            if entry["result"] is None:
+                entry["contribs"][rank] = value
+            entry["left"] += 1
+            self._cond.notify_all()
+            while entry["result"] is None and not self._aborted:
+                self._promote_stale(hb_timeout)
+                missing = [r for r in ranks
+                           if r not in entry["contribs"]
+                           and r not in self._dead]
+                if not missing:
+                    entry["result"] = (dict(entry["contribs"]),
+                                       {r: self._dead[r] for r in ranks
+                                        if r in self._dead})
+                    self._cond.notify_all()
+                    break
+                self._cond.wait(timeout=0.1)
+            if self._aborted:
+                return ("aborted", self._aborted)
+            result = entry["result"]
+            entry["left"] -= 1
+            # reclaim once every live contributor has picked up the
+            # frozen result (late/suspected callers get a fresh entry —
+            # by then they act on the next epoch anyway)
+            if entry["left"] <= 0 and all(
+                    r in entry["contribs"] or r in self._dead
+                    for r in ranks):
+                self._gathers.pop(tag, None)
+            return ("val", result)
 
 
 class Client:
@@ -166,6 +271,14 @@ class Client:
         self._sock = socket.create_connection(addr, timeout=60)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
+        # anonymous fence identity (unique per client, never a real
+        # world rank, never in the dead set)
+        import itertools
+        import os as _os
+        if not hasattr(Client, "_anon_seq"):
+            Client._anon_seq = itertools.count(1)
+        self._anon_rank = -(_os.getpid() * 100000
+                            + next(Client._anon_seq))
 
     def _rpc(self, *msg: Any, timeout: Optional[float] = None) -> Tuple:
         with self._lock:
@@ -188,11 +301,23 @@ class Client:
         reply = self._rpc("get", key, wait)
         return reply[1] if reply[0] == "val" else None
 
-    def fence(self, tag: str, nprocs: int,
+    def fence(self, tag: str, nprocs: int, rank: int = -1,
               timeout: Optional[float] = None) -> None:
-        """Blocks until nprocs arrive. A timeout raises socket.timeout —
-        used by shutdown paths that must not hang on a dead peer."""
-        self._rpc("fence", tag, nprocs, timeout=timeout)
+        """Blocks until nprocs distinct ranks arrive. A timeout raises
+        socket.timeout — used by shutdown paths that must not hang on a
+        dead peer. If failed ranks released the fence early, raises
+        ProcFailedError. Callers without a rank identity pass -1..-N
+        (test harnesses); real ranks pass their world rank so a rank
+        that arrives and then dies is not double-counted."""
+        if rank == -1:
+            rank = self._anon_rank
+        reply = self._rpc("fence", tag, nprocs, rank, timeout=timeout)
+        if reply[0] == "okdead":
+            from ompi_tpu import errors
+
+            raise errors.ProcFailedError(
+                ranks=tuple(reply[1]),
+                msg=f"fence {tag!r} released by failures: {reply[1]}")
 
     def inc(self, key: str, amount: int = 1) -> int:
         return self._rpc("inc", key, amount)[1]
@@ -202,6 +327,25 @@ class Client:
             self._rpc("abort", rank, reason)
         except Exception:
             pass
+
+    # -- fault tolerance --------------------------------------------------
+    def heartbeat(self, rank: int) -> None:
+        self._rpc("hb", rank)
+
+    def mark_dead(self, rank: int, reason: str) -> None:
+        self._rpc("dead", rank, reason)
+
+    def faults(self, hb_timeout: Optional[float] = None) -> Dict[int, str]:
+        """Failed ranks: launcher-declared + heartbeat-stale."""
+        return self._rpc("faults?", hb_timeout)[1]
+
+    def ftgather(self, tag: str, rank: int, value: Any, ranks,
+                 hb_timeout: Optional[float] = None) -> Tuple:
+        """FT rendezvous; returns (contribs, dead) — identical for every
+        caller of the same tag (see module docstring)."""
+        reply = self._rpc("ftgather", tag, rank, value, tuple(ranks),
+                          hb_timeout)
+        return reply[1]
 
     def close(self) -> None:
         try:

@@ -60,15 +60,24 @@ def build_env(rank: int, size: int, store_addr, jobid: str,
 def launch(argv: Sequence[str], nprocs: int,
            mca: Optional[Dict[str, str]] = None,
            timeout: Optional[float] = None) -> int:
-    """Spawn nprocs ranks running ``python argv...``; returns exit code."""
+    """Spawn nprocs ranks running ``python argv...``; returns exit code.
+
+    FT mode (``--mca ft 1``): a rank killed by a signal is declared
+    failed in the store and the job CONTINUES — the ULFM model, where
+    runtime-level detection is the launcher daemon's job (reference:
+    PRTE does this for Open MPI, docs/features/ulfm.rst:260-262).
+    Ranks that *exit* nonzero still fail the job (that's a bug, not an
+    injected fault).
+    """
     store = kvstore.Store().start()
     jobid = uuid.uuid4().hex[:12]
+    ft = (mca or {}).get("ft", "0") not in ("0", "false", "")
     procs: List[subprocess.Popen] = []
     try:
         for r in range(nprocs):
             env = build_env(r, nprocs, store.addr, jobid, mca)
             procs.append(subprocess.Popen(list(argv), env=env))
-        return _wait_all(procs, timeout)
+        return _wait_all(procs, timeout, store=store if ft else None)
     finally:
         for p in procs:
             if p.poll() is None:
@@ -82,19 +91,37 @@ def launch(argv: Sequence[str], nprocs: int,
 
 
 def _wait_all(procs: List[subprocess.Popen],
-              timeout: Optional[float]) -> int:
+              timeout: Optional[float],
+              store: Optional[kvstore.Store] = None) -> int:
+    """store != None enables FT mode: signal deaths are declared to the
+    store instead of tearing the job down."""
     deadline = None if timeout is None else time.monotonic() + timeout
     pending = set(range(len(procs)))
     first_bad = 0
+    clean_exits = 0
+    last_killed_rc = 0
     while pending:
         for i in list(pending):
             rc = procs[i].poll()
             if rc is not None:
                 pending.discard(i)
-                if rc < 0:  # killed by signal: shell convention 128+signum
+                killed = rc < 0
+                if killed:  # by signal: shell convention 128+signum
                     rc = 128 - rc
+                if rc == 0:
+                    clean_exits += 1
+                if killed and store is not None:
+                    store.mark_dead(i, f"killed by signal {rc - 128}")
+                    last_killed_rc = rc
+                    continue  # ULFM: survivors keep running
                 if rc != 0 and first_bad == 0:
                     first_bad = rc
+                    if killed:
+                        from ompi_tpu.util import show_help
+
+                        show_help.show(
+                            "launcher", "rank-died", rank=i,
+                            cause=f"signal {rc - 128}")
                     # a rank died abnormally: bring the job down (mpirun
                     # kills remaining ranks on abnormal termination)
                     for j in pending:
@@ -106,6 +133,10 @@ def _wait_all(procs: List[subprocess.Popen],
                 for j in pending:
                     procs[j].kill()
                 return 124
+    if first_bad == 0 and clean_exits == 0 and last_killed_rc:
+        # FT mode with every rank killed: the job did not survive
+        # anything — that is a failure, not a tolerated fault
+        return last_killed_rc
     return first_bad
 
 

@@ -93,7 +93,8 @@ def fence(tag: str = "", timeout: float | None = None) -> None:
     with _lock:
         _fence_epoch += 1
         epoch = _fence_epoch
-    client().fence(f"fence:{jobid}:{tag}:{epoch}", size, timeout=timeout)
+    client().fence(f"fence:{jobid}:{tag}:{epoch}", size, rank,
+                   timeout=timeout)
 
 
 def next_id(space: str) -> int:

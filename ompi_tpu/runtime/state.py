@@ -68,6 +68,14 @@ def init(thread_level: int = 0):
 
         pml.select()
         _world, _self_comm = build_world()
+
+        # ULFM detector (opt-in: --mca ft 1); after comm construction so
+        # its progress callback can resolve cids (reference: detector
+        # starts from ompi_comm_init under OPAL_ENABLE_FT_MPI)
+        from ompi_tpu.ft import detector as _ft_detector
+
+        if _ft_detector.enabled() and rte.size > 1:
+            _ft_detector.start()
         _initialized = True
         atexit.register(_atexit_finalize)
         return _world
@@ -91,8 +99,15 @@ def finalize() -> None:
         if _finalized or not _initialized:
             _finalized = True
             return
+        from ompi_tpu.ft import detector as _ft_detector
+
         try:
-            if _world is not None and rte.size > 1:
+            # FT mode: a rank can die mid-barrier and strand live peers
+            # that wait on each other (the classic ULFM hang revoke
+            # exists for) — the dead-tolerant store fence below is the
+            # shutdown rendezvous instead.
+            if (_world is not None and rte.size > 1
+                    and _ft_detector.get() is None):
                 _world.barrier()
         except Exception:
             pass
@@ -107,6 +122,7 @@ def finalize() -> None:
             pass
         from ompi_tpu import pml
 
+        _ft_detector.stop()
         pml.finalize()
         registry.close_all()
         _finalized = True

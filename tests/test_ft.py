@@ -1,0 +1,129 @@
+"""ULFM fault-injection tests — kill -9 a rank, detect, shrink, continue.
+
+Reference analog: the external ULFM test suite (the reference keeps fault
+injection out-of-tree, docs/features/ulfm.rst); here injection is in-tree:
+a rank SIGKILLs itself at a known point and survivors must detect the
+failure (launcher waitpid + heartbeat staleness), error their in-flight
+requests, agree consistently, shrink, and keep computing.
+"""
+
+from tests.harness import run_ranks
+
+FT = {"ft": "1"}
+
+
+def test_detect_kill_and_shrink():
+    """Rank 2 dies; survivors detect, shrink, and allreduce on the new
+    comm (the canonical ULFM recovery loop)."""
+    run_ranks("""
+        import os, signal, time
+        comm.Barrier()
+        if rank == 2:
+            os.kill(os.getpid(), signal.SIGKILL)
+        deadline = time.monotonic() + 20
+        while 2 not in comm.get_failed():
+            time.sleep(0.02)
+            assert time.monotonic() < deadline, "failure never detected"
+        new = comm.shrink()
+        assert new.size == 2, new.size
+        out = np.zeros(1, dtype=np.int64)
+        new.Allreduce(np.array([new.rank + 1], dtype=np.int64), out)
+        assert out[0] == 3, out  # 1 + 2 over the two survivors
+    """, 3, mca=FT, timeout=90)
+
+
+def test_pending_recv_errors_on_failure():
+    """A posted recv towards a rank that dies completes with
+    MPI_ERR_PROC_FAILED instead of hanging (req_ft sweep)."""
+    run_ranks("""
+        import os, signal
+        from ompi_tpu import errors
+        comm.Barrier()
+        if rank == 1:
+            os.kill(os.getpid(), signal.SIGKILL)
+        buf = np.zeros(4, dtype=np.float32)
+        try:
+            comm.Recv(buf, source=1, tag=99)
+            raise AssertionError("recv from dead rank completed")
+        except errors.ProcFailedError:
+            pass
+    """, 2, mca=FT, timeout=90)
+
+
+def test_agree_consistent_with_dead_rank():
+    """MPIX_Comm_agree: survivors contribute different flags; both see
+    the same AND-combined value and the same failed set."""
+    run_ranks("""
+        import os, signal, time
+        comm.Barrier()
+        if rank == 2:
+            os.kill(os.getpid(), signal.SIGKILL)
+        deadline = time.monotonic() + 20
+        while 2 not in comm.get_failed():
+            time.sleep(0.02)
+            assert time.monotonic() < deadline
+        flag = 0b11 if rank == 0 else 0b01
+        value, failed = comm.agree(flag)
+        assert value == 0b01, bin(value)
+        assert failed == [2], failed
+        # cross-check both ranks computed identically
+        other = 1 - rank
+        comm.send((value, tuple(failed)), dest=other, tag=5)
+        assert comm.recv(source=other, tag=5) == (value, tuple(failed))
+    """, 3, mca=FT, timeout=90)
+
+
+def test_revoke_interrupts_pending_recv():
+    """MPIX_Comm_revoke on one rank errors a peer's blocked recv with
+    MPI_ERR_REVOKED (reference: comm_ft_revoke.c drains match queues)."""
+    run_ranks("""
+        from ompi_tpu import errors
+        comm.Barrier()
+        if rank == 0:
+            # give rank 1 time to post the recv, then revoke
+            import time
+            time.sleep(0.3)
+            comm.revoke()
+            assert comm.is_revoked()
+        else:
+            buf = np.zeros(1, dtype=np.int32)
+            try:
+                comm.Recv(buf, source=0, tag=42)
+                raise AssertionError("recv on revoked comm completed")
+            except errors.RevokedError:
+                pass
+        # shrink works on a revoked communicator (ULFM): rebuild + use
+        new = comm.shrink()
+        out = np.zeros(1, dtype=np.int64)
+        new.Allreduce(np.array([1], dtype=np.int64), out)
+        assert out[0] == new.size
+    """, 2, mca=FT, timeout=90)
+
+
+def test_wildcard_recv_fails_pending():
+    """ANY_SOURCE recv with an unacknowledged failure completes with
+    ERR_PROC_FAILED_PENDING; after ack_failed it can be reposted and
+    matched from a live sender."""
+    run_ranks("""
+        import os, signal
+        from ompi_tpu import errors, mpi
+        comm.Barrier()
+        if rank == 2:
+            os.kill(os.getpid(), signal.SIGKILL)
+        if rank == 1:
+            # wait for rank 0 to finish its dance, then feed it
+            comm.recv(source=0, tag=8)
+            comm.Send(np.array([7], dtype=np.int32), dest=0, tag=9)
+        if rank == 0:
+            buf = np.zeros(1, dtype=np.int32)
+            try:
+                comm.Recv(buf, source=mpi.ANY_SOURCE, tag=9)
+                raise AssertionError("wildcard recv ignored the failure")
+            except errors.ProcFailedError:
+                pass
+            acked = comm.ack_failed()
+            assert acked >= 1, acked
+            comm.send(None, dest=1, tag=8)
+            comm.Recv(buf, source=mpi.ANY_SOURCE, tag=9)
+            assert buf[0] == 7
+    """, 3, mca=FT, timeout=90)

@@ -1,0 +1,99 @@
+"""Intercommunicators: create/merge, group-vs-group collectives,
+cross-group p2p, and store-brokered connect/accept (dpm-lite)."""
+
+from tests.harness import run_ranks
+
+_SPLIT = """
+    half = comm.split(color=rank % 2, key=rank)
+    peers_lo = [r for r in range(size) if r % 2 == 0]
+    peers_hi = [r for r in range(size) if r % 2 == 1]
+    my_side = peers_lo if rank % 2 == 0 else peers_hi
+    other_side = peers_hi if rank % 2 == 0 else peers_lo
+"""
+
+
+def test_intercomm_create_p2p():
+    run_ranks(_SPLIT + """
+    inter = mpi.Intercomm_create(half, 0, comm, (rank % 2) ^ 1, tag=9)
+    assert inter.is_inter
+    assert inter.size == len(my_side)
+    assert inter.remote_size == len(other_side)
+    # cross-group p2p: my local rank i talks to remote local rank i
+    peer = half.rank
+    got = inter.sendrecv(("hello", rank), dest=peer, source=peer)
+    assert got[1] == other_side[half.rank], got
+    """, 4)
+
+
+def test_intercomm_bcast_root_semantics():
+    run_ranks(_SPLIT + """
+    inter = mpi.Intercomm_create(half, 0, comm, (rank % 2) ^ 1, tag=1)
+    from ompi_tpu.pml.request import PROC_NULL
+    # group 0's local rank 1 broadcasts to all of group 1
+    if rank % 2 == 0:
+        root = mpi.ROOT if half.rank == 1 else PROC_NULL
+        out = inter.bcast(("payload", 42) if root == mpi.ROOT else None,
+                          root=root)
+    else:
+        out = inter.bcast(None, root=1)
+        assert out == ("payload", 42), out
+    """, 4)
+
+
+def test_intercomm_allreduce_swaps_groups():
+    run_ranks(_SPLIT + """
+    inter = mpi.Intercomm_create(half, 0, comm, (rank % 2) ^ 1, tag=2)
+    x = np.full(4, float(rank + 1), np.float32)
+    out = np.empty(4, np.float32)
+    inter.Allreduce(x, out)
+    # each side receives the OTHER side's reduction
+    expect = float(sum(r + 1 for r in other_side))
+    np.testing.assert_array_equal(out, np.full(4, expect))
+    """, 4)
+
+
+def test_intercomm_allgather_and_barrier():
+    run_ranks(_SPLIT + """
+    inter = mpi.Intercomm_create(half, 0, comm, (rank % 2) ^ 1, tag=3)
+    inter.Barrier()
+    x = np.full(2, float(rank), np.float32)
+    out = np.empty((inter.remote_size, 2), np.float32)
+    inter.Allgather(x, out)
+    np.testing.assert_array_equal(
+        out[:, 0], np.array([float(r) for r in other_side], np.float32))
+    objs = inter.allgather(("r", rank))
+    assert [o[1] for o in objs] == other_side
+    """, 4)
+
+
+def test_intercomm_merge():
+    run_ranks(_SPLIT + """
+    inter = mpi.Intercomm_create(half, 0, comm, (rank % 2) ^ 1, tag=4)
+    merged = inter.merge(high=(rank % 2 == 1))  # evens low, odds high
+    assert not merged.is_inter
+    assert merged.size == size
+    # low side first: merged rank order is evens then odds
+    order = peers_lo + peers_hi
+    assert merged.group.ranks == tuple(order), merged.group.ranks
+    v = np.array([float(rank)], np.float32)
+    out = np.empty(1, np.float32)
+    merged.Allreduce(v, out)
+    assert out[0] == float(sum(range(size)))
+    """, 4)
+
+
+def test_connect_accept():
+    run_ranks(_SPLIT + """
+    # rendezvous name agreed out of band (here: a fixed string)
+    port = "port:test:ca1"
+    if rank % 2 == 0:
+        inter = mpi.Comm_accept(port, half, root=0)
+    else:
+        inter = mpi.Comm_connect(port, half, root=0)
+    assert inter.remote_size == len(other_side)
+    x = np.full(2, float(rank + 10), np.float32)
+    out = np.empty(2, np.float32)
+    inter.Allreduce(x, out)
+    expect = float(sum(r + 10 for r in other_side))
+    np.testing.assert_array_equal(out, np.full(2, expect))
+    """, 4)
