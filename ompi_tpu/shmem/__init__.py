@@ -1,0 +1,279 @@
+"""OpenSHMEM facade — PGAS API over the osc window plane.
+
+Reference: oshmem/ (52 KLoC): the shmem API (oshmem/shmem/c/, 69 files)
+over spml (put/get transport, spml.h:1024-1082), sshmem (symmetric
+segment), memheap (symmetric allocation + remote key exchange), scoll
+(collectives, with an 'mpi' component delegating to ompi coll) and
+atomic frameworks.
+
+TPU-first redesign, one module per concern folded into this package:
+  - symmetric heap  = one MPI-style window (osc) of heap_size bytes per
+    PE with a passive lock_all epoch held open for the session — SHMEM's
+    always-legal one-sided model; the reference's memheap mkey exchange
+    is the window's own peer_info exchange.
+  - allocation      = deterministic bump allocator: shmem_malloc is
+    symmetric because every PE performs the same allocation sequence
+    (the memheap contract), so offsets agree with no communication.
+  - put/get/atomics = osc Put/Rput/Get/Fetch_and_op/Compare_and_swap at
+    byte displacements (spml/ucx's RDMA mapped to the AM-emulation osc,
+    which is the honest transport on a host plane with no NIC RDMA).
+  - collectives     = delegate to the comm's coll table (exactly the
+    reference's scoll/mpi component).
+  - wait_until      = progress-engine spin on local heap memory (the
+    window applies remote puts from the progress callback).
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu import errors, op as op_mod
+from ompi_tpu.core import cvar, progress, pvar
+
+_heap_var = cvar.register(
+    "shmem_heap_size", 1 << 22, int,
+    help="Symmetric heap bytes per PE (reference: SHMEM_SYMMETRIC_SIZE "
+         "/ memheap size).", level=4)
+
+_ALIGN = 16
+
+_state: Optional["_Shmem"] = None
+
+CMP_EQ, CMP_NE, CMP_GT, CMP_GE, CMP_LT, CMP_LE = (
+    "eq", "ne", "gt", "ge", "lt", "le")
+_CMPS = {CMP_EQ: operator.eq, CMP_NE: operator.ne, CMP_GT: operator.gt,
+         CMP_GE: operator.ge, CMP_LT: operator.lt, CMP_LE: operator.le}
+
+
+class SymArray:
+    """A symmetric object: same shape/dtype/heap offset on every PE.
+    ``.local`` is this PE's backing storage (a live view into the
+    heap); remote access goes through put/get/atomics with the PE
+    number."""
+
+    def __init__(self, offset: int, shape, dtype) -> None:
+        self.offset = offset
+        self.shape = tuple(np.atleast_1d(np.empty(shape, dtype)).shape) \
+            if shape != () else ()
+        self.dtype = np.dtype(dtype)
+
+    @property
+    def local(self) -> np.ndarray:
+        st = _require()
+        nbytes = int(np.prod(self.shape or (1,))) * self.dtype.itemsize
+        flat = st.heap[self.offset:self.offset + nbytes]
+        return flat.view(self.dtype).reshape(self.shape)
+
+    def byte_disp(self, index: int = 0) -> int:
+        return self.offset + index * self.dtype.itemsize
+
+
+class _Shmem:
+    def __init__(self, heap_size: int) -> None:
+        from ompi_tpu import mpi, osc
+
+        self.comm = mpi.Init()
+        self.heap_arr = np.zeros(heap_size, dtype=np.uint8)
+        self.win = osc.win_create(self.comm, self.heap_arr, disp_unit=1)
+        self.heap = self.heap_arr  # flat uint8 view
+        self.brk = 0
+        # session-long passive exposure: SHMEM one-sided is always legal
+        self.win.Lock_all()
+
+
+def _require() -> _Shmem:
+    if _state is None:
+        raise errors.MPIError(errors.ERR_OTHER,
+                              "shmem.init() has not been called")
+    return _state
+
+
+# -- setup/query (shmem_init/my_pe/n_pes) ----------------------------------
+
+def init(heap_size: Optional[int] = None) -> None:
+    global _state
+    if _state is None:
+        _state = _Shmem(heap_size or _heap_var.get())
+
+
+def finalize() -> None:
+    global _state
+    if _state is not None:
+        st = _state
+        _state = None
+        try:
+            st.win.Unlock_all()
+            st.win.Free()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+
+def my_pe() -> int:
+    return _require().comm.rank
+
+
+def n_pes() -> int:
+    return _require().comm.size
+
+
+# -- symmetric allocation (shmem_malloc / memheap) -------------------------
+
+def zeros(shape, dtype=np.float64) -> SymArray:
+    """Symmetric allocation (collective by convention: every PE calls
+    in the same order with the same arguments — the memheap contract;
+    no communication needed)."""
+    st = _require()
+    sym = SymArray(st.brk, shape, dtype)
+    nbytes = int(np.prod(sym.shape or (1,))) * sym.dtype.itemsize
+    new_brk = (st.brk + nbytes + _ALIGN - 1) // _ALIGN * _ALIGN
+    if new_brk > st.heap.size:
+        raise errors.MPIError(
+            errors.ERR_NO_MEM,
+            f"symmetric heap exhausted ({st.heap.size} bytes; raise "
+            f"--mca shmem_heap_size)")
+    st.brk = new_brk
+    pvar.record("shmem_alloc_bytes", nbytes)
+    return sym
+
+
+def free(sym: SymArray) -> None:
+    """shmem_free: the bump allocator reclaims nothing (reference
+    memheap/buddy does; acceptable for the facade — document it)."""
+
+
+# -- RMA (shmem_put/get and friends over spml) -----------------------------
+
+def put(dest: SymArray, value, pe: int, index: int = 0) -> None:
+    """shmem_putmem: blocking-until-buffered put (delivery ordering to
+    one PE preserved by the osc AM channel)."""
+    st = _require()
+    data = np.ascontiguousarray(value, dtype=dest.dtype)
+    st.win.Put(data, pe, disp=dest.byte_disp(index))
+    pvar.record("shmem_put")
+
+
+def put_nbi(dest: SymArray, value, pe: int, index: int = 0):
+    """shmem_put_nbi: returns a request; quiet() also completes it."""
+    st = _require()
+    data = np.ascontiguousarray(value, dtype=dest.dtype)
+    req = st.win.Rput(data, pe, disp=dest.byte_disp(index))
+    pvar.record("shmem_put")
+    return req
+
+
+def get(src: SymArray, pe: int, count: Optional[int] = None,
+        index: int = 0) -> np.ndarray:
+    """shmem_getmem: blocking get; returns a fresh array."""
+    st = _require()
+    n = count if count is not None else int(np.prod(src.shape or (1,)))
+    out = np.empty(n, dtype=src.dtype)
+    st.win.Get(out, pe, disp=src.byte_disp(index))
+    pvar.record("shmem_get")
+    return out.reshape(src.shape if count is None else (n,))
+
+
+def p(dest: SymArray, value, pe: int, index: int = 0) -> None:
+    """shmem_p — single element."""
+    put(dest, np.asarray([value], dtype=dest.dtype), pe, index)
+
+
+def g(src: SymArray, pe: int, index: int = 0):
+    """shmem_g — single element."""
+    return get(src, pe, count=1, index=index)[0]
+
+
+# -- memory ordering (shmem_fence/quiet) -----------------------------------
+
+def quiet() -> None:
+    """shmem_quiet: all outstanding puts/atomics from this PE are
+    complete at their targets (spml fence+quiet -> osc Flush_all)."""
+    _require().win.Flush_all()
+
+
+def fence() -> None:
+    """shmem_fence: ordering only; the osc AM channel already delivers
+    per-target in order, so fence is quiet's ordering half — a no-op
+    beyond a progress poke."""
+    progress.progress()
+
+
+# -- point synchronization (shmem_wait_until) ------------------------------
+
+def wait_until(sym: SymArray, cmp: str, value, index: int = 0) -> None:
+    """Spin the progress engine until the LOCAL symmetric location
+    satisfies cmp (remote puts land via the window's progress
+    callback)."""
+    fn = _CMPS[cmp]
+    loc = sym.local.reshape(-1)
+    progress.wait_until(lambda: bool(fn(loc[index], value)))
+
+
+# -- atomics (shmem_atomic_* over osc accumulate) --------------------------
+
+def atomic_fetch_add(dest: SymArray, value, pe: int, index: int = 0):
+    st = _require()
+    result = np.empty(1, dtype=dest.dtype)
+    st.win.Fetch_and_op(np.asarray([value], dtype=dest.dtype), result,
+                        pe, disp=dest.byte_disp(index), op=op_mod.SUM)
+    pvar.record("shmem_atomic")
+    return result[0]
+
+
+def atomic_add(dest: SymArray, value, pe: int, index: int = 0) -> None:
+    atomic_fetch_add(dest, value, pe, index)
+
+
+def atomic_compare_swap(dest: SymArray, cond, value, pe: int,
+                        index: int = 0):
+    st = _require()
+    result = np.empty(1, dtype=dest.dtype)
+    st.win.Compare_and_swap(
+        np.asarray([value], dtype=dest.dtype),
+        np.asarray([cond], dtype=dest.dtype), result, pe,
+        disp=dest.byte_disp(index))
+    pvar.record("shmem_atomic")
+    return result[0]
+
+
+# -- collectives (scoll/mpi: delegate to the comm's coll table) ------------
+
+def barrier_all() -> None:
+    """shmem_barrier_all = quiet + barrier."""
+    st = _require()
+    quiet()
+    st.comm.Barrier()
+
+
+def broadcast(dest: SymArray, source: SymArray, root: int) -> None:
+    """shmem_broadcast across all PEs (scoll/mpi -> coll bcast)."""
+    st = _require()
+    if st.comm.rank == root:
+        dest.local[...] = source.local
+    st.comm.Bcast(dest.local, root=root)
+
+
+def fcollect(dest: SymArray, source: SymArray) -> None:
+    """shmem_fcollect: concatenate equal-size blocks from every PE."""
+    st = _require()
+    st.comm.Allgather(source.local, dest.local)
+
+
+def sum_to_all(dest: SymArray, source: SymArray) -> None:
+    _to_all(dest, source, op_mod.SUM)
+
+
+def max_to_all(dest: SymArray, source: SymArray) -> None:
+    _to_all(dest, source, op_mod.MAX)
+
+
+def min_to_all(dest: SymArray, source: SymArray) -> None:
+    _to_all(dest, source, op_mod.MIN)
+
+
+def _to_all(dest: SymArray, source: SymArray, op) -> None:
+    st = _require()
+    st.comm.Allreduce(np.array(source.local, copy=True), dest.local,
+                      op=op)

@@ -1,0 +1,74 @@
+"""OpenSHMEM facade tests (reference analog: examples/ OpenSHMEM
+programs — hello/ring/reduce — run as real PEs on localhost)."""
+
+from tests.harness import run_ranks
+
+
+def test_put_get_and_barrier():
+    run_ranks("""
+        from ompi_tpu import shmem
+        shmem.init(heap_size=1 << 16)
+        me, n = shmem.my_pe(), shmem.n_pes()
+        dst = shmem.zeros(8, dtype=np.int64)
+        # ring put: write my id into my right neighbor's heap
+        shmem.put(dst, np.full(8, me, dtype=np.int64), (me + 1) % n)
+        shmem.barrier_all()
+        assert (dst.local == (me - 1) % n).all(), dst.local
+        # remote get from the left neighbor
+        got = shmem.get(dst, (me - 1) % n)
+        assert (got == (me - 2) % n).all(), got
+        shmem.finalize()
+    """, 3, timeout=120)
+
+
+def test_atomics_and_wait_until():
+    run_ranks("""
+        from ompi_tpu import shmem
+        shmem.init(heap_size=1 << 16)
+        me, n = shmem.my_pe(), shmem.n_pes()
+        counter = shmem.zeros(1, dtype=np.int64)
+        flag = shmem.zeros(1, dtype=np.int64)
+        shmem.barrier_all()
+        # every PE fetch-adds on PE 0's counter
+        old = shmem.atomic_fetch_add(counter, 1, 0)
+        assert 0 <= old < n
+        shmem.barrier_all()
+        if me == 0:
+            assert counter.local[0] == n, counter.local
+            total = counter.local[0]
+            for pe in range(1, n):
+                shmem.p(flag, int(total), pe)
+            shmem.quiet()
+        else:
+            shmem.wait_until(flag, shmem.CMP_EQ, n)
+        # cswap: only one PE wins
+        won = shmem.atomic_compare_swap(counter, n, 999, 0)
+        shmem.barrier_all()
+        if me == 0:
+            assert counter.local[0] == 999
+        shmem.finalize()
+    """, 3, timeout=120)
+
+
+def test_collectives():
+    run_ranks("""
+        from ompi_tpu import shmem
+        shmem.init(heap_size=1 << 16)
+        me, n = shmem.my_pe(), shmem.n_pes()
+        src = shmem.zeros(4, dtype=np.float64)
+        dst = shmem.zeros(4, dtype=np.float64)
+        src.local[:] = me + 1
+        shmem.barrier_all()
+        shmem.sum_to_all(dst, src)
+        assert (dst.local == sum(range(1, n + 1))).all(), dst.local
+        # fcollect
+        coll = shmem.zeros(4 * n, dtype=np.float64)
+        shmem.fcollect(coll, src)
+        for pe in range(n):
+            assert (coll.local[4 * pe:4 * (pe + 1)] == pe + 1).all()
+        # broadcast from PE 1
+        b = shmem.zeros(4, dtype=np.float64)
+        shmem.broadcast(b, src, root=1)
+        assert (b.local == 2.0).all(), b.local
+        shmem.finalize()
+    """, 3, timeout=120)
