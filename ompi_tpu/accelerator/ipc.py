@@ -1,0 +1,64 @@
+"""IPC memory handles — same-host cross-process buffer export.
+
+Reference: accelerator.h's get_ipc_handle/open_ipc_handle (CUDA:
+cuIpcGetMemHandle — a device-memory handle another process maps
+directly) and the smsc/accelerator single-copy component built on it.
+
+PJRT exposes no device-memory IPC, so the honest equivalent stages
+through POSIX shared memory: export snapshots the buffer's bytes into
+a /dev/shm segment (one D2H), import maps and uploads (one H2D). Two
+copies instead of zero, but the *surface* consumers program against is
+identical, and on the host plane (null component) it IS zero-copy on
+import when the consumer accepts a read-only view.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import uuid
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class IpcHandle:
+    """Picklable handle a peer process can open (modex-transportable,
+    like the reference's 64-byte CUipcMemHandle)."""
+
+    path: str
+    shape: Tuple[int, ...]
+    dtype: str
+
+
+def export_array(host: np.ndarray,
+                 shm_dir: str = "/dev/shm") -> IpcHandle:
+    path = os.path.join(
+        shm_dir, f"ompi_tpu_ipc_{os.getpid()}_{uuid.uuid4().hex[:8]}")
+    with open(path, "wb") as fh:
+        fh.write(np.ascontiguousarray(host).tobytes())
+    return IpcHandle(path, tuple(host.shape), str(host.dtype))
+
+
+def import_array(handle: IpcHandle, writable: bool = False) -> np.ndarray:
+    fd = os.open(handle.path, os.O_RDWR if writable else os.O_RDONLY)
+    try:
+        size = os.fstat(fd).st_size
+        mm = mmap.mmap(fd, size,
+                       prot=(mmap.PROT_READ | mmap.PROT_WRITE)
+                       if writable else mmap.PROT_READ)
+    finally:
+        os.close(fd)
+    arr = np.frombuffer(mm, dtype=np.dtype(handle.dtype))
+    return arr.reshape(handle.shape)
+
+
+def release(handle: IpcHandle) -> None:
+    """Exporter-side cleanup (reference: handles are freed when the
+    owning allocation is)."""
+    try:
+        os.unlink(handle.path)
+    except OSError:
+        pass

@@ -31,3 +31,23 @@ class NullAccelerator(Accelerator):
 
     def num_devices(self) -> int:
         return 0
+
+    def get_address_range(self, buf):
+        arr = np.asarray(buf)
+        base = arr.ctypes.data if arr.flags["C_CONTIGUOUS"] else None
+        return (base, arr.nbytes)
+
+    def get_buffer_id(self, buf) -> int:
+        base, _ = self.get_address_range(buf)
+        return base if base is not None else id(buf)
+
+    # host-plane IPC is genuinely zero-copy on import (shm mapping)
+    def ipc_export(self, buf):
+        from ompi_tpu.accelerator import ipc
+
+        return ipc.export_array(np.asarray(buf))
+
+    def ipc_import(self, handle):
+        from ompi_tpu.accelerator import ipc
+
+        return ipc.import_array(handle)

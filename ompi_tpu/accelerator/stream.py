@@ -1,0 +1,98 @@
+"""Streams and events — ordered async op queues for the accelerator.
+
+Reference: opal/mca/accelerator/accelerator.h:668-711 — create_stream/
+sync_stream, create_event/record_event/query_event/sync_event, and the
+*_async memcpy/alloc entries that take a stream. The CUDA component
+maps these 1:1 onto CUstream/CUevent.
+
+TPU/PJRT redesign: PJRT dispatch is already asynchronous (every jax op
+returns immediately; readiness is exposed per-buffer), so a "stream"
+here is a host-side ordered executor — a worker thread draining a FIFO
+of submitted host↔device ops — which is exactly the ordering contract
+CUDA streams give the reference's consumers (pml_ob1_accelerator.c's
+outstanding-copy event arrays). Events mark points in that order.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+
+class Event:
+    """Completion marker (reference: create_event/record/query/sync)."""
+
+    def __init__(self) -> None:
+        self._done = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+    def _fire(self, result=None, error=None) -> None:
+        self.result = result
+        self.error = error
+        self._done.set()
+
+    def query(self) -> bool:
+        """Nonblocking readiness probe (query_event)."""
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until recorded work completes (sync_event)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("event did not complete")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+def completed_event(result=None) -> Event:
+    ev = Event()
+    ev._fire(result)
+    return ev
+
+
+class Stream:
+    """Ordered async executor (reference: create_stream/sync_stream)."""
+
+    def __init__(self, name: str = "accel-stream") -> None:
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True)
+        self._alive = True
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, ev = item
+            try:
+                ev._fire(fn())
+            except BaseException as exc:  # noqa: BLE001 — surfaced at wait
+                ev._fire(error=exc)
+
+    def submit(self, fn: Callable[[], Any]) -> Event:
+        """Enqueue fn; returns the Event completing when it ran (the
+        *_async entries build on this)."""
+        if not self._alive:
+            raise RuntimeError("stream destroyed")
+        ev = Event()
+        self._q.put((fn, ev))
+        return ev
+
+    def record_event(self) -> Event:
+        """Marker event: fires when everything submitted before it has
+        executed (record_event semantics)."""
+        return self.submit(lambda: None)
+
+    def synchronize(self) -> None:
+        """Drain: block until all prior submissions ran (sync_stream)."""
+        self.record_event().wait()
+
+    def destroy(self) -> None:
+        if self._alive:
+            self._alive = False
+            self._q.put(None)
+            self._thread.join(timeout=10)

@@ -177,3 +177,61 @@ class TpuAccelerator(Accelerator):
         if self._jax is not None:
             (self._jax.effects_barrier
              if hasattr(self._jax, "effects_barrier") else lambda: None)()
+
+    # -- introspection (accelerator.h get_address_range/buffer_id/...) ---
+    def get_address_range(self, buf):
+        """(device pointer, nbytes) when PJRT exposes it (the rcache
+        lookup key); (None, nbytes) on backends that don't."""
+        try:
+            ptr = buf.unsafe_buffer_pointer()
+        except Exception:  # noqa: BLE001 — backend-dependent API
+            ptr = None
+        return (ptr, getattr(buf, "nbytes", None))
+
+    def get_buffer_id(self, buf) -> int:
+        ptr, _ = self.get_address_range(buf)
+        return ptr if ptr is not None else id(buf)
+
+    def get_device_attr(self) -> dict:
+        """TPU topology attributes — the PCI-attr analog: mesh coords
+        + core index instead of bus ids."""
+        self._ensure()
+        if not self._devices:
+            return {}
+        d = self._devices[0]
+        return {
+            "coords": getattr(d, "coords", None),
+            "core_on_chip": getattr(d, "core_on_chip", None),
+            "slice_index": getattr(d, "slice_index", 0),
+            "process_index": getattr(d, "process_index", 0),
+        }
+
+    def device_can_access_peer(self, dev_a: int, dev_b: int) -> bool:
+        """Same-slice chips are ICI-connected (the peer-access bit the
+        CUDA component reads from the driver)."""
+        self._ensure()
+        n = len(self._devices)
+        if not (0 <= dev_a < n and 0 <= dev_b < n):
+            return False
+        sa = getattr(self._devices[dev_a], "slice_index", 0)
+        sb = getattr(self._devices[dev_b], "slice_index", 0)
+        return sa == sb
+
+    def memkind_info(self) -> list:
+        return [
+            {"name": "hbm", "kind": "device",
+             "bandwidth_gbps": self.mem_bandwidth()},
+            {"name": "host", "kind": "system"},
+        ]
+
+    # -- IPC via shm staging (see accelerator/ipc.py docstring) -----------
+    def ipc_export(self, buf):
+        from ompi_tpu.accelerator import ipc
+
+        return ipc.export_array(self.to_host(buf))
+
+    def ipc_import(self, handle):
+        from ompi_tpu.accelerator import ipc
+
+        jax = self._ensure()
+        return jax.device_put(self._np.array(ipc.import_array(handle)))
