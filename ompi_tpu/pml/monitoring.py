@@ -1,0 +1,147 @@
+"""pml/monitoring — interposition PML recording per-peer traffic.
+
+Reference: ompi/mca/pml/monitoring (512 LoC) + common/monitoring: a
+PML that wraps the selected one, counts messages and bytes per
+destination peer (split by point-to-point vs collective context), and
+dumps a traffic matrix at finalize or on demand. The same pattern
+carries pml/v (message logging) — any interposition layer installs via
+``pml.set_current``.
+
+Usage:
+    from ompi_tpu.pml import monitoring
+    monitoring.install()           # or --mca pml_monitoring 1
+    ... run ...
+    matrix = monitoring.matrix()   # {peer: (msgs, bytes)}
+    monitoring.dump()              # human-readable to the output stream
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from ompi_tpu.core import cvar, output, pvar
+
+_out = output.stream("pml_monitoring")
+
+_enable_var = cvar.register(
+    "pml_monitoring", False, bool,
+    help="Install the monitoring interposition PML at init "
+         "(reference: pml/monitoring).", level=7)
+
+
+class MonitoringPml:
+    """Wraps the real PML; counts sends per destination world rank.
+
+    The reference monitors the send side (every message is counted
+    exactly once, by its sender); receive totals are available as the
+    transpose after an allgather of matrices."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+        # world rank -> [messages, bytes], split by context
+        self.p2p: Dict[int, list] = {}
+        self.coll: Dict[int, list] = {}
+
+    # -- counting helpers -------------------------------------------------
+    def _count(self, comm, dst: int, nbytes: int,
+               collective: bool) -> None:
+        if dst < 0:  # PROC_NULL
+            return
+        try:
+            g = comm.remote_group if getattr(comm, "is_inter", False) \
+                else comm.group
+            world = g.ranks[dst]
+        except (IndexError, AttributeError):
+            world = dst
+        table = self.coll if collective else self.p2p
+        cell = table.setdefault(world, [0, 0])
+        cell[0] += 1
+        cell[1] += nbytes
+        pvar.record("monitoring_msgs")
+        pvar.record("monitoring_bytes", nbytes)
+
+    @staticmethod
+    def _nbytes(buf, count, dtype) -> int:
+        if dtype is not None and count:
+            return count * dtype.size
+        nb = getattr(buf, "nbytes", None)
+        return nb if nb is not None else 0
+
+    # -- intercepted send-side entries ------------------------------------
+    def isend(self, comm, buf, count, dtype, dst, tag, **kw):
+        self._count(comm, dst, self._nbytes(buf, count, dtype),
+                    kw.get("collective", False))
+        return self._inner.isend(comm, buf, count, dtype, dst, tag, **kw)
+
+    def send(self, comm, buf, count, dtype, dst, tag, **kw):
+        self._count(comm, dst, self._nbytes(buf, count, dtype),
+                    kw.get("collective", False))
+        return self._inner.send(comm, buf, count, dtype, dst, tag, **kw)
+
+    def isend_obj(self, comm, obj, dst, tag, **kw):
+        self._count(comm, dst, 0, kw.get("collective", False))
+        return self._inner.isend_obj(comm, obj, dst, tag, **kw)
+
+    def send_obj(self, comm, obj, dst, tag, **kw):
+        self._count(comm, dst, 0, kw.get("collective", False))
+        return self._inner.send_obj(comm, obj, dst, tag, **kw)
+
+    # -- everything else passes through -----------------------------------
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def install() -> MonitoringPml:
+    """Wrap the currently-selected PML (idempotent)."""
+    from ompi_tpu import pml
+
+    cur = pml.current()
+    if isinstance(cur, MonitoringPml):
+        return cur
+    mon = MonitoringPml(cur)
+    pml.set_current(mon)
+    return mon
+
+
+def installed() -> Optional[MonitoringPml]:
+    """Find the monitoring layer anywhere in the interposition stack."""
+    from ompi_tpu import pml
+
+    cur = pml.instance()
+    while cur is not None:
+        if isinstance(cur, MonitoringPml):
+            return cur
+        cur = getattr(cur, "_inner", None)
+    return None
+
+
+def uninstall() -> None:
+    from ompi_tpu import pml
+
+    cur = pml.instance()
+    if isinstance(cur, MonitoringPml):
+        pml.set_current(cur._inner)
+
+
+def matrix(collective: bool = False) -> Dict[int, Tuple[int, int]]:
+    """Send-side traffic matrix {peer_world_rank: (msgs, bytes)}."""
+    mon = installed()
+    if mon is None:
+        return {}
+    table = mon.coll if collective else mon.p2p
+    return {peer: tuple(cell) for peer, cell in sorted(table.items())}
+
+
+def dump() -> None:
+    """common/monitoring-style matrix dump to the output stream."""
+    mon = installed()
+    if mon is None:
+        _out.verbose(0, "monitoring not installed")
+        return
+    from ompi_tpu.runtime import rte
+
+    for label, table in (("p2p", mon.p2p), ("coll", mon.coll)):
+        for peer, (msgs, nbytes) in sorted(table.items()):
+            _out.verbose(
+                0, "rank %d -> %d [%s]: %d msgs, %d bytes",
+                rte.rank, peer, label, msgs, nbytes)

@@ -1,0 +1,228 @@
+"""pml/v + vprotocol/pessimist — sender-based message logging.
+
+Reference: ompi/mca/pml/v (469 LoC) + vprotocol/pessimist (3,224 LoC):
+an interposition PML that (a) keeps a copy of every sent message in the
+sender's volatile memory (sender-based logging), and (b) logs every
+nondeterministic event outcome — which source/tag a receive actually
+matched, in completion order (the "determinants") — to stable storage.
+After a failure, a restarted process replays: peers re-send from their
+send logs and the process consumes them in the recorded determinant
+order, reconstructing its pre-crash state without coordinated
+checkpoints (uncoordinated recovery).
+
+Scope here: the logging planes and the replay channel — install(),
+per-peer send logs with resend(), determinant capture with optional
+disk persistence, and truncation on acknowledged progress. Process
+re-spawn itself rides the ULFM + connect/accept machinery
+(ompi_tpu.ft, ompi_tpu.comm.intercomm); the recovery *protocol* is the
+application/runtime policy layered on these, as in the reference where
+pml/v supplies mechanism and the fault-tolerance runtime drives it.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ompi_tpu.core import cvar, output, pvar
+
+_out = output.stream("vprotocol")
+
+_enable_var = cvar.register(
+    "pml_v", False, bool,
+    help="Install the message-logging interposition PML at init "
+         "(reference: pml/v + vprotocol/pessimist).", level=7)
+_dir_var = cvar.register(
+    "vprotocol_log_dir", "", str,
+    help="Directory for determinant logs (stable storage). Empty = "
+         "memory only (volatile, like the reference's sender log; "
+         "determinants then survive only with the process).", level=7)
+
+
+class VprotocolPml:
+    """Wraps the selected PML; logs sends + recv determinants."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+        self._lock = threading.Lock()
+        # sender-based log: dst world rank -> [(kind, comm_cid, tag,
+        # payload)] in send order; kind 'buf' payload = (bytes, dtype
+        # str, count) | kind 'obj' payload = object
+        self.send_log: Dict[int, List[Tuple]] = {}
+        # determinants: completion-order (source, tag, count) of every
+        # receive — the nondeterministic outcomes
+        self.determinants: List[Tuple[int, int, int]] = []
+        self._det_fh = None
+        d = _dir_var.get()
+        if d:
+            from ompi_tpu.runtime import rte
+
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"det_{rte.jobid}_{rte.rank}.log")
+            self._det_fh = open(path, "ab")
+
+    # -- send side: log a copy (sender-based logging) ---------------------
+    def _world(self, comm, dst: int) -> int:
+        g = comm.remote_group if getattr(comm, "is_inter", False) \
+            else comm.group
+        try:
+            return g.ranks[dst]
+        except (IndexError, TypeError):
+            return dst
+
+    def _log_send(self, comm, dst: int, entry: Tuple) -> None:
+        if dst < 0:
+            return
+        with self._lock:
+            self.send_log.setdefault(
+                self._world(comm, dst), []).append(entry)
+        pvar.record("vprotocol_logged_sends")
+
+    def isend(self, comm, buf, count, dtype, dst, tag, **kw):
+        import numpy as np
+
+        if kw.get("collective"):
+            # collective-internal rounds are deterministically
+            # re-executed on recovery, never replayed (the reference
+            # logs application messages only)
+            return self._inner.isend(comm, buf, count, dtype, dst,
+                                     tag, **kw)
+        arr = np.ascontiguousarray(buf) if buf is not None else None
+        if arr is not None:
+            self._log_send(comm, dst, (
+                "buf", comm.cid, tag,
+                (arr.tobytes(), arr.dtype.str, count)))
+        return self._inner.isend(comm, buf, count, dtype, dst, tag, **kw)
+
+    def send(self, comm, buf, count, dtype, dst, tag, **kw):
+        req = self.isend(comm, buf, count, dtype, dst, tag, **kw)
+        return req.wait()
+
+    def isend_obj(self, comm, obj, dst, tag, **kw):
+        if not kw.get("collective"):
+            self._log_send(comm, dst, ("obj", comm.cid, tag, obj))
+        return self._inner.isend_obj(comm, obj, dst, tag, **kw)
+
+    def send_obj(self, comm, obj, dst, tag, **kw):
+        return self.isend_obj(comm, obj, dst, tag, **kw).wait()
+
+    # -- recv side: determinant capture -----------------------------------
+    def _record_det(self, req) -> None:
+        det = (req.status.source, req.status.tag, req.status.count)
+        with self._lock:
+            self.determinants.append(det)
+            if self._det_fh is not None:
+                pickle.dump(det, self._det_fh)
+                self._det_fh.flush()
+
+    def _capture(self, req):
+        if req.completed:
+            # matched synchronously from the unexpected queue inside
+            # the inner irecv — the outcome is already determined
+            self._record_det(req)
+            return req
+        orig_complete = req.complete
+
+        def complete(error: int = 0):
+            orig_complete(error)
+            self._record_det(req)
+
+        req.complete = complete
+        return req
+
+    def irecv(self, comm, buf, count, dtype, src, tag, **kw):
+        req = self._inner.irecv(comm, buf, count, dtype, src, tag, **kw)
+        return req if kw.get("collective") else self._capture(req)
+
+    def irecv_obj(self, comm, src, tag, **kw):
+        req = self._inner.irecv_obj(comm, src, tag, **kw)
+        return req if kw.get("collective") else self._capture(req)
+
+    def recv(self, comm, buf, count, dtype, src, tag, **kw):
+        return self.irecv(comm, buf, count, dtype, src, tag, **kw).wait()
+
+    def recv_obj(self, comm, src, tag, **kw):
+        req = self.irecv_obj(comm, src, tag, **kw)
+        req.wait()
+        return req._obj
+
+    # -- replay channel ----------------------------------------------------
+    def resend(self, peer_world: int, comm) -> int:
+        """Re-transmit every logged message for a recovering peer, in
+        original order (the pessimist replay: the peer consumes them
+        guided by its determinant log). Returns messages resent."""
+        import numpy as np
+
+        with self._lock:
+            entries = list(self.send_log.get(peer_world, ()))
+        g = comm.remote_group if getattr(comm, "is_inter", False) \
+            else comm.group
+        dst = g.ranks.index(peer_world)
+        n = 0
+        for kind, cid, tag, payload in entries:
+            if cid != comm.cid:
+                continue
+            if kind == "buf":
+                raw, dtstr, count = payload
+                arr = np.frombuffer(raw, dtype=np.dtype(dtstr))
+                self._inner.send(comm, arr, count, None, dst, tag)
+            else:
+                self._inner.send_obj(comm, payload, dst, tag)
+            n += 1
+        pvar.record("vprotocol_resends", n)
+        return n
+
+    def truncate(self, peer_world: int,
+                 keep_last: int = 0) -> None:
+        """Garbage-collect the send log for a peer once its progress
+        is known stable (the reference truncates on checkpoint/ack)."""
+        with self._lock:
+            log = self.send_log.get(peer_world)
+            if log is not None:
+                del log[:len(log) - keep_last]
+
+    # -- passthrough -------------------------------------------------------
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def install() -> VprotocolPml:
+    from ompi_tpu import pml
+
+    cur = pml.current()
+    if isinstance(cur, VprotocolPml):
+        return cur
+    v = VprotocolPml(cur)
+    pml.set_current(v)
+    return v
+
+
+def installed() -> Optional[VprotocolPml]:
+    """Find the vprotocol layer anywhere in the interposition stack
+    (other layers, e.g. pml/monitoring, may wrap it)."""
+    from ompi_tpu import pml
+
+    cur = pml.instance()
+    while cur is not None:
+        if isinstance(cur, VprotocolPml):
+            return cur
+        cur = getattr(cur, "_inner", None)
+    return None
+
+
+def load_determinants(jobid: str, rank: int) -> List[Tuple]:
+    """Read a (possibly dead) rank's persisted determinant log."""
+    d = _dir_var.get()
+    if not d:
+        return []
+    path = os.path.join(d, f"det_{jobid}_{rank}.log")
+    out: List[Tuple] = []
+    try:
+        with open(path, "rb") as fh:
+            while True:
+                out.append(pickle.load(fh))
+    except (FileNotFoundError, EOFError):
+        pass
+    return out

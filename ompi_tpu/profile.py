@@ -1,0 +1,111 @@
+"""PMPI-style profiling interposition on the MPI API surface.
+
+Reference: every C binding is a weak symbol so tools can interpose
+(ompi/mpi/c/allreduce.c:37-41 PMPI_Allreduce alias; Fortran and SHMEM
+likewise) and SPC_RECORD instruments each entry.
+
+Pythonic redesign: the API methods live in one dispatch table
+(ompi_tpu.mpi._API) attached to Communicator; a tool attaches pre/post
+hooks and every MPI call on every communicator flows through them.
+Attach twice and the wrappers nest — the PMPI chaining behavior.
+
+    from ompi_tpu import profile
+    handle = profile.attach_tool(
+        pre=lambda name, comm, args, kwargs: ...,
+        post=lambda name, comm, result, error: ...)
+    ...
+    profile.detach_tool(handle)
+
+A ready-made timing tool is included: ``with profile.timing() as t``
+collects per-call counts and wall time (the SPC/MPI_T overhead-harness
+pattern, test/monitoring/test_overhead.c).
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional
+
+_handles = itertools.count(1)
+_active: Dict[int, Dict[str, Callable]] = {}  # handle -> {name: prev_fn}
+
+
+def _wrap(name: str, fn: Callable, pre, post) -> Callable:
+    @functools.wraps(fn)
+    def wrapper(comm, *args, **kwargs):
+        if pre is not None:
+            pre(name, comm, args, kwargs)
+        error = None
+        result = None
+        try:
+            result = fn(comm, *args, **kwargs)
+            return result
+        except BaseException as exc:
+            error = exc
+            raise
+        finally:
+            if post is not None:
+                post(name, comm, result, error)
+    wrapper.__profiled__ = True
+    return wrapper
+
+
+def attach_tool(pre: Optional[Callable] = None,
+                post: Optional[Callable] = None,
+                names: Optional[list] = None) -> int:
+    """Interpose pre/post hooks on the MPI API; returns a handle for
+    detach_tool. `names` limits interposition to specific calls."""
+    from ompi_tpu import mpi
+    from ompi_tpu.comm import Communicator
+
+    targets = names if names is not None else list(mpi._API)
+    saved: Dict[str, Callable] = {}
+    for name in targets:
+        cur = getattr(Communicator, name, None)
+        if cur is None:
+            continue
+        saved[name] = cur  # what this tool wrapped (maybe a wrapper)
+        setattr(Communicator, name, _wrap(name, cur, pre, post))
+    handle = next(_handles)
+    _active[handle] = saved
+    return handle
+
+
+def detach_tool(handle: int) -> None:
+    """Remove a tool by restoring the methods it wrapped. Tools nest
+    like PMPI layers: detach in LIFO order (detaching an inner tool
+    out of order drops any tool attached after it on those names)."""
+    from ompi_tpu.comm import Communicator
+
+    saved = _active.pop(handle, None)
+    if saved is None:
+        return
+    for name, prev in saved.items():
+        setattr(Communicator, name, prev)
+
+
+@contextmanager
+def timing(names: Optional[list] = None):
+    """Collect per-call counts and wall-clock seconds."""
+    stats: Dict[str, list] = {}
+    stack: Dict[int, float] = {}
+
+    def pre(name, comm, args, kwargs):
+        stack[id(comm), name] = time.perf_counter()
+
+    def post(name, comm, result, error):
+        t0 = stack.pop((id(comm), name), None)
+        if t0 is None:
+            return
+        cell = stats.setdefault(name, [0, 0.0])
+        cell[0] += 1
+        cell[1] += time.perf_counter() - t0
+
+    handle = attach_tool(pre, post, names)
+    try:
+        yield stats
+    finally:
+        detach_tool(handle)

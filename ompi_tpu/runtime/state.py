@@ -67,6 +67,15 @@ def init(thread_level: int = 0):
         from ompi_tpu.comm import build_world
 
         pml.select()
+        # interposition layers stack over the selected PML before any
+        # traffic flows (reference: pml/monitoring wraps at select)
+        from ompi_tpu.pml import monitoring as _pml_mon
+        from ompi_tpu.pml import vprotocol as _pml_v
+
+        if _pml_v._enable_var.get():
+            _pml_v.install()
+        if _pml_mon._enable_var.get():
+            _pml_mon.install()
         _world, _self_comm = build_world()
 
         # ULFM detector (opt-in: --mca ft 1); after comm construction so
