@@ -307,7 +307,8 @@ def reduce_scatter_basic(comm, sendbuf, recvbuf, counts, dtype, op):
     sb = np.asarray(sendbuf)
     total = np.empty_like(sb) if comm.rank == 0 else sb
     reduce_linear(comm, sb, total, int(sum(counts)), dtype, op, 0)
-    displs = np.concatenate([[0], np.cumsum(counts[:-1])]).tolist()
+    displs = np.concatenate(
+        [[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
     scatterv_linear(comm, total if comm.rank == 0 else None, recvbuf,
                     counts, displs, dtype, 0)
 

@@ -215,6 +215,169 @@ def _sched_reduce(comm, sendbuf, recvbuf, count, dtype, op, root, tag):
         np.copyto(np.asarray(recvbuf), acc, casting="same_kind")
 
 
+def _sched_gatherv(comm, sendbuf, recvbuf, counts, displs, dtype,
+                   root, tag):
+    rank, size = comm.rank, comm.size
+    sb = np.asarray(sendbuf)
+    if rank == root:
+        rb = np.asarray(recvbuf).reshape(-1)
+        rb[displs[root]:displs[root] + counts[root]] = sb.reshape(-1)
+        yield [_irecv(comm, rb[displs[r]:displs[r] + counts[r]],
+                      counts[r], dtype, r, tag)
+               for r in range(size) if r != root and counts[r]]
+    elif counts[rank]:
+        yield [_isend(comm, sb, counts[rank], dtype, root, tag)]
+
+
+def _sched_scatterv(comm, sendbuf, recvbuf, counts, displs, dtype,
+                    root, tag):
+    rank, size = comm.rank, comm.size
+    rb = np.asarray(recvbuf)
+    if rank == root:
+        sb = np.asarray(sendbuf).reshape(-1)
+        rb.reshape(-1)[:counts[root]] = \
+            sb[displs[root]:displs[root] + counts[root]]
+        yield [_isend(comm, sb[displs[r]:displs[r] + counts[r]].copy(),
+                      counts[r], dtype, r, tag)
+               for r in range(size) if r != root and counts[r]]
+    elif counts[rank]:
+        yield [_irecv(comm, rb, counts[rank], dtype, root, tag)]
+
+
+def _sched_allgatherv(comm, sendbuf, recvbuf, counts, displs, dtype,
+                      tag):
+    """gatherv at 0, then binomial bcast of the assembled buffer."""
+    rank = comm.rank
+    rb = np.asarray(recvbuf).reshape(-1)
+    sb = rb[displs[rank]:displs[rank] + counts[rank]].copy() \
+        if sendbuf is B.IN_PLACE else sendbuf
+    yield from _sched_gatherv(comm, sb, recvbuf, counts, displs,
+                              dtype, 0, tag)
+    total = max(displs[r] + counts[r] for r in range(comm.size))
+    yield from _sched_bcast(comm, rb[:total], total, dtype, 0, tag)
+
+
+def _sched_alltoallv(comm, sendbuf, recvbuf, scounts, sdispls,
+                     rcounts, rdispls, dtype, tag):
+    """Pairwise rounds with per-peer counts (libnbc ialltoallv)."""
+    rank, size = comm.rank, comm.size
+    sb = np.asarray(sendbuf).reshape(-1)
+    rb = np.asarray(recvbuf).reshape(-1)
+    rb[rdispls[rank]:rdispls[rank] + rcounts[rank]] = \
+        sb[sdispls[rank]:sdispls[rank] + scounts[rank]]
+    for step in range(1, size):
+        to = (rank + step) % size
+        frm = (rank - step + size) % size
+        ops = []
+        if rcounts[frm]:
+            ops.append(_irecv(
+                comm, rb[rdispls[frm]:rdispls[frm] + rcounts[frm]],
+                rcounts[frm], dtype, frm, tag))
+        if scounts[to]:
+            ops.append(_isend(
+                comm, sb[sdispls[to]:sdispls[to] + scounts[to]].copy(),
+                scounts[to], dtype, to, tag))
+        if ops:
+            yield ops
+
+
+def _sched_scan(comm, sendbuf, recvbuf, count, dtype, op, tag,
+                exclusive: bool):
+    """Linear chain rounds (libnbc iscan/iexscan)."""
+    rank, size = comm.rank, comm.size
+    sb = np.asarray(recvbuf) if sendbuf is B.IN_PLACE \
+        else np.asarray(sendbuf)
+    rb = np.asarray(recvbuf)
+    acc = sb.copy()  # inclusive prefix through this rank
+    if rank > 0:
+        tmp = np.empty_like(acc)
+        yield [_irecv(comm, tmp, count, dtype, rank - 1, tag)]
+        if exclusive:
+            np.copyto(rb, tmp, casting="same_kind")
+        acc = op.np_fn(tmp, acc)
+    if not exclusive:
+        np.copyto(rb, acc, casting="same_kind")
+    if rank + 1 < size:
+        yield [_isend(comm, acc, count, dtype, rank + 1, tag)]
+
+
+def _flat(buf):
+    """Flatten a user buffer for the 1-D staging compositions (other
+    schedules reshape internally; _sched_reduce's final copyto needs
+    matching shapes)."""
+    return buf if buf is B.IN_PLACE else np.asarray(buf).reshape(-1)
+
+
+def _sched_reduce_scatter_block(comm, sendbuf, recvbuf, count, dtype,
+                                op, tag):
+    """reduce at 0 + scatter rounds (compose: the schedule engine makes
+    pipelined composition a yield-from)."""
+    size = comm.size
+    full = np.empty(size * count, dtype=np.asarray(recvbuf).dtype) \
+        if comm.rank == 0 else None
+    yield from _sched_reduce(comm, _flat(sendbuf), full, size * count,
+                             dtype, op, 0, tag)
+    yield from _sched_scatter(comm, full, recvbuf, count, dtype, 0, tag)
+
+
+def _sched_reduce_scatter(comm, sendbuf, recvbuf, counts, dtype, op,
+                          tag):
+    total = sum(counts)
+    displs = np.concatenate(
+        ([0], np.cumsum(counts[:-1], dtype=np.intp))).tolist()
+    full = np.empty(total, dtype=np.asarray(recvbuf).dtype) \
+        if comm.rank == 0 else None
+    yield from _sched_reduce(comm, _flat(sendbuf), full, total, dtype,
+                             op, 0, tag)
+    yield from _sched_scatterv(comm, full, recvbuf, counts, displs,
+                               dtype, 0, tag)
+
+
+# -- persistent collectives (MPI-4 *_init over the schedule engine) --------
+
+class PersistentCollRequest(rq.Request):
+    """MPI-4 persistent collective: start() re-launches the schedule;
+    the request is reusable (reference: the 17 *_init slots of
+    coll.h:532-649, implemented in libnbc).
+
+    ``completed`` proxies the live schedule, so the plural waits
+    (wait_all/wait_any/test_all) — which poll ``r.completed`` while
+    spinning the progress engine — observe completion without needing
+    a per-request test() call."""
+
+    def __init__(self, factory) -> None:
+        super().__init__()
+        self.persistent = True
+        self._factory = factory
+        self._inner: Optional[NbcRequest] = None
+        self._idle_done = True  # inactive counts as complete (MPI)
+
+    @property
+    def completed(self) -> bool:
+        if self._inner is not None:
+            return self._inner.completed
+        return self._idle_done
+
+    @completed.setter
+    def completed(self, v: bool) -> None:  # base __init__ writes here
+        self._idle_done = bool(v)
+
+    def start(self) -> None:
+        if self._inner is not None and not self._inner.completed:
+            raise RuntimeError("persistent collective already active")
+        self._inner = NbcRequest(self._factory())
+
+    def test(self) -> bool:
+        if not self.completed:
+            progress.progress()
+        return self.completed
+
+    def wait(self, timeout=None):
+        if self._inner is not None:
+            return self._inner.wait(timeout)
+        return self.status
+
+
 # -- component ------------------------------------------------------------
 
 def ibarrier(comm):
@@ -256,6 +419,92 @@ def ialltoall(comm, sendbuf, recvbuf, count, dtype):
                                       dtype, _tag(comm)))
 
 
+def igatherv(comm, sendbuf, recvbuf, counts, displs, dtype, root):
+    return NbcRequest(_sched_gatherv(comm, sendbuf, recvbuf, counts,
+                                     displs, dtype, root, _tag(comm)))
+
+
+def iscatterv(comm, sendbuf, recvbuf, counts, displs, dtype, root):
+    return NbcRequest(_sched_scatterv(comm, sendbuf, recvbuf, counts,
+                                      displs, dtype, root, _tag(comm)))
+
+
+def iallgatherv(comm, sendbuf, recvbuf, counts, displs, dtype):
+    return NbcRequest(_sched_allgatherv(comm, sendbuf, recvbuf, counts,
+                                        displs, dtype, _tag(comm)))
+
+
+def ialltoallv(comm, sendbuf, recvbuf, scounts, sdispls, rcounts,
+               rdispls, dtype):
+    return NbcRequest(_sched_alltoallv(
+        comm, sendbuf, recvbuf, scounts, sdispls, rcounts, rdispls,
+        dtype, _tag(comm)))
+
+
+def iscan(comm, sendbuf, recvbuf, count, dtype, op):
+    return NbcRequest(_sched_scan(comm, sendbuf, recvbuf, count, dtype,
+                                  op, _tag(comm), exclusive=False))
+
+
+def iexscan(comm, sendbuf, recvbuf, count, dtype, op):
+    return NbcRequest(_sched_scan(comm, sendbuf, recvbuf, count, dtype,
+                                  op, _tag(comm), exclusive=True))
+
+
+def ireduce_scatter_block(comm, sendbuf, recvbuf, count, dtype, op):
+    return NbcRequest(_sched_reduce_scatter_block(
+        comm, sendbuf, recvbuf, count, dtype, op, _tag(comm)))
+
+
+def ireduce_scatter(comm, sendbuf, recvbuf, counts, dtype, op):
+    return NbcRequest(_sched_reduce_scatter(
+        comm, sendbuf, recvbuf, counts, dtype, op, _tag(comm)))
+
+
+def _persistent(sched, comm, *args):
+    # one tag per start: each launch is a distinct operation on the
+    # collective context
+    return PersistentCollRequest(lambda: sched(comm, *args, _tag(comm)))
+
+
+def barrier_init(comm):
+    return _persistent(_sched_barrier, comm)
+
+
+def bcast_init(comm, buf, count, dtype, root):
+    return _persistent(_sched_bcast, comm, buf, count, dtype, root)
+
+
+def allreduce_init(comm, sendbuf, recvbuf, count, dtype, op):
+    return _persistent(_sched_allreduce, comm, sendbuf, recvbuf, count,
+                       dtype, op)
+
+
+def reduce_init(comm, sendbuf, recvbuf, count, dtype, op, root):
+    return _persistent(_sched_reduce, comm, sendbuf, recvbuf, count,
+                       dtype, op, root)
+
+
+def gather_init(comm, sendbuf, recvbuf, count, dtype, root):
+    return _persistent(_sched_gather, comm, sendbuf, recvbuf, count,
+                       dtype, root)
+
+
+def scatter_init(comm, sendbuf, recvbuf, count, dtype, root):
+    return _persistent(_sched_scatter, comm, sendbuf, recvbuf, count,
+                       dtype, root)
+
+
+def allgather_init(comm, sendbuf, recvbuf, count, dtype):
+    return _persistent(_sched_allgather, comm, sendbuf, recvbuf, count,
+                       dtype)
+
+
+def alltoall_init(comm, sendbuf, recvbuf, count, dtype):
+    return _persistent(_sched_alltoall, comm, sendbuf, recvbuf, count,
+                       dtype)
+
+
 @framework.register
 class CollLibnbc(CollModule):
     NAME = "libnbc"
@@ -271,4 +520,21 @@ class CollLibnbc(CollModule):
             "iscatter": iscatter,
             "iallgather": iallgather,
             "ialltoall": ialltoall,
+            "igatherv": igatherv,
+            "iscatterv": iscatterv,
+            "iallgatherv": iallgatherv,
+            "ialltoallv": ialltoallv,
+            "iscan": iscan,
+            "iexscan": iexscan,
+            "ireduce_scatter": ireduce_scatter,
+            "ireduce_scatter_block": ireduce_scatter_block,
+            # MPI-4 persistent collectives
+            "barrier_init": barrier_init,
+            "bcast_init": bcast_init,
+            "allreduce_init": allreduce_init,
+            "reduce_init": reduce_init,
+            "gather_init": gather_init,
+            "scatter_init": scatter_init,
+            "allgather_init": allgather_init,
+            "alltoall_init": alltoall_init,
         }

@@ -59,7 +59,10 @@ def _parse_buf(buf) -> Tuple[Any, int, Optional[Datatype]]:
 
 class _PersistentRequest(rq.Request):
     """MPI_Send_init / MPI_Recv_init handles (reference: persistent
-    requests restarted by MPI_Start)."""
+    requests restarted by MPI_Start). ``completed``/``status`` proxy
+    the live inner request so the plural waits (wait_all/test_any),
+    which poll ``r.completed`` while spinning progress, observe
+    completion without a per-request test()."""
 
     def __init__(self, comm, kind: str, args: tuple) -> None:
         super().__init__()
@@ -68,7 +71,27 @@ class _PersistentRequest(rq.Request):
         self.kind = kind
         self.args = args
         self._live: Optional[rq.Request] = None
-        self.completed = True  # inactive until started
+        self._idle_done = True  # inactive counts as complete (MPI)
+
+    @property
+    def completed(self) -> bool:
+        if self._live is not None:
+            return self._live.completed
+        return self._idle_done
+
+    @completed.setter
+    def completed(self, v: bool) -> None:  # base __init__ writes here
+        self._idle_done = bool(v)
+
+    @property
+    def status(self) -> rq.Status:
+        if self._live is not None:
+            return self._live.status
+        return self._idle_status
+
+    @status.setter
+    def status(self, st) -> None:  # base __init__ writes here
+        self._idle_status = st
 
     def start(self) -> None:
         p = pml.current()
@@ -78,21 +101,18 @@ class _PersistentRequest(rq.Request):
         else:
             buf, count, dt, src, tag = self.args
             self._live = p.irecv(self.comm, buf, count, dt, src, tag)
-        self.completed = False
 
     def test(self) -> bool:
-        if self._live is not None and self._live.test():
-            self.status = self._live.status
-            self.completed = True
+        if not self.completed:
+            from ompi_tpu.core import progress
+
+            progress.progress()
         return self.completed
 
     def wait(self, timeout=None):
         if self._live is None:
             return self.status
-        st = self._live.wait(timeout=timeout)
-        self.status = st
-        self.completed = True
-        return st
+        return self._live.wait(timeout=timeout)
 
 
 def start_all(reqs: Sequence[_PersistentRequest]) -> None:
@@ -358,7 +378,7 @@ def _Gatherv(self, sendbuf, recvbuf, counts, displs=None,
     sarr = _parse_buf(sendbuf)[0]
     rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
     if displs is None:
-        displs = np.concatenate([[0], np.cumsum(counts[:-1])]).tolist()
+        displs = np.concatenate([[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
     self.coll.gatherv(self, sarr, rarr, counts, displs,
                       dtype_of(sarr), root)
 
@@ -383,7 +403,7 @@ def _Scatterv(self, sendbuf, recvbuf, counts, displs=None,
     rarr = _parse_buf(recvbuf)[0]
     sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
     if displs is None:
-        displs = np.concatenate([[0], np.cumsum(counts[:-1])]).tolist()
+        displs = np.concatenate([[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
     self.coll.scatterv(self, sarr, rarr, counts, displs,
                        dtype_of(rarr), root)
 
@@ -404,7 +424,7 @@ def _Allgatherv(self, sendbuf, recvbuf, counts, displs=None) -> None:
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if displs is None:
-        displs = np.concatenate([[0], np.cumsum(counts[:-1])]).tolist()
+        displs = np.concatenate([[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
     self.coll.allgatherv(self, sarr, rarr, counts, displs,
                          dtype_of(sarr))
 
@@ -427,9 +447,9 @@ def _Alltoallv(self, sendbuf, recvbuf, scounts, rcounts,
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if sdispls is None:
-        sdispls = np.concatenate([[0], np.cumsum(scounts[:-1])]).tolist()
+        sdispls = np.concatenate([[0], np.cumsum(scounts[:-1], dtype=np.intp)]).tolist()
     if rdispls is None:
-        rdispls = np.concatenate([[0], np.cumsum(rcounts[:-1])]).tolist()
+        rdispls = np.concatenate([[0], np.cumsum(rcounts[:-1], dtype=np.intp)]).tolist()
     self.coll.alltoallv(self, sarr, rarr, scounts, sdispls, rcounts,
                         rdispls, dtype_of(sarr))
 
@@ -523,6 +543,129 @@ def _Ialltoall(self, sendbuf, recvbuf) -> rq.Request:
     return self.coll.ialltoall(self, sarr, rarr, count, dtype_of(sarr))
 
 
+def _Igatherv(self, sendbuf, recvbuf, counts, displs=None,
+              root: int = 0) -> rq.Request:
+    sarr = _parse_buf(sendbuf)[0]
+    rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
+    if displs is None:
+        displs = np.concatenate([[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
+    return self.coll.igatherv(self, sarr, rarr, counts, displs,
+                              dtype_of(sarr), root)
+
+
+def _Iscatterv(self, sendbuf, recvbuf, counts, displs=None,
+               root: int = 0) -> rq.Request:
+    rarr = _parse_buf(recvbuf)[0]
+    sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
+    if displs is None:
+        displs = np.concatenate([[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
+    return self.coll.iscatterv(self, sarr, rarr, counts, displs,
+                               dtype_of(rarr), root)
+
+
+def _Iallgatherv(self, sendbuf, recvbuf, counts,
+                 displs=None) -> rq.Request:
+    sarr = IN_PLACE if sendbuf is IN_PLACE else _parse_buf(sendbuf)[0]
+    rarr = _parse_buf(recvbuf)[0]
+    if displs is None:
+        displs = np.concatenate(
+            [[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
+    return self.coll.iallgatherv(self, sarr, rarr, counts, displs,
+                                 dtype_of(rarr))
+
+
+def _Ialltoallv(self, sendbuf, recvbuf, scounts, rcounts,
+                sdispls=None, rdispls=None) -> rq.Request:
+    sarr = _parse_buf(sendbuf)[0]
+    rarr = _parse_buf(recvbuf)[0]
+    if sdispls is None:
+        sdispls = np.concatenate([[0], np.cumsum(scounts[:-1], dtype=np.intp)]).tolist()
+    if rdispls is None:
+        rdispls = np.concatenate([[0], np.cumsum(rcounts[:-1], dtype=np.intp)]).tolist()
+    return self.coll.ialltoallv(self, sarr, rarr, scounts, sdispls,
+                                rcounts, rdispls, dtype_of(sarr))
+
+
+def _Iscan(self, sendbuf, recvbuf, op=op_mod.SUM) -> rq.Request:
+    rarr, rcount, rdt = _parse_buf(recvbuf)
+    if sendbuf is IN_PLACE:
+        return self.coll.iscan(self, IN_PLACE, rarr, rcount, rdt, op)
+    sarr, count, dt = _parse_buf(sendbuf)
+    return self.coll.iscan(self, sarr, rarr, count, dt, op)
+
+
+def _Iexscan(self, sendbuf, recvbuf, op=op_mod.SUM) -> rq.Request:
+    rarr, rcount, rdt = _parse_buf(recvbuf)
+    if sendbuf is IN_PLACE:
+        return self.coll.iexscan(self, IN_PLACE, rarr, rcount, rdt, op)
+    sarr, count, dt = _parse_buf(sendbuf)
+    return self.coll.iexscan(self, sarr, rarr, count, dt, op)
+
+
+def _Ireduce_scatter_block(self, sendbuf, recvbuf,
+                           op=op_mod.SUM) -> rq.Request:
+    rarr, count, dt = _parse_buf(recvbuf)
+    return self.coll.ireduce_scatter_block(
+        self, _parse_buf(sendbuf)[0], rarr, count, dt, op)
+
+
+def _Ireduce_scatter(self, sendbuf, recvbuf, counts,
+                     op=op_mod.SUM) -> rq.Request:
+    rarr = _parse_buf(recvbuf)[0]
+    return self.coll.ireduce_scatter(self, _parse_buf(sendbuf)[0],
+                                     rarr, counts, dtype_of(rarr), op)
+
+
+# -- MPI-4 persistent collectives (coll.h *_init slots via libnbc) -------
+
+def _Barrier_init(self) -> rq.Request:
+    return self.coll.barrier_init(self)
+
+
+def _Bcast_init(self, buf, root: int = 0) -> rq.Request:
+    arr, count, dt = _parse_buf(buf)
+    return self.coll.bcast_init(self, arr, count, dt, root)
+
+
+def _Allreduce_init(self, sendbuf, recvbuf, op=op_mod.SUM) -> rq.Request:
+    sarr, count, dt = _parse_buf(sendbuf)
+    return self.coll.allreduce_init(self, sarr, _parse_buf(recvbuf)[0],
+                                    count, dt, op)
+
+
+def _Reduce_init(self, sendbuf, recvbuf, op=op_mod.SUM,
+                 root: int = 0) -> rq.Request:
+    sarr, count, dt = _parse_buf(sendbuf)
+    rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
+    return self.coll.reduce_init(self, sarr, rarr, count, dt, op, root)
+
+
+def _Gather_init(self, sendbuf, recvbuf, root: int = 0) -> rq.Request:
+    sarr, count, dt = _parse_buf(sendbuf)
+    rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
+    return self.coll.gather_init(self, sarr, rarr, count, dt, root)
+
+
+def _Scatter_init(self, sendbuf, recvbuf, root: int = 0) -> rq.Request:
+    rarr, count, dt = _parse_buf(recvbuf)
+    sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
+    return self.coll.scatter_init(self, sarr, rarr, count, dt, root)
+
+
+def _Allgather_init(self, sendbuf, recvbuf) -> rq.Request:
+    sarr, count, dt = _parse_buf(sendbuf)
+    return self.coll.allgather_init(self, sarr, _parse_buf(recvbuf)[0],
+                                    count, dt)
+
+
+def _Alltoall_init(self, sendbuf, recvbuf) -> rq.Request:
+    sarr = _parse_buf(sendbuf)[0]
+    rarr = _parse_buf(recvbuf)[0]
+    count = np.asarray(sarr).size // self.size
+    return self.coll.alltoall_init(self, sarr, rarr, count,
+                                   dtype_of(sarr))
+
+
 def _barrier(self) -> None:
     _Barrier(self)
 
@@ -604,6 +747,15 @@ _API = {
     "Iallreduce": _Iallreduce, "Ireduce": _Ireduce,
     "Igather": _Igather, "Iscatter": _Iscatter,
     "Iallgather": _Iallgather, "Ialltoall": _Ialltoall,
+    "Igatherv": _Igatherv, "Iscatterv": _Iscatterv,
+    "Iallgatherv": _Iallgatherv, "Ialltoallv": _Ialltoallv,
+    "Iscan": _Iscan, "Iexscan": _Iexscan,
+    "Ireduce_scatter": _Ireduce_scatter,
+    "Ireduce_scatter_block": _Ireduce_scatter_block,
+    "Barrier_init": _Barrier_init, "Bcast_init": _Bcast_init,
+    "Allreduce_init": _Allreduce_init, "Reduce_init": _Reduce_init,
+    "Gather_init": _Gather_init, "Scatter_init": _Scatter_init,
+    "Allgather_init": _Allgather_init, "Alltoall_init": _Alltoall_init,
 }
 
 for _name, _fn in _API.items():

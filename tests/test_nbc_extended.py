@@ -1,0 +1,104 @@
+"""Extended nonblocking + persistent collectives (libnbc completeness:
+iallgatherv/ialltoallv/iscan/iexscan/ireduce_scatter + MPI-4 *_init).
+
+Reference analog: libnbc's full 17-slot nonblocking + persistent
+tables (coll.h:532-649)."""
+
+import numpy as np
+
+from tests.harness import run_ranks
+
+
+def test_i_vector_collectives():
+    run_ranks("""
+        from ompi_tpu import mpi
+        counts = [r + 1 for r in range(size)]
+        displs = list(np.concatenate([[0], np.cumsum(counts[:-1])]))
+        total = sum(counts)
+        mine = np.full(rank + 1, rank, dtype=np.float64)
+        # Iallgatherv
+        out = np.zeros(total, dtype=np.float64)
+        comm.Iallgatherv(mine, out, counts).wait()
+        expect = np.concatenate(
+            [np.full(r + 1, r, dtype=np.float64) for r in range(size)])
+        assert np.array_equal(out, expect), out
+        # Ialltoallv: send (r+1) elems of my rank to each peer r? use
+        # symmetric counts: to peer r send r+1 items valued rank
+        scounts = counts
+        rcounts = [rank + 1] * size
+        sbuf = np.concatenate(
+            [np.full(c, rank, dtype=np.float64) for c in scounts])
+        rbuf = np.zeros(sum(rcounts), dtype=np.float64)
+        comm.Ialltoallv(sbuf, rbuf, scounts, rcounts).wait()
+        expect = np.repeat(np.arange(size, dtype=np.float64), rank + 1)
+        assert np.array_equal(rbuf, expect), rbuf
+        # Igatherv at root 1
+        gout = np.zeros(total, dtype=np.float64) if rank == 1 else None
+        comm.Igatherv(mine, gout, counts, root=1).wait()
+        if rank == 1:
+            assert np.array_equal(gout, np.concatenate(
+                [np.full(r + 1, r, dtype=np.float64)
+                 for r in range(size)]))
+        # Iscatterv from root 0
+        sv = np.concatenate(
+            [np.full(r + 1, 7.0 + r, dtype=np.float64)
+             for r in range(size)]) if rank == 0 else None
+        rv = np.zeros(rank + 1, dtype=np.float64)
+        comm.Iscatterv(sv, rv, counts, root=0).wait()
+        assert np.array_equal(rv, np.full(rank + 1, 7.0 + rank)), rv
+    """, 3, timeout=180)
+
+
+def test_iscan_iexscan_ireduce_scatter():
+    run_ranks("""
+        data = np.full(4, rank + 1, dtype=np.int64)
+        out = np.zeros(4, dtype=np.int64)
+        comm.Iscan(data, out).wait()
+        assert (out == sum(range(1, rank + 2))).all(), out
+        oute = np.zeros(4, dtype=np.int64)
+        comm.Iexscan(data, oute).wait()
+        if rank > 0:
+            assert (oute == sum(range(1, rank + 1))).all(), oute
+        # ireduce_scatter_block: each rank gets its block of the sum
+        sb = np.arange(4 * size, dtype=np.int64)
+        rb = np.zeros(4, dtype=np.int64)
+        comm.Ireduce_scatter_block(sb, rb).wait()
+        assert (rb == size * np.arange(rank * 4, rank * 4 + 4)).all()
+        # ireduce_scatter with uneven counts
+        counts = [r + 1 for r in range(size)]
+        sbv = np.arange(sum(counts), dtype=np.int64)
+        rbv = np.zeros(rank + 1, dtype=np.int64)
+        comm.Ireduce_scatter(sbv, rbv, counts).wait()
+        off = sum(counts[:rank])
+        assert (rbv == size * np.arange(off, off + rank + 1)).all()
+    """, 3, timeout=180)
+
+
+def test_persistent_collectives_restart():
+    run_ranks("""
+        from ompi_tpu import mpi
+        send = np.zeros(4, dtype=np.float64)
+        out = np.zeros(4, dtype=np.float64)
+        req = comm.Allreduce_init(send, out)
+        for it in range(3):
+            send[:] = (rank + 1) * (it + 1)
+            req.start()
+            req.wait()
+            assert (out == (it + 1) * sum(
+                r + 1 for r in range(size))).all(), (it, out)
+        # persistent bcast, restarted with fresh payloads
+        buf = np.zeros(8, dtype=np.int64)
+        breq = comm.Bcast_init(buf, root=0)
+        for it in range(2):
+            if rank == 0:
+                buf[:] = np.arange(8) * (it + 1)
+            breq.start()
+            breq.wait()
+            assert np.array_equal(buf, np.arange(8) * (it + 1)), buf
+            comm.Barrier()
+        # persistent barrier + start_all
+        b1 = comm.Barrier_init()
+        b2 = comm.Barrier_init()
+        mpi.start_all([b1, b2])
+        b1.wait(); b2.wait()
+    """, 3, timeout=180)
