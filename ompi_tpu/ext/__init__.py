@@ -1,0 +1,86 @@
+"""MPI extensions — the mpiext pattern.
+
+Reference: ompi/mpiext/ (2,922 LoC): compile-time API extensions, each
+a self-contained directory exposing MPIX_* symbols — ftmpi (ULFM),
+cuda/rocm (MPIX_Query_cuda_support), affinity, shortfloat. The pattern
+exists so vendor/feature surfaces can ship without touching the core
+API namespace.
+
+Redesign: extensions are subpackages here, each registering its MPIX_*
+callables in :data:`REGISTRY` at import. ``ompi_tpu.ext.MPIX_*`` names
+resolve through the registry, so user code probes capabilities the way
+reference users probe MPIX_Query_cuda_support.
+
+Built-in extensions:
+  - tpu:   MPIX_Query_tpu_support (the cuda/rocm-extension analog)
+  - ftmpi: MPIX_Comm_revoke/shrink/agree/get_failed/ack_failed over
+           ompi_tpu.ft (the ULFM extension surface)
+  - shortfloat: MPIX_BFLOAT16/MPIX_FLOAT16 datatypes (the TPU-relevant
+           short-float types; the reference ships shortfloat for the
+           same reason)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+REGISTRY: Dict[str, object] = {}
+
+
+def register(name: str, obj) -> None:
+    """Extensions call this at import (reference: each mpiext adds its
+    MPIX_* prototypes to mpi-ext.h)."""
+    REGISTRY[name] = obj
+
+
+def available() -> list:
+    return sorted(REGISTRY)
+
+
+def __getattr__(name: str):
+    if name in REGISTRY:
+        return REGISTRY[name]
+    raise AttributeError(
+        f"no MPI extension provides {name!r}; available: {available()}")
+
+
+# -- built-in extensions ---------------------------------------------------
+
+def _query_tpu_support() -> bool:
+    """MPIX_Query_tpu_support (the MPIX_Query_cuda_support analog,
+    ompi/mpiext/cuda): True when the tpu accelerator component is
+    selected and sees at least one device."""
+    from ompi_tpu import accelerator
+
+    accel = accelerator.current()
+    if accel.NAME != "tpu":
+        return False
+    try:
+        return accel.num_devices() > 0
+    except Exception:  # noqa: BLE001 — no device runtime
+        return False
+
+
+register("MPIX_Query_tpu_support", _query_tpu_support)
+
+
+def _ftmpi() -> None:
+    from ompi_tpu import ft
+
+    register("MPIX_Comm_revoke", ft.revoke)
+    register("MPIX_Comm_shrink", ft.shrink)
+    register("MPIX_Comm_agree", ft.agree)
+    register("MPIX_Comm_get_failed", ft.get_failed)
+    register("MPIX_Comm_ack_failed", ft.ack_failed)
+
+
+def _shortfloat() -> None:
+    from ompi_tpu.datatype import datatype as dt
+
+    register("MPIX_FLOAT16", dt.FLOAT16)
+    if hasattr(dt, "BFLOAT16"):
+        register("MPIX_BFLOAT16", dt.BFLOAT16)
+
+
+_ftmpi()
+_shortfloat()

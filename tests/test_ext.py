@@ -1,0 +1,25 @@
+"""MPI extension (mpiext pattern) tests."""
+
+import pytest
+
+
+def test_registry_and_query():
+    from ompi_tpu import ext
+
+    names = ext.available()
+    assert "MPIX_Query_tpu_support" in names
+    assert "MPIX_Comm_agree" in names
+    assert "MPIX_BFLOAT16" in names
+    assert isinstance(ext.MPIX_Query_tpu_support(), bool)
+    # shortfloat datatypes are real committed datatypes
+    assert ext.MPIX_FLOAT16.size == 2
+    assert ext.MPIX_BFLOAT16.size == 2
+    with pytest.raises(AttributeError):
+        ext.MPIX_No_such_extension
+
+
+def test_ftmpi_extension_binds_ft():
+    from ompi_tpu import ext, ft
+
+    assert ext.MPIX_Comm_revoke is ft.revoke
+    assert ext.MPIX_Comm_shrink is ft.shrink
