@@ -46,6 +46,11 @@ HDR_RNDV = 2
 HDR_ACK = 3
 HDR_FRAG = 4
 HDR_FRAG_ACK = 5
+HDR_RNDV_SC = 6   # rendezvous offering single-copy (smsc/cma): the
+#                   match header + (pid, address) of the stable packed
+#                   buffer — the RGET protocol with CMA as the RDMA
+#                   (reference: pml_ob1_sendreq.c start_rdma)
+HDR_SC_FIN = 7    # receiver finished the single-copy pull
 
 FLAG_SYNC = 1  # ssend: sender wants a match ack
 FLAG_OBJ = 2   # payload is a pickled python object
@@ -54,6 +59,8 @@ _MATCH = struct.Struct("<BIiiQQBQ")
 _ACK = struct.Struct("<BQQ")
 _FRAG = struct.Struct("<BQQ")
 _FRAGACK = struct.Struct("<BQQ")
+_SC = struct.Struct("<QQ")     # pid, remote address
+_SCFIN = struct.Struct("<BQ")  # type, msgid
 
 _out = output.stream("pml_ob1")
 _msg_ids = itertools.count(1)
@@ -81,7 +88,7 @@ NO_OBJ = object()
 
 class SendRequest(rq.Request):
     __slots__ = ("conv", "dst_world", "ctx", "msgid", "recv_id",
-                 "acked_bytes", "pumping")
+                 "acked_bytes", "pumping", "sc_keep")
 
     def __init__(self) -> None:
         super().__init__()
@@ -92,6 +99,8 @@ class SendRequest(rq.Request):
         self.recv_id = 0       # RNDV: receiver's stream id
         self.acked_bytes = 0   # RNDV: FRAG_ACK high-water mark
         self.pumping = False   # re-entrancy guard (see _pump)
+        self.sc_keep = None    # single-copy: pins the exposed buffer
+        #                        until the receiver's SC_FIN
 
 
 class RecvRequest(rq.Request):
@@ -244,12 +253,49 @@ class Ob1:
                 self.bml.endpoint(dst_world).send(dst_world, hdr + payload)
                 req.complete()
         else:
-            hdr = _MATCH.pack(HDR_RNDV, ctx, src_commrank, tag, seq,
-                              size, flags, msgid)
-            pvar.record("rndv")
+            sc = self._expose_single_copy(req, dst_world)
+            if sc is not None:
+                hdr = _MATCH.pack(HDR_RNDV_SC, ctx, src_commrank, tag,
+                                  seq, size, flags, msgid) + sc
+                pvar.record("rndv_sc")
+            else:
+                hdr = _MATCH.pack(HDR_RNDV, ctx, src_commrank, tag, seq,
+                                  size, flags, msgid)
+                pvar.record("rndv")
             self.pending_ack[msgid] = req
             self.bml.endpoint(dst_world).send(dst_world, hdr)
         return req
+
+    def _expose_single_copy(self, req: SendRequest,
+                            dst_world: int) -> Optional[bytes]:
+        """Offer smsc/cma single-copy for a same-host RNDV: pin a
+        stable contiguous byte image of the message and return the
+        (pid, addr) trailer. Contiguous user buffers are exposed
+        in place (a true zero-copy offer); non-contiguous layouts are
+        packed once. Returns None when the peer is remote or cma is
+        off (reference: the smsc qualification in sm add_procs)."""
+        import os
+
+        from ompi_tpu import smsc
+
+        if not smsc.available():
+            return None
+        if self.bml.endpoint(dst_world).NAME != "sm":
+            return None
+        conv = req.conv
+        flat = conv._flat(False)
+        if conv._spans is None and flat.flags["C_CONTIGUOUS"]:
+            req.sc_keep = flat
+            addr = flat.ctypes.data
+        else:
+            data = conv.pack()
+            conv.set_position(0)  # keep the frag path viable: the
+            # receiver falls back to a plain ACK + streaming if its
+            # cma read is denied at runtime
+            view = np.frombuffer(data, dtype=np.uint8)
+            req.sc_keep = (data, view)
+            addr = view.ctypes.data
+        return _SC.pack(os.getpid(), addr)
 
     def send(self, comm, buf, count, dtype, dst: int, tag: int,
              sync: bool = False, collective: bool = False) -> None:
@@ -423,7 +469,7 @@ class Ob1:
     # -- matching & protocol (receiver side) ------------------------------
     def _on_frame(self, data: bytes) -> None:
         t = data[0]
-        if t in (HDR_MATCH, HDR_RNDV):
+        if t in (HDR_MATCH, HDR_RNDV, HDR_RNDV_SC):
             hdr = _MATCH.unpack_from(data, 0)
             payload = data[_MATCH.size:]
             self._on_match_frame(hdr, payload)
@@ -436,6 +482,9 @@ class Ob1:
         elif t == HDR_FRAG_ACK:
             _, msgid, nbytes = _FRAGACK.unpack_from(data, 0)
             self._on_frag_ack(msgid, nbytes)
+        elif t == HDR_SC_FIN:
+            _, msgid = _SCFIN.unpack_from(data, 0)
+            self._on_sc_fin(msgid)
         else:
             _out.error("unknown frame type %d", t)
 
@@ -516,13 +565,62 @@ class Ob1:
                 ack = _ACK.pack(HDR_ACK, msgid, 0)
                 self.bml.endpoint(src_world).send(src_world, ack)
             self._finish_recv(req)
-        else:  # RNDV: allocate recv id, ack, wait for frags
-            req.recv_id = next(self._recv_ids)
-            req.src_world = src_world
-            req.src_msgid = msgid
-            self.active_recv[req.recv_id] = req
-            ack = _ACK.pack(HDR_ACK, msgid, req.recv_id)
-            self.bml.endpoint(src_world).send(src_world, ack)
+            return
+        if typ == HDR_RNDV_SC and self._try_single_copy(
+                req, payload, size, msgid, src_world):
+            return
+        # RNDV: allocate recv id, ack, wait for frags
+        req.recv_id = next(self._recv_ids)
+        req.src_world = src_world
+        req.src_msgid = msgid
+        self.active_recv[req.recv_id] = req
+        ack = _ACK.pack(HDR_ACK, msgid, req.recv_id)
+        self.bml.endpoint(src_world).send(src_world, ack)
+
+    def _try_single_copy(self, req: RecvRequest, payload: bytes,
+                         size: int, msgid: int,
+                         src_world: int) -> bool:
+        """Pull the message straight from the sender's address space
+        (smsc/cma); on any denial fall back to streaming by returning
+        False (the plain ACK then triggers the sender's frag pump —
+        its convertor was left rewound for exactly this)."""
+        from ompi_tpu import smsc
+
+        if not smsc.available():
+            return False
+        pid, addr = _SC.unpack_from(payload, 0)
+        take = min(size, req.conv.packed_size)
+        try:
+            flat = req.conv._flat(True)
+            if req.conv._spans is None and flat.flags["C_CONTIGUOUS"]:
+                # contiguous receiver: pull straight into the user
+                # buffer — the actual single copy
+                smsc.read(pid, addr, memoryview(flat)[:take])
+                req.conv.set_position(take)
+            else:
+                wire = bytearray(take)
+                smsc.read(pid, addr, memoryview(wire))
+                req.conv.unpack(wire)
+        except OSError as exc:
+            # e.g. yama ptrace restrictions between sibling ranks that
+            # the self-read probe cannot detect
+            smsc.disqualify(f"runtime read from pid {pid}: {exc}")
+            return False
+        req.status.count = take
+        self.bml.endpoint(src_world).send(
+            src_world, _SCFIN.pack(HDR_SC_FIN, msgid))
+        self._finish_recv(req)
+        return True
+
+    def _on_sc_fin(self, msgid: int) -> None:
+        """Receiver completed its single-copy pull: release the pinned
+        buffer and complete (RGET FIN, pml_ob1_recvreq.c fin)."""
+        req = self.pending_ack.pop(msgid, None)
+        if req is None:
+            _out.error("SC_FIN for unknown msgid %d", msgid)
+            return
+        req.sc_keep = None
+        req.complete()
 
     def _finish_recv(self, req: RecvRequest) -> None:
         if req.is_obj and req.status.error == 0:
@@ -539,6 +637,9 @@ class Ob1:
         if recv_id == 0:  # eager ssend ack
             req.complete()
             return
+        # the receiver declined any single-copy offer: release the
+        # pinned image (the frag pump re-packs from the user buffer)
+        req.sc_keep = None
         req.recv_id = recv_id
         self.streaming[msgid] = req
         self._pump(req)

@@ -20,16 +20,22 @@ def _xfer_body(nbytes: int) -> str:
     """
 
 
+# these tests exercise the STREAMING protocol specifically, so the
+# same-host single-copy path (smsc/cma, which replaces fragging
+# entirely) is pinned off — the forced-algorithm A/B pattern
+
+
 def test_rndv_pipelined_sm_depth1():
     """depth=1 with the byte floor disabled: strict stop-and-wait
     (every fragment waits for its FRAG_ACK) still delivers correctly."""
     run_ranks(_xfer_body(2 << 20), 2,
               mca={"pml_ob1_send_pipeline_depth": "1",
-                   "pml_ob1_send_window_bytes": "1"})
+                   "pml_ob1_send_window_bytes": "1",
+                   "smsc": "off"})
 
 
 def test_rndv_pipelined_sm_default_depth():
-    run_ranks(_xfer_body(8 << 20), 2)
+    run_ranks(_xfer_body(8 << 20), 2, mca={"smsc": "off"})
 
 
 def test_rndv_pipelined_tcp():

@@ -1,0 +1,105 @@
+"""smsc/cma single-copy tests (reference analog: opal/mca/smsc/cma —
+same-host RNDV pulls payload directly from the sender's address
+space)."""
+
+import numpy as np
+
+from tests.harness import run_ranks
+
+
+def test_single_copy_rndv_contiguous():
+    run_ranks("""
+        from ompi_tpu.core import pvar
+        n = 1 << 20  # 8 MB of float64: far beyond the eager limit
+        if rank == 0:
+            comm.Send(np.arange(n, dtype=np.float64), dest=1, tag=1)
+            assert pvar.read("rndv_sc") >= 1, pvar.snapshot()
+        else:
+            buf = np.zeros(n, dtype=np.float64)
+            comm.Recv(buf, source=0, tag=1)
+            assert np.array_equal(buf, np.arange(n, dtype=np.float64))
+            assert pvar.read("smsc_single_copies") >= 1
+    """, 2, timeout=120)
+
+
+def test_single_copy_noncontiguous_datatype():
+    run_ranks("""
+        from ompi_tpu.datatype import datatype as dt
+        rows, cols = 512, 64
+        vec = dt.vector(rows, cols // 2, cols, dt.DOUBLE)
+        src = np.arange(rows * cols, dtype=np.float64).reshape(rows, cols)
+        if rank == 0:
+            comm.Send((src, 1, vec), dest=1, tag=2)
+        else:
+            dst = np.zeros((rows, cols), dtype=np.float64)
+            comm.Recv((dst, 1, vec), source=0, tag=2)
+            assert np.array_equal(dst[:, :cols // 2], src[:, :cols // 2])
+            assert (dst[:, cols // 2:] == 0).all()
+    """, 2, timeout=120)
+
+
+def test_streaming_fallback_when_off():
+    run_ranks("""
+        from ompi_tpu.core import pvar
+        n = 1 << 19
+        if rank == 0:
+            comm.Send(np.arange(n, dtype=np.float64), dest=1, tag=3)
+            assert pvar.read("rndv_sc") == 0
+            assert pvar.read("rndv") >= 1
+        else:
+            buf = np.zeros(n, dtype=np.float64)
+            comm.Recv(buf, source=0, tag=3)
+            assert np.array_equal(buf, np.arange(n, dtype=np.float64))
+            assert pvar.read("smsc_single_copies") == 0
+    """, 2, mca={"smsc": "off"}, timeout=120)
+
+
+def test_offer_declined_falls_back_to_streaming():
+    """Sender offers single-copy (HDR_RNDV_SC) but the receiver's cma
+    is disqualified at runtime (the yama scenario): the plain ACK must
+    re-arm the sender's frag pump — its convertor was packed and
+    rewound — and deliver identical data via streaming."""
+    run_ranks("""
+        from ompi_tpu import smsc
+        from ompi_tpu.core import pvar
+        from ompi_tpu.datatype import datatype as dt
+        if rank == 1:
+            smsc.disqualify("test: receiver-side denial")
+        comm.Barrier()
+        n = 1 << 19
+        # contiguous (zero-copy offer) AND non-contiguous (packed +
+        # rewound offer) messages both take the fallback
+        vec = dt.vector(1024, 16, 32, dt.DOUBLE)
+        src = np.arange(1024 * 32, dtype=np.float64).reshape(1024, 32)
+        if rank == 0:
+            comm.Send(np.arange(n, dtype=np.float64), dest=1, tag=1)
+            comm.Send((src, 1, vec), dest=1, tag=2)
+            assert pvar.read("rndv_sc") >= 2      # offers were made
+            assert pvar.read("rndv_frag") > 1     # and streamed anyway
+        else:
+            buf = np.zeros(n, dtype=np.float64)
+            comm.Recv(buf, source=0, tag=1)
+            assert np.array_equal(buf, np.arange(n, dtype=np.float64))
+            dst = np.zeros((1024, 32), dtype=np.float64)
+            comm.Recv((dst, 1, vec), source=0, tag=2)
+            assert np.array_equal(dst[:, :16], src[:, :16])
+            assert pvar.read("smsc_single_copies") == 0
+    """, 2, timeout=120)
+
+
+def test_many_large_messages_both_directions():
+    run_ranks("""
+        n = 200_000
+        reqs = []
+        bufs = [np.zeros(n, dtype=np.int64) for _ in range(4)]
+        other = 1 - rank
+        for i, b in enumerate(bufs):
+            reqs.append(comm.Irecv(b, source=other, tag=20 + i))
+        for i in range(4):
+            comm.Send(np.full(n, rank * 100 + i, dtype=np.int64),
+                      dest=other, tag=20 + i)
+        for r in reqs:
+            r.wait()
+        for i, b in enumerate(bufs):
+            assert (b == other * 100 + i).all(), (i, b[0])
+    """, 2, timeout=120)
