@@ -1,0 +1,11 @@
+"""Hello world (reference analog: examples/hello_c.c).
+
+Run:  python -m ompi_tpu.runtime.launcher -n 4 examples/hello.py
+"""
+
+from ompi_tpu import mpi
+
+comm = mpi.Init()
+print(f"Hello, world, I am {comm.rank} of {comm.size} "
+      f"({mpi.Get_processor_name()})")
+mpi.Finalize()

@@ -1,0 +1,10 @@
+"""OpenSHMEM hello (reference analog: examples/hello_oshmem_c.c).
+
+Run:  python -m ompi_tpu.runtime.launcher -n 4 examples/shmem_hello.py
+"""
+
+from ompi_tpu import shmem
+
+shmem.init()
+print(f"Hello, world, I am {shmem.my_pe()} of {shmem.n_pes()}")
+shmem.finalize()

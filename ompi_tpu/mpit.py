@@ -1,0 +1,167 @@
+"""MPI_T — the tools information interface.
+
+Reference: ompi/mpi/tool/ over mca_base_var / mca_base_pvar
+(opal/mca/base/mca_base_pvar.h:20-64): indexed enumeration of control
+variables with read/write, and performance variables accessed through
+sessions and bound handles with start/stop/read/reset semantics.
+
+Mapped onto the cvar/pvar planes: cvars enumerate in sorted-name order
+(stable within a process lifetime, like the reference's registration
+order); pvar handles bind a counter name inside a session and report
+deltas from their start() point — the reference's semantics where a
+bound watermark/counter restarts at handle bind.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ompi_tpu.core import cvar, pvar
+
+VERBOSITY_USER_BASIC, VERBOSITY_USER_DETAIL, VERBOSITY_USER_ALL = 1, 2, 3
+VERBOSITY_TUNER_BASIC, VERBOSITY_TUNER_DETAIL, VERBOSITY_TUNER_ALL = 4, 5, 6
+VERBOSITY_MPIDEV_BASIC, VERBOSITY_MPIDEV_DETAIL, VERBOSITY_MPIDEV_ALL = \
+    7, 8, 9
+
+
+def init_thread() -> None:
+    """MPI_T_init_thread: the tool interface is usable before and
+    after MPI init/finalize (nothing to bring up here — kept for API
+    parity)."""
+
+
+def finalize() -> None:
+    """MPI_T_finalize."""
+
+
+# -- control variables -----------------------------------------------------
+
+#: enumeration order frozen at first sight: MPI_T indices must stay
+#: stable for the process lifetime even though modules register cvars
+#: lazily — new names APPEND, existing indices never shift
+_cvar_order: List[str] = []
+_cvar_seen: set = set()
+
+
+def _cvar_names() -> List[str]:
+    for name in sorted(cvar.all_vars()):
+        if name not in _cvar_seen:
+            _cvar_seen.add(name)
+            _cvar_order.append(name)
+    return _cvar_order
+
+
+def cvar_get_num() -> int:
+    return len(_cvar_names())
+
+
+def cvar_get_info(index: int) -> Dict[str, Any]:
+    """MPI_T_cvar_get_info: name/type/default/verbosity/description."""
+    name = _cvar_names()[index]
+    var = cvar.lookup(name)
+    return {
+        "name": name,
+        "type": var.typ.__name__,
+        "default": var.default,
+        "verbosity": var.level,
+        "desc": var.help,
+        "choices": list(var.choices) if var.choices is not None else None,
+    }
+
+
+def cvar_index(name: str) -> int:
+    """MPI_T_cvar_get_index."""
+    return _cvar_names().index(name)
+
+
+class CvarHandle:
+    """MPI_T_cvar_handle: read/write one control variable."""
+
+    def __init__(self, index: int) -> None:
+        self._var = cvar.lookup(_cvar_names()[index])
+
+    def read(self):
+        return self._var.get()
+
+    def write(self, value) -> None:
+        self._var.set(value)
+
+
+# -- performance variables -------------------------------------------------
+
+def pvar_get_num() -> int:
+    return len(pvar.snapshot())
+
+
+def pvar_names() -> List[str]:
+    return sorted(pvar.snapshot())
+
+
+class PvarSession:
+    """MPI_T_pvar_session: isolates handle lifetimes (reference:
+    sessions scope bound handles so tools don't interfere)."""
+
+    def __init__(self) -> None:
+        self._handles: List["PvarHandle"] = []
+        self._freed = False
+
+    def handle_alloc(self, name: str) -> "PvarHandle":
+        if self._freed:
+            raise RuntimeError("session freed")
+        h = PvarHandle(name)
+        self._handles.append(h)
+        return h
+
+    def free(self) -> None:
+        self._freed = True
+        self._handles.clear()
+
+
+class PvarHandle:
+    """A counter bound in a session: start() marks the baseline,
+    read() returns the delta since start, stop() freezes it."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._base: Optional[int] = None
+        self._frozen: Optional[int] = None
+
+    def start(self) -> None:
+        self._base = pvar.read(self.name)
+        self._frozen = None
+
+    def stop(self) -> None:
+        if self._base is not None:
+            self._frozen = pvar.read(self.name) - self._base
+
+    def read(self) -> int:
+        if self._base is None:
+            return pvar.read(self.name)  # unstarted: absolute value
+        if self._frozen is not None:
+            return self._frozen
+        return pvar.read(self.name) - self._base
+
+    def reset(self) -> None:
+        self._base = pvar.read(self.name)
+        self._frozen = None
+
+
+def pvar_session_create() -> PvarSession:
+    return PvarSession()
+
+
+# -- categories (MPI_T_category_*: one per framework) ----------------------
+
+def category_get_num() -> int:
+    return len(categories())
+
+
+def categories() -> List[Tuple[str, List[str]]]:
+    """Frameworks as categories, each listing its cvars by prefix."""
+    from ompi_tpu.core import registry
+
+    out = []
+    names = _cvar_names()
+    for fw in sorted(registry.all_frameworks()):
+        out.append((fw, [n for n in names if n.startswith(fw)]))
+    return out
