@@ -175,7 +175,7 @@ class SmBtl(base.Btl):
         # Create ALL outbound rings now and attach inbound after a fence
         # (reference maps peer segments during add_procs; eager setup
         # removes any attach-vs-unlink race at teardown).
-        same_host = [p for p in range(rte.size) if p != rte.rank
+        same_host = [p for p in rte.world_ranks() if p != rte.rank
                      and rte.modex_recv("btl_sm_host", p)
                      == socket.gethostname()]
         for p in same_host:

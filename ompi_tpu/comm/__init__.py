@@ -328,9 +328,13 @@ def comm_create_from_group(group: Group,
 
 
 def build_world() -> Tuple[Communicator, Communicator]:
-    """COMM_WORLD (cid 0) + COMM_SELF (cid 1)."""
+    """COMM_WORLD (cid 0) + COMM_SELF (cid 1). A spawned world's
+    COMM_WORLD spans its own world-rank block (rte.world_ranks) —
+    cross-world traffic only ever rides intercomm CIDs from the shared
+    store counter, so the per-world cid 0/1 never collide on the
+    wire."""
     rte.init()
-    world = Communicator(Group(range(rte.size)), cid=0)
+    world = Communicator(Group(rte.world_ranks()), cid=0)
     world.set_name("MPI_COMM_WORLD")
     selfc = Communicator(Group([rte.rank]), cid=1)
     selfc.set_name("MPI_COMM_SELF")

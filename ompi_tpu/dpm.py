@@ -1,0 +1,139 @@
+"""Dynamic process management — MPI_Comm_spawn / MPI_Comm_get_parent.
+
+Reference: ompi/dpm/dpm.c (spawn at :1639 via PMIx_Spawn, connect at
+:386): the runtime starts new processes, wires them into the existing
+transport universe, and hands back a parent↔children
+intercommunicator.
+
+TPU-first redesign over this repo's runtime plane:
+  - process start: the spawn root forks the children itself (the
+    launcher-as-daemon model — there is no separate PRRTE to ask);
+  - naming: children join the SAME store and jobid but receive a fresh
+    block of globally-unique world ranks from the store's watermark
+    counter (seeded by the launcher), so every modex key, sm ring path
+    and fence identity stays collision-free across worlds;
+  - wire-up: the tcp BTL dials any world rank lazily through the
+    modex, which is exactly what makes cross-world (parent↔child)
+    traffic work with zero new transport code; intra-child sm rings
+    come up within their own block;
+  - rendezvous: the children's COMM_WORLD spans only their block; the
+    parent side accepts and the children connect on a store port
+    (dpm-lite), yielding the MPI-mandated intercommunicator.
+
+Caveat parity note: spawned processes are independent jobs to the
+launcher (it does not babysit them — the reference's PRRTE does);
+spawn_handles() exposes the Popen objects and finalize kills
+stragglers.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from ompi_tpu.core import output, pvar
+from ompi_tpu.runtime import launcher as launcher_mod, rte
+
+_out = output.stream("dpm")
+
+_children: List[subprocess.Popen] = []
+_atexit_installed = False
+
+
+def _child_env(world_rank: int, i: int, maxprocs: int, offset: int,
+               port: str, mca: Optional[Dict[str, str]]) -> Dict[str, str]:
+    env = launcher_mod.build_env(
+        world_rank, maxprocs, rte.client().addr, rte.jobid, mca)
+    env["OMPI_TPU_WORLD_OFFSET"] = str(offset)
+    env["OMPI_TPU_LOCAL_RANK"] = str(i)
+    env["OMPI_TPU_LOCAL_SIZE"] = str(maxprocs)
+    env["OMPI_TPU_PARENT_PORT"] = port
+    return env
+
+
+def comm_spawn(command: str, args: Sequence[str] = (),
+               maxprocs: int = 1, comm=None, root: int = 0,
+               mca: Optional[Dict[str, str]] = None):
+    """MPI_Comm_spawn: start maxprocs copies of ``command`` (a python
+    script; append ``args``) and return the parent↔children
+    intercommunicator. Collective over ``comm``."""
+    from ompi_tpu.comm.intercomm import comm_accept, open_port
+    from ompi_tpu.runtime import state
+
+    if comm is None:
+        comm = state.world()
+    if maxprocs == 0:
+        # MPI-4.1 §11.8.2: legal, returns an intercomm with an empty
+        # remote group (no rendezvous — nobody will ever connect)
+        from ompi_tpu.comm import Group, alloc_cid
+        from ompi_tpu.comm.intercomm import Intercommunicator
+
+        cid = comm.bcast(alloc_cid() if comm.rank == root else None,
+                         root=root)
+        return Intercommunicator(Group(comm.group.ranks), Group([]),
+                                 cid)
+    global _atexit_installed
+    if comm.rank == root:
+        client = rte.client()
+        end = client.inc(f"ww:{rte.jobid}", maxprocs)
+        offset = end - maxprocs
+        port = open_port(f"spawn:{rte.jobid}:{offset}")
+        argv_tail = [command, *map(str, args)]
+        if command.endswith(".py"):
+            argv_tail = [sys.executable] + argv_tail
+        for i in range(maxprocs):
+            env = _child_env(offset + i, i, maxprocs, offset, port, mca)
+            _children.append(subprocess.Popen(argv_tail, env=env))
+        if not _atexit_installed:
+            atexit.register(_reap_children)
+            _atexit_installed = True
+        pvar.record("spawned_procs", maxprocs)
+        _out.verbose(2, "spawned %d procs at world offset %d",
+                     maxprocs, offset)
+        data = port
+    else:
+        data = None
+    port = comm.bcast(data, root=root)
+    # children connect from their COMM_WORLD; we accept as a group
+    return comm_accept(port, comm, root=root)
+
+
+_parent = None
+
+
+def get_parent():
+    """MPI_Comm_get_parent: the intercomm to the spawning group, or
+    None when this process was not spawned. Idempotent — MPI mandates
+    the same handle on every call (and the connect rendezvous must
+    only run once)."""
+    global _parent
+    if _parent is not None:
+        return _parent
+    from ompi_tpu.comm.intercomm import comm_connect
+    from ompi_tpu.runtime import state
+
+    port = os.environ.get("OMPI_TPU_PARENT_PORT")
+    if not port:
+        return None
+    _parent = comm_connect(port, state.world(), root=0)
+    return _parent
+
+
+def spawn_handles() -> List[subprocess.Popen]:
+    """The Popen handles of every child this process spawned."""
+    return list(_children)
+
+
+def wait_children(timeout: Optional[float] = None) -> List[int]:
+    """Join all spawned children; returns their exit codes."""
+    codes = []
+    for p in _children:
+        codes.append(p.wait(timeout=timeout))
+    return codes
+
+
+def _reap_children() -> None:
+    launcher_mod.reap(_children)

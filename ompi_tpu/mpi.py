@@ -782,6 +782,11 @@ from ompi_tpu.io import (  # noqa: E402,F401
     MODE_SEQUENTIAL, MODE_WRONLY, SEEK_CUR, SEEK_END, SEEK_SET,
 )
 
+# dynamic processes (ompi/dpm: PMIx_Spawn equivalent)
+from ompi_tpu.dpm import (  # noqa: E402,F401
+    comm_spawn as Comm_spawn, get_parent as Comm_get_parent,
+)
+
 
 # ---------------------------------------------------------------------------
 # module-level state: COMM_WORLD / COMM_SELF / init / finalize

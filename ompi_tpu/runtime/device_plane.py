@@ -135,8 +135,12 @@ def init_plane() -> bool:
                          "%d: %s", rte.rank, exc)
             ok = False
         if rte.size > 1:
-            key = f"devplane:{rte.jobid}:coord"
-            if rte.rank == 0:
+            # world-namespaced: a spawned world bootstraps its OWN
+            # jax.distributed cluster; its leader is its first world
+            # rank (rte.world_offset), not global rank 0
+            leader = rte.world_offset
+            key = f"devplane:{rte.jobid}:{rte.world_offset}:coord"
+            if rte.rank == leader:
                 # publish BEFORE any blocking work: peers wait on this
                 # key, so rank 0 must never fail without writing it
                 # (a missing key would deadlock the whole job)
@@ -153,7 +157,8 @@ def init_plane() -> bool:
                 try:
                     jax.distributed.initialize(
                         coordinator_address=coord,
-                        num_processes=rte.size, process_id=rte.rank,
+                        num_processes=rte.size,
+                        process_id=rte.rank - rte.world_offset,
                         initialization_timeout=_timeout.get())
                 except Exception as exc:  # noqa: BLE001
                     _out.verbose(1, "device plane bootstrap failed on "
@@ -169,7 +174,7 @@ def init_plane() -> bool:
         rte.modex_send("devplane", {"ok": ok, "device_id": dev_id})
         rte.fence("devplane")
         peers: Dict[int, dict] = {
-            r: rte.modex_recv("devplane", r) for r in range(rte.size)}
+            r: rte.modex_recv("devplane", r) for r in rte.world_ranks()}
         if not all(p and p.get("ok") for p in peers.values()):
             bad = [r for r, p in peers.items() if not (p and p.get("ok"))]
             _out.verbose(1, "device plane disabled: rank(s) %s failed "

@@ -14,7 +14,11 @@ this with the pod network's isolation, not expose it publicly.
 Commands:
   ("put", key, value)            -> ("ok",)
   ("get", key, wait: bool)       -> ("val", value) | ("none",)
-  ("fence", tag, nprocs)         -> blocks until nprocs arrive -> ("ok",)
+  ("fence", tag, nprocs, rank, base)
+      -> blocks until nprocs distinct ranks arrive -> ("ok",)
+      rank identifies the arriver (anonymous callers use unique
+      negatives); base is the first world rank of the fencing world
+      (FT dead-release only counts ranks in [base, base+nprocs))
   ("inc", key, amount)           -> ("val", new_value)   # atomic counter
   ("abort", rank, reason)        -> ("ok",)  # marks job aborted
   ("aborted?",)                  -> ("val", reason | None)
@@ -149,7 +153,7 @@ class Store:
         if op == "fence":
             # tags must be unique per epoch (the rte client appends an
             # epoch counter, mirroring PMIx fence instance uniqueness)
-            _, tag, nprocs, rank = msg
+            _, tag, nprocs, rank, base = msg
             with self._cond:
                 entry = self._fences.setdefault(tag, [set(), 0])
                 entry[0].add(rank)
@@ -157,12 +161,14 @@ class Store:
 
                 def dead_absent():  # dead ranks release the fence
                     # (PMIx fence over failed procs errors, never
-                    # hangs). Only plausible participants count: world
-                    # fences span ranks [0, nprocs), so a dead rank
-                    # outside that range (or one that arrived and THEN
-                    # died) must not release someone else's fence.
+                    # hangs). Only plausible participants count: this
+                    # world's fence spans [base, base+nprocs), so a
+                    # dead rank outside that block (or one that
+                    # arrived and THEN died) must not release someone
+                    # else's fence.
                     return sum(1 for r in self._dead
-                               if 0 <= r < nprocs and r not in entry[0])
+                               if base <= r < base + nprocs
+                               and r not in entry[0])
 
                 while (len(entry[0]) + dead_absent() < nprocs
                        and not self._aborted):
@@ -207,6 +213,14 @@ class Store:
             _, tag, rank, value, ranks, hb_timeout = msg
             return self._ftgather(tag, rank, value, ranks, hb_timeout)
         return ("err", f"unknown op {op!r}")
+
+    def seed_counter(self, key: str, value: int) -> None:
+        """Pre-claim counter space (the launcher seeds the spawn
+        world-rank watermark with the initial world size, so
+        MPI_Comm_spawn blocks never collide with launcher ranks)."""
+        with self._cond:
+            if self._counters.get(key, 0) < value:
+                self._counters[key] = value
 
     # -- fault-tolerance internals ---------------------------------------
     def mark_dead(self, rank: int, reason: str) -> None:
@@ -302,16 +316,19 @@ class Client:
         return reply[1] if reply[0] == "val" else None
 
     def fence(self, tag: str, nprocs: int, rank: int = -1,
+              base: int = 0,
               timeout: Optional[float] = None) -> None:
         """Blocks until nprocs distinct ranks arrive. A timeout raises
         socket.timeout — used by shutdown paths that must not hang on a
         dead peer. If failed ranks released the fence early, raises
         ProcFailedError. Callers without a rank identity pass -1..-N
         (test harnesses); real ranks pass their world rank so a rank
-        that arrives and then dies is not double-counted."""
+        that arrives and then dies is not double-counted. ``base`` is
+        the first world rank of the fencing world (spawn blocks)."""
         if rank == -1:
             rank = self._anon_rank
-        reply = self._rpc("fence", tag, nprocs, rank, timeout=timeout)
+        reply = self._rpc("fence", tag, nprocs, rank, base,
+                          timeout=timeout)
         if reply[0] == "okdead":
             from ompi_tpu import errors
 

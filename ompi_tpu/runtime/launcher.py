@@ -71,6 +71,9 @@ def launch(argv: Sequence[str], nprocs: int,
     """
     store = kvstore.Store().start()
     jobid = uuid.uuid4().hex[:12]
+    # pre-claim world ranks [0, nprocs): MPI_Comm_spawn allocates
+    # fresh blocks above this watermark (ompi_tpu.dpm)
+    store.seed_counter(f"ww:{jobid}", nprocs)
     ft = (mca or {}).get("ft", "0") not in ("0", "false", "")
     procs: List[subprocess.Popen] = []
     try:
@@ -79,15 +82,22 @@ def launch(argv: Sequence[str], nprocs: int,
             procs.append(subprocess.Popen(list(argv), env=env))
         return _wait_all(procs, timeout, store=store if ft else None)
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        reap(procs)
         store.stop()
+
+
+def reap(procs: Sequence[subprocess.Popen],
+         grace: float = 5.0) -> None:
+    """Terminate stragglers, then kill after a grace period (shared by
+    the launcher teardown and dpm's spawned-children cleanup)."""
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            p.kill()
 
 
 def _wait_all(procs: List[subprocess.Popen],

@@ -27,6 +27,11 @@ size: int = 1
 jobid: str = "singleton"
 local_rank: int = 0
 local_size: int = 1
+#: first world rank of THIS world (0 for launcher-started jobs;
+#: spawned worlds get a fresh block from the store's watermark —
+#: world ranks are globally unique across all worlds sharing a store,
+#: which is what lets the tcp/sm modex address spawned processes)
+world_offset: int = 0
 
 
 def is_launched() -> bool:
@@ -39,19 +44,25 @@ def init() -> None:
     with _lock:
         if _client is not None:
             return
+        global world_offset
         if is_launched():
             rank = int(os.environ["OMPI_TPU_RANK"])
             size = int(os.environ["OMPI_TPU_SIZE"])
             jobid = os.environ.get("OMPI_TPU_JOBID", "job0")
             local_rank = int(os.environ.get("OMPI_TPU_LOCAL_RANK", rank))
             local_size = int(os.environ.get("OMPI_TPU_LOCAL_SIZE", size))
+            world_offset = int(
+                os.environ.get("OMPI_TPU_WORLD_OFFSET", "0"))
             host, _, port = os.environ["OMPI_TPU_STORE_ADDR"].partition(":")
             _client = kvstore.Client((host, int(port)))
         else:
             rank, size, jobid = 0, 1, "singleton"
             local_rank, local_size = 0, 1
+            world_offset = 0
             _local_store = kvstore.Store().start()
             _client = kvstore.Client(_local_store.addr)
+            # spawn watermark for singleton-rooted spawns
+            _local_store.seed_counter(f"ww:{jobid}", 1)
         atexit.register(_shutdown)
 
 
@@ -84,17 +95,25 @@ def modex_recv(component: str, peer: int, wait: bool = True) -> Any:
     return client().get(f"modex:{jobid}:{component}:{peer}", wait=wait)
 
 
+def world_ranks() -> range:
+    """World ranks of MY world (spawned worlds occupy their own
+    globally-unique block)."""
+    return range(world_offset, world_offset + size)
+
+
 def fence(tag: str = "", timeout: float | None = None) -> None:
-    """All-rank rendezvous (PMIx_Fence). A timeout (shutdown paths only:
-    it leaves the RPC stream desynchronized) raises socket.timeout."""
+    """My-world rendezvous (PMIx_Fence). A timeout (shutdown paths
+    only: it leaves the RPC stream desynchronized) raises
+    socket.timeout. The tag is namespaced by the world's offset so
+    spawned worlds sharing the store never collide."""
     global _fence_epoch
     if size == 1:
         return
     with _lock:
         _fence_epoch += 1
         epoch = _fence_epoch
-    client().fence(f"fence:{jobid}:{tag}:{epoch}", size, rank,
-                   timeout=timeout)
+    client().fence(f"fence:{jobid}:{world_offset}:{tag}:{epoch}", size,
+                   rank, base=world_offset, timeout=timeout)
 
 
 def next_id(space: str) -> int:

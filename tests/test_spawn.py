@@ -1,0 +1,84 @@
+"""MPI_Comm_spawn tests (reference analog: test/simple spawn programs
++ the mpi4py spawn lane in the reference CI)."""
+
+import os
+import tempfile
+import textwrap
+
+from tests.harness import run_ranks
+
+_CHILD = textwrap.dedent("""
+    import os
+    import sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+    from ompi_tpu import mpi
+
+    mode = sys.argv[1] if len(sys.argv) > 1 else "allreduce"
+    comm = mpi.Init()
+    parent = mpi.Comm_get_parent()
+    assert parent is not None, "child must see a parent intercomm"
+    if mode == "merge":
+        merged = parent.merge(high=True)
+        tot = np.zeros(1, dtype=np.int64)
+        merged.Allreduce(np.array([1], dtype=np.int64), tot)
+        assert tot[0] == merged.size, tot
+    else:
+        # intercomm allreduce: child contributes its rank+1; each side
+        # receives the OTHER side's reduction
+        out = np.zeros(1, dtype=np.int64)
+        parent.Allreduce(np.array([comm.rank + 1], dtype=np.int64), out)
+        # out = sum over the parent group of (their rank + 100)
+        expect = sum(r + 100 for r in range(parent.remote_size))
+        assert out[0] == expect, (out, expect)
+    # child world is self-contained: its own COMM_WORLD collective
+    tot = np.zeros(1, dtype=np.int64)
+    comm.Allreduce(np.array([1], dtype=np.int64), tot)
+    assert tot[0] == comm.size
+    mpi.Finalize()
+""")
+
+
+def _with_child_script(body_fmt: str, n: int, timeout: float = 180):
+    fd, child_path = tempfile.mkstemp(suffix="_spawn_child.py")
+    with os.fdopen(fd, "w") as fh:
+        fh.write(_CHILD)
+    try:
+        run_ranks(body_fmt.format(child=child_path), n, timeout=timeout)
+    finally:
+        os.unlink(child_path)
+
+
+def test_spawn_and_intercomm_allreduce():
+    """2 parents spawn 3 children; both sides allreduce across the
+    bridge and the children run their own world collectives."""
+    _with_child_script("""
+        from ompi_tpu import dpm
+        inter = mpi.Comm_spawn({child!r}, maxprocs=3)
+        assert inter.remote_size == 3
+        out = np.zeros(1, dtype=np.int64)
+        inter.Allreduce(np.array([rank + 100], dtype=np.int64), out)
+        assert out[0] == 1 + 2 + 3, out  # children sent rank+1
+        if rank == 0:
+            codes = dpm.wait_children(timeout=120)
+            assert codes == [0, 0, 0], codes
+        comm.Barrier()
+    """, 2)
+
+
+def test_spawn_merge_forms_single_world():
+    """Intercomm_merge across the spawn bridge gives one intracomm
+    spanning parents + children."""
+    _with_child_script("""
+        from ompi_tpu import dpm
+        inter = mpi.Comm_spawn({child!r}, args=("merge",), maxprocs=2)
+        merged = inter.merge(high=False)
+        # parents (2) + children (2)
+        assert merged.size == 4, merged.size
+        tot = np.zeros(1, dtype=np.int64)
+        merged.Allreduce(np.array([1], dtype=np.int64), tot)
+        assert tot[0] == 4
+        if rank == 0:
+            dpm.wait_children(timeout=120)
+        comm.Barrier()
+    """, 2)
