@@ -197,6 +197,82 @@ class Convertor:
             pos += take
 
 
+def pack_external(datarep: str, buf: Buffer, dtype: Datatype,
+                  count: int) -> bytes:
+    """MPI_Pack_external: canonical big-endian 'external32' wire form
+    (reference: opal/datatype's external32 path +
+    opal_copy_functions_heterogeneous.c). The element type is taken
+    from the buffer — external32's fixed sizes coincide with the
+    native numpy sizes, so only byte order changes."""
+    if datarep != "external32":
+        from ompi_tpu import errors
+
+        raise errors.MPIError(errors.ERR_ARG,
+                              f"unknown datarep {datarep!r}")
+    wire = pack(buf, dtype, count)
+    return _swap_wire(wire, _elem_dtype(buf, dtype))
+
+
+def unpack_external(datarep: str, data: bytes, buf: Buffer,
+                    dtype: Datatype, count: int) -> int:
+    """MPI_Unpack_external (inverse of pack_external)."""
+    if datarep != "external32":
+        from ompi_tpu import errors
+
+        raise errors.MPIError(errors.ERR_ARG,
+                              f"unknown datarep {datarep!r}")
+    return unpack(_swap_wire(bytes(data), _elem_dtype(buf, dtype)),
+                  buf, dtype, count)
+
+
+def _elem_dtype(buf, dtype: Datatype) -> np.dtype:
+    """The element REPRESENTATION to swap by: a typed buffer's own
+    dtype governs (an already-big-endian buffer needs no swap); a
+    raw-byte buffer falls back to the Datatype's typemap base in
+    native order (predefined/contiguous/vector/indexed propagate a
+    uniform base). Raw bytes under a baseless datatype are rejected —
+    guessing would silently skip the canonical swap."""
+    from ompi_tpu import errors
+
+    elem = np.asarray(buf).dtype
+    raw = (elem.names is not None or elem.kind in ("V", "S")
+           or elem.itemsize == 1)
+    if not raw:
+        return elem
+    base = getattr(dtype, "base", None)
+    if base is not None:
+        # raw byte staging: the datatype's logical element governs,
+        # in native representation
+        return np.dtype(base)
+    raise errors.MPIError(
+        errors.ERR_NOT_SUPPORTED,
+        "external32 needs a uniform element type: this datatype "
+        "carries no base type and the buffer is raw bytes")
+
+
+def _swap_wire(wire: bytes, elem: np.dtype) -> bytes:
+    """Element representation <-> big-endian canonical swap of a
+    packed stream (no-op when the representation is already BE —
+    including native order on big-endian hosts)."""
+    from ompi_tpu import errors
+
+    if elem.names is not None:
+        # a struct's packed stream strips inter-field padding, so it
+        # cannot be re-viewed as the structured dtype for swapping
+        raise errors.MPIError(
+            errors.ERR_NOT_SUPPORTED,
+            "external32 over structured element types")
+    if elem.itemsize <= 1 or elem.byteorder == "|":
+        return wire
+    if elem.newbyteorder(">") == elem:
+        return wire  # representation is already big-endian
+    if len(wire) % elem.itemsize:
+        raise errors.MPIError(
+            errors.ERR_TYPE,
+            "packed size is not a multiple of the element size")
+    return np.frombuffer(wire, dtype=elem).byteswap().tobytes()
+
+
 def pack(buf: Buffer, dtype: Datatype, count: int) -> bytes:
     """One-shot MPI_Pack."""
     return Convertor(buf, dtype, count).pack()

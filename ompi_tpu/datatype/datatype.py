@@ -221,7 +221,9 @@ def hvector(count: int, blocklength: int, stride_bytes: int,
         + (blocklength - 1) * old.extent
     lb = placements_lo + old.lb
     ub = placements_hi + old.ub
-    return Datatype(spans, ub - lb, lb=lb, name="vector")
+    # a vector of a uniform element keeps that element as its typemap
+    # base (external32 swaps by it)
+    return Datatype(spans, ub - lb, lb=lb, base=old.base, name="vector")
 
 
 def indexed(blocklengths: Sequence[int], displs: Sequence[int],
@@ -267,9 +269,11 @@ def create_struct(blocklengths: Sequence[int], displs_bytes: Sequence[int],
     if not parts:
         return Datatype([], 0, name="struct")
     spans = np.concatenate(parts)
+    bases = {t.base for t in types if t.size}
+    base = bases.pop() if len(bases) == 1 else None  # uniform only
     # struct pack order follows declaration order (MPI pack traversal),
     # which for typical ascending-displacement structs is ascending
-    return Datatype(spans, ub - lb, lb=lb, name="struct")
+    return Datatype(spans, ub - lb, lb=lb, base=base, name="struct")
 
 
 def subarray(sizes: Sequence[int], subsizes: Sequence[int],

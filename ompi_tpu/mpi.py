@@ -787,6 +787,12 @@ from ompi_tpu.dpm import (  # noqa: E402,F401
     comm_spawn as Comm_spawn, get_parent as Comm_get_parent,
 )
 
+# MPI_Pack family incl. the canonical external32 representation
+from ompi_tpu.datatype.convertor import (  # noqa: E402,F401
+    pack as Pack, pack_external as Pack_external, unpack as Unpack,
+    unpack_external as Unpack_external,
+)
+
 
 # ---------------------------------------------------------------------------
 # module-level state: COMM_WORLD / COMM_SELF / init / finalize

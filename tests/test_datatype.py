@@ -171,3 +171,35 @@ def test_large_count_spans():
     big = contiguous(300_000_000, DOUBLE)  # 2.4 GB logical
     assert big.size == 2_400_000_000
     assert big.spans_for_count(1)[0][1] == 2_400_000_000
+
+
+def test_pack_external32_roundtrip():
+    """external32 canonical big-endian packing (reference: the
+    external32 datarep, opal_copy_functions_heterogeneous.c)."""
+    import numpy as np
+
+    from ompi_tpu import errors
+    from ompi_tpu.datatype import datatype as dt
+    from ompi_tpu.datatype.convertor import pack_external, unpack_external
+
+    src = np.arange(16, dtype=np.int32)
+    wire = pack_external("external32", src, dt.INT32, 16)
+    # canonical form is big-endian on every host
+    assert wire == src.astype(">i4").tobytes()
+    back = np.zeros(16, dtype=np.int32)
+    unpack_external("external32", wire, back, dt.INT32, 16)
+    assert np.array_equal(back, src)
+    # derived datatype: strided vector round-trips through external32
+    vec = dt.vector(4, 2, 4, dt.DOUBLE)
+    m = np.arange(16, dtype=np.float64).reshape(4, 4)
+    w2 = pack_external("external32", m, vec, 1)
+    assert w2 == np.ascontiguousarray(m[:, :2]).astype(">f8").tobytes()
+    out = np.zeros((4, 4), dtype=np.float64)
+    unpack_external("external32", w2, out, vec, 1)
+    assert np.array_equal(out[:, :2], m[:, :2])
+    # unknown datarep + structured elements are rejected
+    try:
+        pack_external("native", src, dt.INT32, 16)
+        raise AssertionError("datarep check missing")
+    except errors.MPIError:
+        pass
