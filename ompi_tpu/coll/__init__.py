@@ -9,7 +9,8 @@ priority<0). Components here: ``basic`` (linear reference algorithms),
 fallback), ``xla`` (device-executed collectives over the
 multi-controller device plane — the north star), ``inter``
 (group-vs-group algorithms for intercommunicators), ``han``
-(hierarchical node×network compositions), ``sync`` (barrier-injection
+(hierarchical node×network compositions), ``adapt`` (event-driven
+segmented ibcast/ireduce, opt-in), ``sync`` (barrier-injection
 debug interposition). COMM_SELF/size-1 comms are served by basic's
 linear paths and xla's local fast path (no separate ``self`` component
 needed).
@@ -130,7 +131,8 @@ def comm_select(comm) -> None:
 
 def _register_builtin() -> None:
     from ompi_tpu.coll import (  # noqa: F401
-        accelerator, basic, han, inter, libnbc, sync, tuned, xla,
+        accelerator, adapt, basic, han, inter, libnbc, sync, tuned,
+        xla,
     )
 
 

@@ -102,3 +102,35 @@ def test_persistent_collectives_restart():
         mpi.start_all([b1, b2])
         b1.wait(); b2.wait()
     """, 3, timeout=180)
+
+
+def test_adapt_segmented_ibcast_ireduce():
+    """coll/adapt: per-segment pipelined trees match the flat results
+    (forced-priority A/B, reference: adapt ships opt-in)."""
+    run_ranks("""
+        assert comm.coll.providers["ibcast"] == "adapt"
+        n = 100_000  # ~12 segments of 64KB float64
+        buf = (np.arange(n, dtype=np.float64) if rank == 1
+               else np.zeros(n, dtype=np.float64))
+        comm.Ibcast(buf, root=1).wait()
+        assert np.array_equal(buf, np.arange(n, dtype=np.float64))
+        out = np.zeros(n, dtype=np.float64) if rank == 0 else None
+        comm.Ireduce(np.full(n, rank + 1.0), out, root=0).wait()
+        if rank == 0:
+            assert (out == sum(r + 1 for r in range(size))).all()
+        # count < buffer size: only count elements move
+        big = (np.arange(40_000, dtype=np.float64) if rank == 1
+               else np.zeros(40_000, dtype=np.float64))
+        comm.Ibcast((big, 20_000), root=1).wait()
+        assert np.array_equal(big[:20_000],
+                              np.arange(20_000, dtype=np.float64))
+        if rank != 1:
+            assert (big[20_000:] == 0).all()  # untouched past count
+        # non-viewable buffer (bytearray) delegates to libnbc and
+        # still lands in the caller's memory (a silent temporary-copy
+        # receive would lose it)
+        ba = bytearray(b"ADAPT-DELEGATION" if rank == 0 else 16)
+        comm.Ibcast((ba, 16), root=0).wait()
+        assert bytes(ba) == b"ADAPT-DELEGATION", (rank, ba)
+    """, 3, mca={"coll_adapt_priority": "25",
+                 "coll_adapt_max_inflight": "3"}, timeout=180)
