@@ -16,13 +16,10 @@ from typing import Dict, Optional
 from ompi_tpu.runtime import launcher
 
 _PRELUDE = """
-import os
-os.environ["JAX_PLATFORMS"] = "cpu"  # N ranks share the host; no device fights
-try:
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+# NOTE: no jax import or platform pinning here — the launcher already
+# sets JAX_PLATFORMS=cpu and skips the device plugin for rank
+# processes (launcher.build_env), and importing jax costs ~2s per rank
+# per test; bodies that need jax import it themselves.
 import numpy as np
 from ompi_tpu import mpi
 comm = mpi.Init()
