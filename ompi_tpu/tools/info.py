@@ -25,30 +25,31 @@ from ompi_tpu.core import cvar, pvar, registry
 _SOURCES = {0: "default", 1: "file", 2: "env", 3: "set"}
 
 
-def _import_component_universe() -> None:
-    """Import every package that registers components/cvars so the dump
-    is complete without bringing up the runtime (no rte/store init —
-    like ompi_info, which opens frameworks without calling MPI_Init)."""
-    import importlib
+#: modules never imported by the dump: heavy (models pull jax and
+#: compile), side-effectful (launcher forks, __main__ runs CLIs), or
+#: meaningless without a live job
+_DISCOVERY_DENYLIST = (
+    "ompi_tpu.models", "ompi_tpu.ops", "ompi_tpu.parallel",
+    "ompi_tpu.runtime.launcher", "ompi_tpu.tools",
+)
 
-    for mod in (
-            "ompi_tpu.accelerator",
-            "ompi_tpu.accelerator.null", "ompi_tpu.accelerator.tpu",
-            "ompi_tpu.btl.self_btl", "ompi_tpu.btl.sm", "ompi_tpu.btl.tcp",
-            "ompi_tpu.coll", "ompi_tpu.coll.accelerator",
-            "ompi_tpu.coll.basic", "ompi_tpu.coll.inter",
-            "ompi_tpu.coll.libnbc", "ompi_tpu.coll.tuned",
-            "ompi_tpu.coll.xla",
-            "ompi_tpu.core.progress",
-            "ompi_tpu.datatype",
-            "ompi_tpu.ft.detector",
-            "ompi_tpu.io",
-            "ompi_tpu.op",
-            "ompi_tpu.osc",
-            "ompi_tpu.pml.ob1", "ompi_tpu.pml.part",
-            "ompi_tpu.runtime.device_plane",
-            "ompi_tpu.topo",
-    ):
+
+def _import_component_universe() -> None:
+    """Import every ompi_tpu module so each component/cvar
+    registration runs and the dump is complete, without bringing up
+    the runtime (no rte/store init — like ompi_info, which opens
+    frameworks without calling MPI_Init). Auto-discovered via
+    pkgutil so new components can never silently drift out of the
+    dump; per-module failures warn and continue."""
+    import importlib
+    import pkgutil
+
+    import ompi_tpu
+
+    for info in pkgutil.walk_packages(ompi_tpu.__path__, "ompi_tpu."):
+        mod = info.name
+        if mod.startswith(_DISCOVERY_DENYLIST):
+            continue
         try:
             importlib.import_module(mod)
         except Exception as exc:  # noqa: BLE001 — a broken module should
