@@ -44,8 +44,23 @@ _small = cvar.register(
     help="Bytes below which latency-optimal algorithms are used "
          "(reference switchpoint shape, decision_fixed.c)")
 _pipeline_min = cvar.register(
-    "coll_tuned_bcast_pipeline_min", 1 << 20, int,
-    help="Bytes above which bcast switches to the segmented pipeline")
+    "coll_tuned_bcast_pipeline_min", 64 << 20, int,
+    help="Bytes above which bcast switches to the segmented pipeline. "
+         "High default: with smsc single-copy a binomial hop moves the "
+         "whole payload in one copy (measured 1.26 GB/s vs pipeline's "
+         "0.07 at 8MB/4 ranks), so segmentation only pays on streaming "
+         "fabrics — lower this when smsc is off")
+_bcast_segsize = cvar.register(
+    "coll_tuned_bcast_segsize", 1 << 20, int,
+    help="Pipeline bcast segment bytes (reference segsize params, "
+         "coll_base_bcast.c). The Python per-segment cost is ~50x the "
+         "reference's, so the default segment is 16x larger")
+_ring_min = cvar.register(
+    "coll_tuned_allreduce_ring_min", 2 << 20, int,
+    help="Total bytes above which commutative allreduce uses the "
+         "bandwidth-optimal ring (measured on sm+smsc: recursive "
+         "doubling wins to ~1MB, ring from ~4MB; Rabenseifner trails "
+         "both here and stays forced-only)")
 
 
 def _bytes(count, dtype) -> int:
@@ -66,15 +81,10 @@ def allreduce_tuned(comm, sendbuf, recvbuf, count, dtype, op):
         return A.allreduce_rabenseifner(comm, sendbuf, recvbuf, count,
                                         dtype, op)
     total = _bytes(count, dtype)
-    if not op.commute or comm.size <= 2 or total <= _small.get():
-        return A.allreduce_recursivedoubling(comm, sendbuf, recvbuf,
-                                             count, dtype, op)
-    if count >= comm.size:
-        # bandwidth-bound: Rabenseifner for pow2-ish, ring otherwise
-        # (reference decision_fixed.c large-message branch)
-        if comm.size & (comm.size - 1) == 0:
-            return A.allreduce_rabenseifner(comm, sendbuf, recvbuf,
-                                            count, dtype, op)
+    if (op.commute and comm.size > 2 and count >= comm.size
+            and total >= _ring_min.get()):
+        # bandwidth-bound (reference decision_fixed.c large branch):
+        # ring measured fastest here at every size/rank combo tried
         return A.allreduce_ring(comm, sendbuf, recvbuf, count, dtype, op)
     return A.allreduce_recursivedoubling(comm, sendbuf, recvbuf, count,
                                          dtype, op)
@@ -87,9 +97,11 @@ def bcast_tuned(comm, buf, count, dtype, root):
     if forced == "binomial":
         return A.bcast_binomial(comm, buf, count, dtype, root)
     if forced == "pipeline":
-        return A.bcast_pipeline(comm, buf, count, dtype, root)
+        return A.bcast_pipeline(comm, buf, count, dtype, root,
+                                segsize=_bcast_segsize.get())
     if _bytes(count, dtype) >= _pipeline_min.get() and comm.size > 2:
-        return A.bcast_pipeline(comm, buf, count, dtype, root)
+        return A.bcast_pipeline(comm, buf, count, dtype, root,
+                                segsize=_bcast_segsize.get())
     return A.bcast_binomial(comm, buf, count, dtype, root)
 
 

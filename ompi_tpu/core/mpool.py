@@ -1,0 +1,202 @@
+"""mpool/rcache/allocator — pooled memory + registration cache.
+
+Reference: three OPAL frameworks this module covers in one TPU-first
+plane —
+
+- ``opal/mca/allocator`` (basic/bucket, 1,493 LoC): size-class free
+  lists feeding BTL fragment pools -> :class:`BufferPool`.
+  (``opal_free_list_t``'s *object* pooling is deliberately absent:
+  hot-path request/fragment objects are plain Python objects per the
+  class-containers redesign — CPython's allocator already free-lists
+  small objects, so a second pool above it would only add aliasing
+  hazards.)
+- ``opal/mca/rcache`` (grdma VMA interval tree, 3,413 LoC): caches
+  expensive per-buffer state (NIC registrations there; device-buffer
+  metadata and staged host mirrors here) with LRU eviction ->
+  :class:`Rcache`. The reference invalidates via memory hooks on
+  munmap; jax arrays are immutable and garbage-collected, so
+  invalidation is a weakref callback instead — the same lifetime
+  contract without symbol patching.
+
+The pools exist for the same reason the reference's do: the p2p hot
+path allocates per-fragment scratch at a high rate, and allocator
+pressure is measurable in a managed runtime just as it is in C (there:
+malloc + NUMA placement; here: allocation + GC churn).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ompi_tpu.core import cvar, pvar
+
+_max_cached = cvar.register(
+    "mpool_max_cached_bytes", 32 << 20, int,
+    help="Upper bound on idle bytes retained per BufferPool size "
+         "class set (reference: allocator/bucket caps its buckets); "
+         "0 disables pooling entirely.", level=7)
+
+_rcache_bytes = cvar.register(
+    "rcache_max_bytes", 256 << 20, int,
+    help="Registration-cache capacity in payload bytes before LRU "
+         "eviction (reference: rcache_grdma size limits).", level=7)
+
+
+def _size_class(n: int) -> int:
+    """Round up to the allocation bucket: powers of two from 256 B."""
+    c = 256
+    while c < n:
+        c <<= 1
+    return c
+
+
+class BufferPool:
+    """Size-class byte-buffer pool (allocator/bucket): ``take(n)``
+    returns a ``bytearray`` of capacity >= n (callers slice a
+    memoryview to n); ``give(buf)`` recycles it. Total idle bytes are
+    capped by the ``mpool_max_cached_bytes`` cvar — beyond it buffers
+    fall to the garbage collector."""
+
+    def __init__(self) -> None:
+        self._classes: Dict[int, List[bytearray]] = {}
+        self._idle = 0
+        self._lock = threading.Lock()
+
+    def take(self, nbytes: int) -> bytearray:
+        if _max_cached.get() <= 0:
+            pvar.record("mpool_misses")
+            return bytearray(nbytes)
+        c = _size_class(nbytes)
+        with self._lock:
+            free = self._classes.get(c)
+            if free:
+                buf = free.pop()
+                self._idle -= c
+                pvar.record("mpool_hits")
+                return buf
+        pvar.record("mpool_misses")
+        return bytearray(c)
+
+    def give(self, buf: bytearray) -> None:
+        c = len(buf)
+        if c & (c - 1) or c < 256:
+            return  # not one of ours (sliced/foreign); let GC have it
+        with self._lock:
+            if self._idle + c > _max_cached.get():
+                return
+            self._classes.setdefault(c, []).append(buf)
+            self._idle += c
+
+    @property
+    def idle_bytes(self) -> int:
+        return self._idle
+
+
+#: process-wide pool for transport scratch (frag assembly, staging)
+pool = BufferPool()
+
+
+class Rcache:
+    """LRU registration cache (rcache/grdma). Keys are caller-chosen
+    (the convention is :func:`buffer_key` — id() plus a liveness
+    weakref so a recycled id can never alias a dead registration).
+    Values carry a byte cost; total cost is capped by the
+    ``rcache_max_bytes`` cvar with least-recently-used eviction, and
+    an optional ``on_evict`` hook releases derived resources (the
+    reference calls the BTL's deregister)."""
+
+    def __init__(self, on_evict: Optional[Callable[[Any, Any], None]]
+                 = None) -> None:
+        self._map: "OrderedDict[Any, Tuple[Any, int]]" = OrderedDict()
+        self._bytes = 0
+        # reentrant: buffer_key's weakref finalizer calls invalidate(),
+        # and cyclic GC can fire it on a thread already inside insert/
+        # lookup (allocations under the lock can trigger collection)
+        self._lock = threading.RLock()
+        self._on_evict = on_evict
+
+    def insert(self, key, value, nbytes: int) -> None:
+        evicted = []
+        with self._lock:
+            if key in self._map:
+                _, old = self._map.pop(key)
+                self._bytes -= old
+            self._map[key] = (value, nbytes)
+            self._bytes += nbytes
+            cap = _rcache_bytes.get()
+            while self._bytes > cap and self._map:
+                k, (v, n) = self._map.popitem(last=False)
+                self._bytes -= n
+                evicted.append((k, v))
+                pvar.record("rcache_evictions")
+        if self._on_evict:
+            for k, v in evicted:
+                self._on_evict(k, v)
+
+    def lookup(self, key):
+        with self._lock:
+            hit = self._map.get(key)
+            if hit is None:
+                return None
+            self._map.move_to_end(key)
+        pvar.record("rcache_hits")
+        return hit[0]
+
+    def invalidate(self, key) -> None:
+        with self._lock:
+            hit = self._map.pop(key, None)
+            if hit is not None:
+                self._bytes -= hit[1]
+        if hit is not None and self._on_evict:
+            self._on_evict(key, hit[0])
+
+    def clear(self) -> None:
+        with self._lock:
+            items = list(self._map.items())
+            self._map.clear()
+            self._bytes = 0
+        if self._on_evict:
+            for k, (v, _) in items:
+                self._on_evict(k, v)
+
+    @property
+    def bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
+_fin_lock = threading.Lock()
+_fin_registered: set = set()
+
+
+def buffer_key(buf, cache: "Rcache"):
+    """A cache key for a (device) buffer: ``id(buf)`` guarded by a
+    weakref finalizer that invalidates the entry when the buffer dies —
+    the analog of rcache's memory-hook invalidation on munmap
+    (opal/memoryhooks/). Registered once per (buffer, cache): repeat
+    calls on a hot path must not pile up finalizer objects. Falls back
+    to the bare id for objects that cannot carry weak references (the
+    entry then ages out by LRU)."""
+    key = id(buf)
+    token = (key, id(cache))
+    with _fin_lock:
+        if token in _fin_registered:
+            return key
+        _fin_registered.add(token)
+
+    def _die(k=key, c=cache, t=token):
+        with _fin_lock:
+            _fin_registered.discard(t)
+        c.invalidate(k)
+
+    try:
+        weakref.finalize(buf, _die)
+    except TypeError:
+        with _fin_lock:
+            _fin_registered.discard(token)
+    return key

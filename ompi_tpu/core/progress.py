@@ -25,6 +25,41 @@ _spin_var = cvar.register(
          "one Python sweep costs ~50x a C one, so the default is scaled "
          "down to keep the pre-yield spin time comparable.", level=8)
 
+_yield_var = cvar.register(
+    "yield_when_idle", "auto", str,
+    help="Yield the CPU aggressively while waiting: 'on' drops the "
+         "idle spin to a handful of sweeps, 'off' spins the full "
+         "progress_spin_count, 'auto' turns on when local ranks "
+         "oversubscribe the cores (the reference's mpi_yield_when_idle, "
+         "set by mpirun's oversubscription detection — "
+         "ompi/runtime/ompi_mpi_params.c).", choices=["auto", "on",
+                                                      "off"], level=5)
+
+_oversubscribed: bool | None = None
+
+
+def _spin_budget() -> int:
+    """Idle sweeps before the first yield. Oversubscribed hosts (ranks
+    > cores, the single-host test topology) must hand the core to the
+    peer that owns the data almost immediately: a full spin burns the
+    scheduler quantum doing no-op polls while every peer waits."""
+    mode = _yield_var.get()
+    if mode == "off":
+        return _spin_var.get()
+    if mode == "on":
+        return 4
+    global _oversubscribed
+    if _oversubscribed is None:
+        import os
+
+        local = int(os.environ.get("OMPI_TPU_LOCAL_SIZE", "1") or 1)
+        try:  # affinity/cgroup-aware: the cores we may actually run on
+            cores = len(os.sched_getaffinity(0))
+        except (AttributeError, OSError):
+            cores = os.cpu_count() or 1
+        _oversubscribed = local > cores
+    return 1 if _oversubscribed else _spin_var.get()
+
 
 def register(cb: Callable[[], int]) -> None:
     with _lock:
@@ -55,7 +90,7 @@ def progress() -> int:
 
 def wait_until(cond: Callable[[], bool], timeout: float | None = None) -> bool:
     """Spin progress until cond() — the SYNC_WAIT equivalent."""
-    spin_max = _spin_var.get()
+    spin_max = _spin_budget()
     deadline = None if timeout is None else time.monotonic() + timeout
     idle = 0
     yields = 0

@@ -17,6 +17,11 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from ompi_tpu.core import mpool as _mpool
+
+#: tiled span tables per (derived dtype, count) — rcache analog
+_span_cache = _mpool.Rcache()
+
 try:  # bfloat16 as a first-class predefined type (TPU-native)
     import ml_dtypes
 
@@ -66,8 +71,11 @@ def _tile(spans: np.ndarray, n: int, stride: int) -> np.ndarray:
 class Datatype:
     """An MPI datatype: a byte-layout description over an (N,2) span table."""
 
+    # __weakref__: the span cache's invalidate-on-death hook
+    # (mpool.buffer_key) needs weakref support — without it a recycled
+    # id() could alias a dead dtype's cached tables
     __slots__ = ("spans", "size", "extent", "lb", "name", "base",
-                 "committed")
+                 "committed", "__weakref__")
 
     def __init__(self, spans, extent: int, lb: int = 0,
                  base: Optional[np.dtype] = None,
@@ -110,8 +118,24 @@ class Datatype:
                         self.name + "_dup")
 
     def spans_for_count(self, count: int) -> np.ndarray:
-        """(N,2) span table covering ``count`` consecutive elements."""
-        return _tile(self.spans, count, self.extent)
+        """(N,2) span table covering ``count`` consecutive elements.
+
+        Tiled tables are cached in the registration cache (rcache
+        analog — the reference caches the compiled ddt description the
+        same way, opal_datatype_optimize.c): repeated sends of the same
+        (derived dtype, count) skip the O(spans*count) rebuild; LRU
+        eviction bounds memory for adversarial count diversity."""
+        key = _mpool.buffer_key(self, _span_cache)  # id + death hook
+        per_count = _span_cache.lookup(key)
+        if per_count is not None and count in per_count:
+            return per_count[count]
+        table = _tile(self.spans, count, self.extent)
+        if per_count is None:
+            per_count = {}
+        per_count[count] = table
+        _span_cache.insert(
+            key, per_count, sum(t.nbytes for t in per_count.values()))
+        return table
 
     def __repr__(self) -> str:
         return (f"Datatype({self.name}, size={self.size}, "

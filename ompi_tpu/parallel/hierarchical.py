@@ -1,0 +1,179 @@
+"""Hierarchical device collectives — the ICI×DCN composition layer.
+
+Reference: ompi/mca/coll/han (coll_han.h:22-33,62-63) splits a
+communicator into an intra-node ``low_comm`` and an inter-node
+``up_comm`` and composes per-level algorithms (e.g. allreduce =
+low reduce_scatter -> up allreduce -> low allgather), because the two
+levels have order-of-magnitude different bandwidths. On TPU pods the
+same two-level structure is ICI (fast intra-slice mesh) × DCN (slower
+data-center network between slices): a 2-axis ``jax.sharding.Mesh``
+with the *outer* axis spanning slices makes XLA place the inner-axis
+collectives on ICI and the outer-axis collectives on DCN.
+
+This module is the device-plane face of :mod:`ompi_tpu.coll.han`: the
+same compositions, expressed as traced jax collectives for use inside
+``shard_map`` programs over a hierarchical mesh. The bandwidth-optimal
+pattern — reduce_scatter on the cheap axis, the expensive axis touching
+only 1/ici_size of the data, allgather back — is the han "split-level"
+allreduce reimagined for the compiler: everything stays in one XLA
+program so the phases pipeline without host round-trips.
+
+Mesh construction helpers live here too (``hier_mesh``): on real
+hardware pass ``jax.devices()`` grouped by ``d.slice_index`` (one DCN
+group per slice); tests shape the virtual CPU mesh the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ompi_tpu import op as op_mod
+from ompi_tpu.parallel import collectives as C
+
+#: canonical axis names for the two levels
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+
+def hier_mesh(devices=None, n_slices: Optional[int] = None,
+              axis_names: Tuple[str, str] = (DCN_AXIS, ICI_AXIS)):
+    """A 2-level Mesh: outer axis = DCN groups (slices), inner = ICI.
+
+    With real TPU devices, groups by ``device.slice_index`` so each row
+    of the mesh is one slice and the outer axis crosses slices (XLA
+    then routes outer-axis collectives over DCN). Virtual/CPU devices
+    carry no slice index: ``n_slices`` splits the device list evenly in
+    enumeration order, standing in for the slice boundary.
+    """
+    from jax.sharding import Mesh
+
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    devices = list(devices)
+    by_slice = {}
+    if n_slices is None:
+        for d in devices:
+            idx = getattr(d, "slice_index", None)
+            if idx is None:
+                break
+            by_slice.setdefault(idx, []).append(d)
+        else:
+            rows = [by_slice[k] for k in sorted(by_slice)]
+            if len({len(r) for r in rows}) != 1:
+                raise ValueError(
+                    f"ragged slices: {[len(r) for r in rows]} devices "
+                    "per slice; a mesh needs equal rows")
+            return Mesh(np.array(rows), axis_names)
+        n_slices = 1  # no slice info: a single DCN group
+    if len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_slices} "
+            "equal slices")
+    grid = np.array(devices).reshape(n_slices, len(devices) // n_slices)
+    return Mesh(grid, axis_names)
+
+
+# ---------------------------------------------------------------------------
+# compositions (traced; call inside shard_map over a hier mesh)
+
+
+def allreduce(x, ici_axis: str = ICI_AXIS, dcn_axis: str = DCN_AXIS,
+              op=op_mod.SUM, deterministic: Optional[str] = None):
+    """han-style split-level allreduce.
+
+    low reduce_scatter (ICI) -> up allreduce (DCN, 1/ici_size of the
+    bytes) -> low allgather (ICI). DCN traffic shrinks by the ICI group
+    size versus a flat allreduce — the entire point of han's two-level
+    composition (coll_han.h:62-63), and of NCCL/XLA hierarchical rings.
+
+    Falls back to a flat fold over both axes for shapes the scatter
+    cannot tile (dim0 not divisible by the ICI group size).
+    """
+    n_ici = C.axis_size(ici_axis)
+    if x.ndim == 0 or x.shape[0] % n_ici:
+        # flat: single fused reduction over both axes
+        return C.allreduce(C.allreduce(x, ici_axis, op,
+                                       deterministic=deterministic),
+                           dcn_axis, op, deterministic=deterministic)
+    part = C.reduce_scatter(x, ici_axis, op, scatter_dim=0, tiled=True,
+                            deterministic=deterministic)
+    part = C.allreduce(part, dcn_axis, op, deterministic=deterministic)
+    return C.allgather(part, ici_axis, tiled=True, gather_dim=0)
+
+
+def reduce_scatter(x, ici_axis: str = ICI_AXIS, dcn_axis: str = DCN_AXIS,
+                   op=op_mod.SUM, deterministic: Optional[str] = None):
+    """Two-level reduce_scatter: ICI scatter first (bulk bytes on the
+    fast wire), then DCN scatter of the per-ICI-rank shard. Output is
+    the (dcn, ici)-lexicographic shard of the full reduction."""
+    part = C.reduce_scatter(x, ici_axis, op, scatter_dim=0, tiled=True,
+                            deterministic=deterministic)
+    return C.reduce_scatter(part, dcn_axis, op, scatter_dim=0,
+                            tiled=True, deterministic=deterministic)
+
+
+def allgather(x, ici_axis: str = ICI_AXIS, dcn_axis: str = DCN_AXIS):
+    """Inverse of :func:`reduce_scatter`: DCN allgather of the small
+    shard, then ICI allgather of the assembled row."""
+    part = C.allgather(x, dcn_axis, tiled=True, gather_dim=0)
+    return C.allgather(part, ici_axis, tiled=True, gather_dim=0)
+
+
+def bcast(x, root_dcn: int = 0, root_ici: int = 0,
+          ici_axis: str = ICI_AXIS, dcn_axis: str = DCN_AXIS):
+    """Root's block everywhere — han's composition (up bcast, then low
+    bcast, coll_han.h:62-63): the payload crosses DCN once, down the
+    root's ICI column to every slice's local delegate, then fans out on
+    the fast ICI wires inside each slice. (Columns other than the
+    root's move garbage in phase 1; phase 2 overwrites them from the
+    delegate, which is correct and keeps the program SPMD.)"""
+    x = C.bcast(x, dcn_axis, root_dcn)      # root's column: slice->slices
+    return C.bcast(x, ici_axis, root_ici)   # every slice: delegate->row
+
+
+def alltoall(x, ici_axis: str = ICI_AXIS, dcn_axis: str = DCN_AXIS):
+    """Global all-to-all over the flattened (dcn, ici) rank space as
+    two phased exchanges: ICI first regroups data by destination slice,
+    DCN then delivers slice-to-slice in one pass — each payload byte
+    crosses DCN exactly once (the han/hierarchical alltoall property).
+
+    dim0 must be divisible by dcn_size*ici_size; rows are interpreted
+    in (dcn, ici)-major destination order, matching the rank order of
+    a flattened hierarchical mesh.
+    """
+    n_ici = C.axis_size(ici_axis)
+    n_dcn = C.axis_size(dcn_axis)
+    n = n_dcn * n_ici
+    if x.shape[0] % n:
+        raise ValueError(
+            f"hier alltoall: dim0 {x.shape[0]} not divisible by "
+            f"world {n}")
+    blk = x.shape[0] // n
+    rest = x.shape[1:]
+    # phase 1 (ICI): deliver by ici_dst within each slice. Input rows
+    # are destination-rank-major = (dcn_dst, ici_dst, blk); regroup
+    # ici_dst-major (blk stays folded into dim0) so the axis split is
+    # by ici destination.
+    body = x.reshape((n_dcn, n_ici, blk) + rest)
+    body = body.swapaxes(0, 1).reshape((n * blk,) + rest)
+    body = C.alltoall(body, ici_axis, split_dim=0, concat_dim=0)
+    # holder (slice u, ici j) now has rows (ici_src, dcn_dst, blk) all
+    # with ici_dst == j; regroup dcn_dst-major for the DCN split
+    body = body.reshape((n_ici, n_dcn, blk) + rest)
+    body = body.swapaxes(0, 1).reshape((n * blk,) + rest)
+    # phase 2 (DCN): slice-to-slice delivery; result rows come out
+    # (dcn_src, ici_src, blk) = flattened-source-rank-major, the MPI
+    # alltoall output order
+    return C.alltoall(body, dcn_axis, split_dim=0, concat_dim=0)
+
+
+def barrier(ici_axis: str = ICI_AXIS, dcn_axis: str = DCN_AXIS):
+    """Returns a dependence token (sum of both levels' tokens) the
+    caller must thread into downstream computation — as with
+    :func:`C.barrier`, synchronization only exists through data
+    dependence; an unused token is dead-code-eliminated by XLA."""
+    return C.barrier(ici_axis) + C.barrier(dcn_axis)

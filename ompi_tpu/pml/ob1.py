@@ -35,9 +35,10 @@ import numpy as np
 
 from ompi_tpu import errors
 from ompi_tpu.btl import base as btl_base
-from ompi_tpu.core import output, pvar
+from ompi_tpu.core import memchecker, mpool, output, pvar
 from ompi_tpu.datatype import BYTE, Convertor
 from ompi_tpu.datatype.convertor import dtype_of
+from ompi_tpu.pml import peruse
 from ompi_tpu.pml import request as rq
 from ompi_tpu.runtime import rte
 
@@ -225,6 +226,10 @@ class Ob1:
             if dtype is None:
                 dtype = dtype_of(buf)
             conv = Convertor(buf, dtype, count)
+            if memchecker.enabled():
+                # reference: MEMCHECKER annotation on every send entry
+                # (ompi/mpi/c/send.c) — flag sends of undefined bytes
+                memchecker.check_defined(buf, "send")
         if sync:
             flags |= FLAG_SYNC
         dst_world = comm.world_rank(dst)
@@ -326,6 +331,14 @@ class Ob1:
             dtype = dtype_of(buf)
         req = RecvRequest(ctx, src, tag, buf, count, dtype, False)
         pvar.record("irecv")
+        if buf is not None and memchecker.enabled():
+            # contents undefined until completion; also flags a second
+            # receive racing into the same bytes. Shadow only the
+            # count*extent bytes the receive can write — a recv into a
+            # larger array must not poison the untouched tail.
+            span = count * dtype.extent if (dtype is not None
+                                            and count) else 0
+            memchecker.mark_undefined(req.id, buf, span)
         err = self._recv_src_failed(comm, src)
         if err:
             req.complete(err)
@@ -379,9 +392,20 @@ class Ob1:
         for ux in ux_q:
             if self._hdr_matches(req, ux.hdr):
                 ux_q.remove(ux)
+                if peruse.active:
+                    peruse.fire(peruse.MSG_REMOVE_FROM_UNEX_Q,
+                                ctx=req.ctx, src=ux.hdr[2],
+                                tag=ux.hdr[3], size=ux.hdr[5],
+                                msgid=ux.hdr[7])
+                    peruse.fire(peruse.REQ_MATCH_UNEX, ctx=req.ctx,
+                                src=ux.hdr[2], tag=ux.hdr[3],
+                                size=ux.hdr[5], msgid=ux.hdr[7])
                 self._match(req, ux.hdr, ux.payload, ux.src_world)
                 return
         self.posted.setdefault(req.ctx, deque()).append(req)
+        if peruse.active:
+            peruse.fire(peruse.REQ_INSERT_IN_POSTED_Q, ctx=req.ctx,
+                        src=req.want_src, tag=req.want_tag)
 
     @staticmethod
     def _hdr_matches(req: RecvRequest, hdr) -> bool:
@@ -522,11 +546,18 @@ class Ob1:
         for req in q:
             if self._hdr_matches(req, hdr):
                 q.remove(req)
+                if peruse.active:
+                    peruse.fire(peruse.REQ_REMOVE_FROM_POSTED_Q,
+                                ctx=ctx, src=src, tag=tag, size=size,
+                                msgid=msgid)
                 self._match(req, hdr, payload, self._src_world(ctx, src))
                 return
         pvar.record("unexpected")
         self.unexpected.setdefault(ctx, deque()).append(
             _Unexpected(hdr, payload, self._src_world(ctx, src)))
+        if peruse.active:
+            peruse.fire(peruse.MSG_INSERT_IN_UNEX_Q, ctx=ctx, src=src,
+                        tag=tag, size=size, msgid=msgid)
 
     @staticmethod
     def _src_world(ctx: int, src_commrank: int) -> int:
@@ -549,7 +580,11 @@ class Ob1:
         req.total = size
         # build the receive convertor
         if req.is_obj or (flags & FLAG_OBJ and req.buf is None):
-            req.buf = bytearray(size)
+            # pooled scratch (mpool): object payloads arrive at a high
+            # rate from the lowercase API; the pool's size classes may
+            # hand back a larger bytearray — the convertor only touches
+            # [0, size) and _finish_recv slices before unpickling
+            req.buf = mpool.pool.take(size)
             req.is_obj = True
             req.conv = Convertor(req.buf, BYTE, size)
         else:
@@ -624,8 +659,15 @@ class Ob1:
 
     def _finish_recv(self, req: RecvRequest) -> None:
         if req.is_obj and req.status.error == 0:
-            req._obj = pickle.loads(bytes(req.buf))
+            req._obj = pickle.loads(
+                bytes(memoryview(req.buf)[:req.total]))
+            mpool.pool.give(req.buf)
+            req.buf = None
         req.complete(req.status.error)
+        if peruse.active:
+            peruse.fire(peruse.REQ_COMPLETE, ctx=req.ctx,
+                        src=req.status.source, tag=req.status.tag,
+                        size=req.status.count)
 
     # -- sender: ack/frag streaming (reference: mca_pml_ob1_send_request_
     #    schedule pipeline, depth pml_ob1_component.c:207) ----------------

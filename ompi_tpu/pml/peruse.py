@@ -1,0 +1,81 @@
+"""PERUSE — message-queue event callbacks for MPI tools.
+
+Reference: ompi/peruse/ (729 LoC): a tool registers per-communicator
+callbacks on the PML's internal queue events (PERUSE_COMM_REQ_INSERT_IN_
+POSTED_Q, ..._REMOVE_FROM_POSTED_Q, ..._MSG_INSERT_IN_UNEX_Q,
+..._MSG_REMOVE_FROM_UNEX_Q, ..._REQ_MATCH_UNEX, peruse.h event enum) and
+observes matching behavior — the data MPI profilers use to attribute
+late-sender/late-receiver time.
+
+TPU-first shape: a process-wide subscription table fired from ob1's
+matching engine. The hot path pays one module-attribute truth test when
+no tool is attached (``active`` flips only on first subscription) — the
+reference compiles to the same single branch via its event-handle
+activation check.
+
+Event payloads are keyword dicts rather than opaque handles: Python
+tools want ``ev["tag"]`` not a descriptor query API.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List
+
+# -- event ids (reference: peruse.h PERUSE_COMM_* enum) --------------------
+REQ_INSERT_IN_POSTED_Q = "req_insert_in_posted_q"
+REQ_REMOVE_FROM_POSTED_Q = "req_remove_from_posted_q"
+MSG_INSERT_IN_UNEX_Q = "msg_insert_in_unex_q"
+MSG_REMOVE_FROM_UNEX_Q = "msg_remove_from_unex_q"
+REQ_MATCH_UNEX = "req_match_unex"
+REQ_COMPLETE = "req_complete"
+
+EVENTS = (REQ_INSERT_IN_POSTED_Q, REQ_REMOVE_FROM_POSTED_Q,
+          MSG_INSERT_IN_UNEX_Q, MSG_REMOVE_FROM_UNEX_Q,
+          REQ_MATCH_UNEX, REQ_COMPLETE)
+
+#: fast-path guard: ob1 tests this before building event payloads
+active: bool = False
+
+_lock = threading.Lock()
+_subs: Dict[str, List[Callable[[dict], None]]] = {}
+
+
+def subscribe(event: str, cb: Callable[[dict], None]) -> None:
+    """Attach a tool callback; cb receives one dict per event with keys
+    ``event, ctx, src, tag`` (+ ``size, msgid`` for message events)."""
+    global active
+    if event not in EVENTS:
+        raise ValueError(f"unknown peruse event {event!r}")
+    with _lock:
+        _subs.setdefault(event, []).append(cb)
+        active = True
+
+
+def unsubscribe(event: str, cb: Callable[[dict], None]) -> None:
+    global active
+    with _lock:
+        try:
+            _subs.get(event, []).remove(cb)
+        except ValueError:
+            pass
+        if not any(_subs.values()):
+            active = False
+
+
+def fire(event: str, **info) -> None:
+    """Deliver an event (no-op without subscribers; ob1 additionally
+    guards on :data:`active` so payload dicts aren't even built)."""
+    cbs = _subs.get(event)
+    if not cbs:
+        return
+    info["event"] = event
+    for cb in tuple(cbs):
+        cb(info)
+
+
+def reset_for_testing() -> None:
+    global active
+    with _lock:
+        _subs.clear()
+        active = False

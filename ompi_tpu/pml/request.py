@@ -11,7 +11,7 @@ from __future__ import annotations
 import itertools
 from typing import Any, List, Optional, Sequence
 
-from ompi_tpu.core import progress
+from ompi_tpu.core import memchecker, progress
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -56,6 +56,9 @@ class Request:
     def complete(self, error: int = 0) -> None:
         self.status.error = error
         self.completed = True
+        # memchecker: a completed receive's bytes become defined
+        # (no-op unless shadow intervals exist — see core/memchecker)
+        memchecker.mark_defined(self.id)
 
     def test(self) -> bool:
         if not self.completed:

@@ -39,22 +39,31 @@ def _import_component_universe() -> None:
     registration runs and the dump is complete, without bringing up
     the runtime (no rte/store init — like ompi_info, which opens
     frameworks without calling MPI_Init). Auto-discovered via
-    pkgutil so new components can never silently drift out of the
-    dump; per-module failures warn and continue."""
+    pkgutil.iter_modules with *manual* recursion: walk_packages would
+    itself import every package — including denylisted ones — just to
+    recurse into it; iter_modules only reads directory listings, so
+    denylisted subtrees are pruned before any import runs. Per-module
+    failures warn and continue."""
     import importlib
     import pkgutil
 
     import ompi_tpu
 
-    for info in pkgutil.walk_packages(ompi_tpu.__path__, "ompi_tpu."):
-        mod = info.name
-        if mod.startswith(_DISCOVERY_DENYLIST):
-            continue
-        try:
-            importlib.import_module(mod)
-        except Exception as exc:  # noqa: BLE001 — a broken module should
-            print(f"# warning: {mod} failed to import: {exc}",
-                  file=sys.stderr)  # not hide the rest of the dump
+    stack = [("ompi_tpu.", list(ompi_tpu.__path__))]
+    while stack:
+        prefix, paths = stack.pop()
+        for info in pkgutil.iter_modules(paths, prefix):
+            mod = info.name
+            if mod.startswith(_DISCOVERY_DENYLIST):
+                continue
+            try:
+                imported = importlib.import_module(mod)
+            except Exception as exc:  # noqa: BLE001 — a broken module
+                print(f"# warning: {mod} failed to import: {exc}",
+                      file=sys.stderr)  # must not hide the whole dump
+                continue
+            if info.ispkg:
+                stack.append((mod + ".", list(imported.__path__)))
 
 
 def collect(level: int = 3,

@@ -1,0 +1,112 @@
+"""mpool/rcache/allocator analog (core/mpool).
+
+Reference parity: opal_free_list_t grow/recycle, allocator/bucket size
+classes, rcache/grdma LRU + invalidation-on-release."""
+
+import gc
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core import cvar, mpool
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pool():
+    # module-level singletons: keep tests independent
+    mpool.pool._classes.clear()
+    mpool.pool._idle = 0
+    yield
+
+
+def test_bufferpool_size_class_and_reuse():
+    buf = mpool.pool.take(1000)
+    assert len(buf) == 1024  # next pow2 class
+    mpool.pool.give(buf)
+    assert mpool.pool.idle_bytes == 1024
+    again = mpool.pool.take(700)  # same class
+    assert again is buf
+    assert mpool.pool.idle_bytes == 0
+
+
+def test_bufferpool_rejects_foreign_buffers():
+    mpool.pool.give(bytearray(999))  # not a pow2 class
+    assert mpool.pool.idle_bytes == 0
+
+
+def test_bufferpool_respects_byte_cap():
+    old = cvar.get("mpool_max_cached_bytes")
+    try:
+        cvar.set("mpool_max_cached_bytes", 2048)
+        mpool.pool.give(bytearray(2048))
+        assert mpool.pool.idle_bytes == 2048
+        mpool.pool.give(bytearray(2048))  # over cap: dropped
+        assert mpool.pool.idle_bytes == 2048
+    finally:
+        cvar.set("mpool_max_cached_bytes", old)
+
+
+def test_rcache_lru_eviction_and_hook():
+    evicted = []
+    old = cvar.get("rcache_max_bytes")
+    try:
+        cvar.set("rcache_max_bytes", 100)
+        rc = mpool.Rcache(on_evict=lambda k, v: evicted.append(k))
+        rc.insert("a", 1, 40)
+        rc.insert("b", 2, 40)
+        assert rc.lookup("a") == 1  # refresh a: b becomes LRU
+        rc.insert("c", 3, 40)      # 120 > 100 -> evict b
+        assert evicted == ["b"]
+        assert rc.lookup("b") is None
+        assert rc.lookup("a") == 1 and rc.lookup("c") == 3
+    finally:
+        cvar.set("rcache_max_bytes", old)
+
+
+def test_rcache_invalidate():
+    rc = mpool.Rcache()
+    rc.insert("k", "v", 10)
+    rc.invalidate("k")
+    assert rc.lookup("k") is None
+    assert rc.bytes == 0
+
+
+def test_buffer_key_invalidates_on_death():
+    rc = mpool.Rcache()
+
+    class Obj:
+        pass
+
+    o = Obj()
+    key = mpool.buffer_key(o, rc)
+    rc.insert(key, "live", 8)
+    assert rc.lookup(key) == "live"
+    del o
+    gc.collect()
+    assert rc.lookup(key) is None  # finalizer fired
+
+
+def test_buffer_key_registers_once():
+    rc = mpool.Rcache()
+
+    class Obj:
+        pass
+
+    o = Obj()
+    k1 = mpool.buffer_key(o, rc)
+    k2 = mpool.buffer_key(o, rc)
+    assert k1 == k2
+    assert sum(1 for t in mpool._fin_registered if t[0] == k1) == 1
+
+
+def test_span_cache_reuses_tables():
+    from ompi_tpu import datatype as dt
+
+    vec = dt.vector(4, 2, 5, dt.FLOAT)
+    t1 = vec.spans_for_count(3)
+    t2 = vec.spans_for_count(3)
+    assert t1 is t2  # cache hit returns the same table
+    t3 = vec.spans_for_count(4)
+    assert t3 is not t1
+    np.testing.assert_array_equal(
+        t1, np.asarray(t1))  # sane ndarray content

@@ -1,0 +1,89 @@
+"""PERUSE message-queue event callbacks (pml/peruse).
+
+Reference parity: ompi/peruse/ event classes — posted-queue insert/
+remove, unexpected-queue insert/remove, match-from-unexpected."""
+
+import pytest
+
+from ompi_tpu.pml import peruse
+from tests import harness
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    peruse.reset_for_testing()
+    yield
+    peruse.reset_for_testing()
+
+
+def test_subscribe_validates_event():
+    with pytest.raises(ValueError):
+        peruse.subscribe("bogus", lambda ev: None)
+
+
+def test_active_flag_tracks_subscriptions():
+    assert not peruse.active
+    cb = lambda ev: None  # noqa: E731
+    peruse.subscribe(peruse.REQ_COMPLETE, cb)
+    assert peruse.active
+    peruse.unsubscribe(peruse.REQ_COMPLETE, cb)
+    assert not peruse.active
+
+
+def test_fire_without_subscribers_is_noop():
+    peruse.fire(peruse.REQ_COMPLETE, ctx=0)  # must not raise
+
+
+def test_late_receiver_events():
+    """Sender first: the message parks in the unexpected queue, the
+    late recv matches it -> UNEX insert + remove + match events."""
+    harness.run_ranks("""
+        from ompi_tpu.pml import peruse
+        events = []
+        for ev in peruse.EVENTS:
+            peruse.subscribe(ev, lambda e: events.append(e))
+        if rank == 0:
+            comm.Barrier()
+            got = np.zeros(4, np.float32)
+            comm.Recv(got, 1, tag=42)       # sender already fired
+            kinds = [e["event"] for e in events]
+            assert peruse.MSG_INSERT_IN_UNEX_Q in kinds, kinds
+            assert peruse.MSG_REMOVE_FROM_UNEX_Q in kinds, kinds
+            assert peruse.REQ_MATCH_UNEX in kinds, kinds
+            unex = [e for e in events
+                    if e["event"] == peruse.MSG_INSERT_IN_UNEX_Q][0]
+            assert unex["tag"] == 42 and unex["size"] == 16
+        else:
+            comm.Send(np.ones(4, np.float32), 0, tag=42)
+            comm.Barrier()
+            import time
+            time.sleep(0.3)  # let rank 0's recv run while we idle
+    """, 2)
+
+
+def test_late_sender_events():
+    """Receiver first: the request parks in the posted queue and the
+    arrival removes it -> POSTED insert + remove events."""
+    harness.run_ranks("""
+        from ompi_tpu.pml import peruse
+        events = []
+        for ev in peruse.EVENTS:
+            peruse.subscribe(ev, lambda e: events.append(e))
+        if rank == 0:
+            req = comm.Irecv(np.zeros(4, np.float32), 1, tag=5)
+            comm.Barrier()                  # recv posted before send
+            req.wait()
+            kinds = [e["event"] for e in events]
+            assert peruse.REQ_INSERT_IN_POSTED_Q in kinds, kinds
+            removed = [e for e in events
+                       if e["event"] == peruse.REQ_REMOVE_FROM_POSTED_Q]
+            assert any(e["tag"] == 5 for e in removed), events
+            assert peruse.REQ_COMPLETE in kinds, kinds
+            # our message matched a posted recv: it must never have
+            # entered the unexpected queue (barrier traffic might)
+            assert not any(e["event"] == peruse.MSG_INSERT_IN_UNEX_Q
+                           and e["tag"] == 5 for e in events), events
+        else:
+            comm.Barrier()
+            comm.Send(np.ones(4, np.float32), 0, tag=5)
+    """, 2)
