@@ -47,7 +47,7 @@ SLOTS = (
     # device arrays — PJRT buffers are immutable)
     "allreduce_dev", "bcast_dev", "reduce_dev", "allgather_dev",
     "alltoall_dev", "reduce_scatter_block_dev", "scatter_dev",
-    "gather_dev",
+    "gather_dev", "scan_dev", "exscan_dev",
 )
 
 

@@ -139,6 +139,27 @@ def gather_dev(comm, sendbuf, root=0):
     return _stage_out(recv, sendbuf)
 
 
+def scan_dev(comm, sendbuf, op=op_mod.SUM, deterministic=None):
+    pvar.record("coll_accelerator_staged")
+    host = _stage_in(sendbuf)
+    recv = np.empty_like(host)
+    comm.coll.scan(comm, host, recv, host.size, None, op)
+    return _stage_out(recv, sendbuf)
+
+
+def exscan_dev(comm, sendbuf, op=op_mod.SUM, deterministic=None):
+    """MPI semantics: rank 0's result is undefined — this path pins it
+    to zeros, matching coll/xla's traced exscan default so the two
+    components agree."""
+    pvar.record("coll_accelerator_staged")
+    host = _stage_in(sendbuf)
+    recv = np.empty_like(host)
+    comm.coll.exscan(comm, host, recv, host.size, None, op)
+    if comm.rank == 0:
+        recv = np.zeros_like(host)
+    return _stage_out(recv, sendbuf)
+
+
 @framework.register
 class CollAccelerator(CollModule):
     NAME = "accelerator"
@@ -157,4 +178,6 @@ class CollAccelerator(CollModule):
             "reduce_scatter_block_dev": reduce_scatter_block_dev,
             "scatter_dev": scatter_dev,
             "gather_dev": gather_dev,
+            "scan_dev": scan_dev,
+            "exscan_dev": exscan_dev,
         }

@@ -119,6 +119,12 @@ def allgather_tuned(comm, sendbuf, recvbuf, count, dtype):
                                              count, dtype)
     if _bytes(count, dtype) <= _small.get():
         return A.allgather_bruck(comm, sendbuf, recvbuf, count, dtype)
+    if comm.size & (comm.size - 1) == 0:
+        # pow2: recursive doubling measured ~1.5x faster than ring at
+        # every size tried (log p rounds vs p-1, same total bytes; the
+        # per-round Python/handshake cost dominates on this plane)
+        return A.allgather_recursivedoubling(comm, sendbuf, recvbuf,
+                                             count, dtype)
     return A.allgather_ring(comm, sendbuf, recvbuf, count, dtype)
 
 

@@ -293,6 +293,51 @@ def scatter_dev(comm, sendbuf, root: int = 0):
     return ctx.my_shard(fn(ctx.to_global(x)))
 
 
+def scan_dev(comm, sendbuf, op=op_mod.SUM,
+             deterministic: Optional[str] = None):
+    """Inclusive prefix over comm ranks (lax.associative_scan under
+    shard_map — log-depth on device)."""
+    if not _op_ok(op):
+        return staging.scan_dev(comm, sendbuf, op)
+    pvar.record("coll_xla_device")
+    if comm.size == 1:
+        return sendbuf
+    from ompi_tpu.parallel import collectives as C
+
+    ctx = _ctx(comm)
+    opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
+
+    def build():
+        return ctx.smap(lambda a: C.scan(a[0], AXIS, opn),
+                        out_varying=True)
+
+    fn = ctx.compiled(_key(sendbuf, "scan", opn.name), build)
+    return ctx.my_shard(fn(ctx.to_global(sendbuf)))
+
+
+def exscan_dev(comm, sendbuf, op=op_mod.SUM,
+               deterministic: Optional[str] = None):
+    """Exclusive prefix; rank 0 gets zeros (MPI leaves it undefined)."""
+    if not _op_ok(op):
+        return staging.exscan_dev(comm, sendbuf, op)
+    pvar.record("coll_xla_device")
+    if comm.size == 1:
+        import jax.numpy as jnp
+
+        return jnp.zeros_like(sendbuf)
+    from ompi_tpu.parallel import collectives as C
+
+    ctx = _ctx(comm)
+    opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
+
+    def build():
+        return ctx.smap(lambda a: C.exscan(a[0], AXIS, opn),
+                        out_varying=True)
+
+    fn = ctx.compiled(_key(sendbuf, "exscan", opn.name), build)
+    return ctx.my_shard(fn(ctx.to_global(sendbuf)))
+
+
 @framework.register
 class CollXla(CollModule):
     NAME = "xla"
@@ -320,4 +365,6 @@ class CollXla(CollModule):
             "alltoall_dev": alltoall_dev,
             "reduce_scatter_block_dev": reduce_scatter_block_dev,
             "scatter_dev": scatter_dev,
+            "scan_dev": scan_dev,
+            "exscan_dev": exscan_dev,
         }

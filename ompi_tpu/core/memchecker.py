@@ -117,13 +117,14 @@ def mark_defined(req_id: int) -> None:
             _undefined.pop(req_id, None)
 
 
-def check_defined(arr, what: str = "send") -> None:
-    """Flag use of a buffer whose bytes are undefined (pending recv).
-    Called by the PML on every send pack; callable from applications
-    as the ``MEMCHECKER(memchecker_call(...))`` analog."""
+def check_defined(arr, what: str = "send", nbytes: int = 0) -> None:
+    """Flag use of a buffer whose bytes are undefined (pending recv);
+    ``nbytes`` bounds the span to the bytes the operation actually
+    reads. Called by the PML on every send pack; callable from
+    applications as the ``MEMCHECKER(memchecker_call(...))`` analog."""
     if not enabled() or not _undefined:
         return
-    ivl = _interval(arr)
+    ivl = _interval(arr, nbytes)
     with _lock:
         clash = _overlaps(ivl)
     if clash:

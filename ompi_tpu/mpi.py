@@ -475,17 +475,21 @@ def _Reduce_scatter(self, sendbuf, recvbuf, counts, op=op_mod.SUM) -> None:
                              dtype_of(rarr), op)
 
 
-def _Scan(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
+def _Scan(self, sendbuf, recvbuf=None, op=op_mod.SUM) -> None:
     self.check_revoked()
     self.check_failed()
+    if _is_dev(sendbuf):
+        return self.coll.scan_dev(self, sendbuf, op)
     sarr, count, dt = _parse_buf(sendbuf)
     rarr = _parse_buf(recvbuf)[0]
     self.coll.scan(self, sarr, rarr, count, dt, op)
 
 
-def _Exscan(self, sendbuf, recvbuf, op=op_mod.SUM) -> None:
+def _Exscan(self, sendbuf, recvbuf=None, op=op_mod.SUM) -> None:
     self.check_revoked()
     self.check_failed()
+    if _is_dev(sendbuf):
+        return self.coll.exscan_dev(self, sendbuf, op)
     sarr, count, dt = _parse_buf(sendbuf)
     rarr = _parse_buf(recvbuf)[0]
     self.coll.exscan(self, sarr, rarr, count, dt, op)

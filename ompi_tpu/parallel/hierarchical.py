@@ -108,8 +108,11 @@ def allreduce(x, ici_axis: str = ICI_AXIS, dcn_axis: str = DCN_AXIS,
 def reduce_scatter(x, ici_axis: str = ICI_AXIS, dcn_axis: str = DCN_AXIS,
                    op=op_mod.SUM, deterministic: Optional[str] = None):
     """Two-level reduce_scatter: ICI scatter first (bulk bytes on the
-    fast wire), then DCN scatter of the per-ICI-rank shard. Output is
-    the (dcn, ici)-lexicographic shard of the full reduction."""
+    fast wire), then DCN scatter of the per-ICI-rank shard. Shard
+    placement is ici-major: rank (dcn=s, ici=j) holds global row
+    j*dcn_size + s of the reduction — :func:`allgather` inverts
+    exactly this order; do not feed these shards to flat rank-ordered
+    collectives without permuting."""
     part = C.reduce_scatter(x, ici_axis, op, scatter_dim=0, tiled=True,
                             deterministic=deterministic)
     return C.reduce_scatter(part, dcn_axis, op, scatter_dim=0,

@@ -228,8 +228,10 @@ class Ob1:
             conv = Convertor(buf, dtype, count)
             if memchecker.enabled():
                 # reference: MEMCHECKER annotation on every send entry
-                # (ompi/mpi/c/send.c) — flag sends of undefined bytes
-                memchecker.check_defined(buf, "send")
+                # (ompi/mpi/c/send.c) — flag sends of undefined bytes,
+                # bounded to the count*extent span actually packed
+                memchecker.check_defined(buf, "send",
+                                         count * dtype.extent)
         if sync:
             flags |= FLAG_SYNC
         dst_world = comm.world_rank(dst)
@@ -331,13 +333,13 @@ class Ob1:
             dtype = dtype_of(buf)
         req = RecvRequest(ctx, src, tag, buf, count, dtype, False)
         pvar.record("irecv")
-        if buf is not None and memchecker.enabled():
+        if buf is not None and memchecker.enabled() and count:
             # contents undefined until completion; also flags a second
             # receive racing into the same bytes. Shadow only the
             # count*extent bytes the receive can write — a recv into a
-            # larger array must not poison the untouched tail.
-            span = count * dtype.extent if (dtype is not None
-                                            and count) else 0
+            # larger array must not poison the untouched tail, and a
+            # zero-count recv writes nothing at all (skipped above).
+            span = count * dtype.extent if dtype is not None else 0
             memchecker.mark_undefined(req.id, buf, span)
         err = self._recv_src_failed(comm, src)
         if err:

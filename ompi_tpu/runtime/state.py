@@ -76,6 +76,10 @@ def init(thread_level: int = 0):
             _pml_v.install()
         if _pml_mon._enable_var.get():
             _pml_mon.install()
+        # debugger hook: SIGUSR1 match-queue dump (MPIR analog)
+        from ompi_tpu.tools import msgq as _msgq
+
+        _msgq.install_signal_dump()
         _world, _self_comm = build_world()
 
         # ULFM detector (opt-in: --mca ft 1); after comm construction so

@@ -1,0 +1,151 @@
+"""Message-queue introspection — the parallel-debugger (MPIR) analog.
+
+Reference: ompi/debuggers/ (5,654 LoC): the MPIR interface plus
+TotalView-style DLLs that walk a live rank's match queues
+(ompi_msgq_dll.c: posted receives, unexpected messages, pending sends)
+and handle tables (ompi_mpihandles_dll.c) from *outside* the process.
+
+TPU-first redesign: the queues live in one Python object (the ob1
+instance), so introspection is a first-party API instead of a debugger
+plug-in that re-implements struct layouts:
+
+- :func:`snapshot` — structured dump of posted/unexpected/in-flight
+  queues plus live communicator handles (the msgq + mpihandles DLL
+  payloads in one dict).
+- :func:`render` — human-readable lines, what a debugger would show.
+- :func:`install_signal_dump` — SIGUSR1 dumps the queues of a live
+  (possibly hung) rank to stderr: the practical equivalent of
+  attaching TotalView to inspect why a recv never matched. Installed
+  at init when the ``mpir_dump_on_signal`` cvar is on; ``tpurun``
+  users can then ``kill -USR1`` a stuck rank.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+from typing import Dict, List
+
+from ompi_tpu.core import cvar
+
+dump_on_signal = cvar.register(
+    "mpir_dump_on_signal", "on", str,
+    help="Install a SIGUSR1 handler that dumps PML match queues and "
+         "communicator handles to stderr — the debugger-attach "
+         "(MPIR/ompi_msgq_dll) equivalent for hung-rank triage.",
+    choices=["on", "off"], level=5)
+
+
+def _tag_str(tag: int) -> str:
+    return "ANY_TAG" if tag == -1 else str(tag)
+
+
+def _src_str(src: int) -> str:
+    return "ANY_SOURCE" if src == -1 else str(src)
+
+
+def snapshot() -> Dict:
+    """Queue + handle state of this rank (empty when no PML yet)."""
+    from ompi_tpu import comm as comm_mod, pml
+
+    inst = pml.instance()
+    out: Dict = {"posted": [], "unexpected": [], "pending_sends": [],
+                 "communicators": []}
+    # live communicator handles (mpihandles DLL payload)
+    for cid, c in sorted(getattr(comm_mod, "_comms", {}).items()):
+        if c is None:
+            continue
+        out["communicators"].append({
+            "cid": cid, "size": c.size, "rank": c.rank,
+            "name": getattr(c, "name", f"cid{cid}"),
+            "revoked": bool(getattr(c, "revoked", False)),
+            "inter": bool(getattr(c, "is_inter", False)),
+        })
+    if inst is None:
+        return out
+    for ctx, q in inst.posted.items():
+        for req in q:
+            out["posted"].append({
+                "cid": ctx // 2, "collective": bool(ctx & 1),
+                "src": req.want_src, "tag": req.want_tag,
+                "count": req.count,
+            })
+    for ctx, q in inst.unexpected.items():
+        for ux in q:
+            _, _, src, tag, seq, size, _, msgid = ux.hdr
+            out["unexpected"].append({
+                "cid": ctx // 2, "collective": bool(ctx & 1),
+                "src": src, "tag": tag, "seq": seq, "bytes": size,
+                "msgid": msgid,
+            })
+    for msgid, req in list(inst.pending_ack.items()):
+        out["pending_sends"].append({
+            "msgid": msgid, "dst_world": req.dst_world,
+            "state": "awaiting_ack",
+        })
+    for msgid, req in list(inst.streaming.items()):
+        out["pending_sends"].append({
+            "msgid": msgid, "dst_world": req.dst_world,
+            "state": "streaming", "acked_bytes": req.acked_bytes,
+            "total": req.conv.packed_size if req.conv else 0,
+        })
+    return out
+
+
+def render(snap: Dict = None) -> List[str]:
+    snap = snapshot() if snap is None else snap
+    lines = ["MPI message queues:"]
+    lines.append(f"  communicators ({len(snap['communicators'])}):")
+    for c in snap["communicators"]:
+        flags = "".join(f for f, on in (("R", c["revoked"]),
+                                        ("I", c["inter"])) if on)
+        lines.append(f"    cid {c['cid']:>3} {c['name']}: rank "
+                     f"{c['rank']}/{c['size']} {flags}")
+    lines.append(f"  posted receives ({len(snap['posted'])}):")
+    for p in snap["posted"]:
+        coll = " coll" if p["collective"] else ""
+        lines.append(f"    cid {p['cid']}{coll}: src "
+                     f"{_src_str(p['src'])} tag {_tag_str(p['tag'])} "
+                     f"count {p['count']}")
+    lines.append(f"  unexpected messages ({len(snap['unexpected'])}):")
+    for u in snap["unexpected"]:
+        coll = " coll" if u["collective"] else ""
+        lines.append(f"    cid {u['cid']}{coll}: src {u['src']} tag "
+                     f"{_tag_str(u['tag'])} seq {u['seq']} "
+                     f"{u['bytes']}B")
+    lines.append(f"  pending sends ({len(snap['pending_sends'])}):")
+    for s in snap["pending_sends"]:
+        extra = (f" {s['acked_bytes']}/{s['total']}B"
+                 if s["state"] == "streaming" else "")
+        lines.append(f"    msgid {s['msgid']} -> world "
+                     f"{s['dst_world']}: {s['state']}{extra}")
+    return lines
+
+
+def dump(file=None) -> None:
+    print("\n".join(render()), file=file or sys.stderr, flush=True)
+
+
+_installed = False
+
+
+def install_signal_dump() -> None:
+    """Idempotent; main-thread only (signal module restriction). An
+    application handler registered before Init is *chained*, not
+    clobbered — SIGUSR1 has conventional uses (reload, log rotation)
+    that MPI must not silently eat."""
+    global _installed
+    if _installed or dump_on_signal.get() != "on":
+        return
+    try:
+        prior = signal.getsignal(signal.SIGUSR1)
+
+        def _handler(signum, frame):
+            dump()
+            if callable(prior):
+                prior(signum, frame)
+
+        signal.signal(signal.SIGUSR1, _handler)
+        _installed = True
+    except ValueError:
+        pass  # not the main thread: debugger dump stays manual

@@ -208,3 +208,43 @@ print("OK")
                        capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr
     assert "OK" in r.stdout
+
+
+def test_scan_exscan_device():
+    run_ranks("""
+    import jax
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    x = jnp.full(6, float(rank + 1), jnp.float32)
+    s = comm.Scan(x)
+    assert isinstance(s, jax.Array), type(s)
+    # inclusive prefix: sum of ranks 0..rank of (r+1)
+    exp = sum(r + 1 for r in range(rank + 1))
+    np.testing.assert_array_equal(np.asarray(s),
+                                  np.full(6, exp, np.float32))
+    e = comm.Exscan(x)
+    exp_ex = sum(r + 1 for r in range(rank))  # 0 on rank 0 (zeros)
+    np.testing.assert_array_equal(np.asarray(e),
+                                  np.full(6, exp_ex, np.float32))
+    assert pvar.read("coll_accelerator_staged") == 0
+    assert comm.coll.providers["scan_dev"] == "xla"
+    """, 3, mca=MCA)
+
+
+def test_scan_staging_fallback():
+    """Plane off: device-buffer Scan stages through the host and
+    matches the same prefix results."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    x = jnp.full(4, float(rank + 2), jnp.float32)
+    s = comm.Scan(x)
+    exp = sum(r + 2 for r in range(rank + 1))
+    np.testing.assert_array_equal(np.asarray(s),
+                                  np.full(4, exp, np.float32))
+    e = comm.Exscan(x)
+    exp_ex = sum(r + 2 for r in range(rank))
+    np.testing.assert_array_equal(np.asarray(e),
+                                  np.full(4, exp_ex, np.float32))
+    assert pvar.read("coll_accelerator_staged") >= 2
+    """, 3)

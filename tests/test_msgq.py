@@ -1,0 +1,58 @@
+"""Message-queue introspection (tools/msgq) — the MPIR/debugger analog.
+
+Reference parity: ompi/debuggers/ompi_msgq_dll.c (posted/unexpected/
+pending-send walks) + ompi_mpihandles_dll.c (communicator handles)."""
+
+from tests import harness
+
+
+def test_snapshot_empty_before_init():
+    from ompi_tpu.tools import msgq
+
+    snap = msgq.snapshot()
+    assert snap["posted"] == [] and snap["unexpected"] == []
+    assert isinstance(msgq.render(snap), list)
+
+
+def test_queues_visible_and_drain():
+    harness.run_ranks("""
+        import signal, os
+        from ompi_tpu.tools import msgq
+        from ompi_tpu.core import progress
+        if rank == 0:
+            # a recv that can't match yet -> posted queue
+            pending = comm.Irecv(np.zeros(4, np.float32), 1, tag=99)
+            comm.Barrier()
+            # rank 1 sent tag 7 (no recv posted) -> unexpected queue
+            progress.wait_until(
+                lambda: any(u["tag"] == 7 for u in
+                            msgq.snapshot()["unexpected"]), timeout=30)
+            snap = msgq.snapshot()
+            assert any(p["tag"] == 99 for p in snap["posted"]), snap
+            assert any(u["tag"] == 7 for u in snap["unexpected"]), snap
+            world = [c for c in snap["communicators"]
+                     if c["size"] == size]
+            assert world and world[0]["rank"] == 0, snap
+            text = "\\n".join(msgq.render(snap))
+            assert "tag 7" in text and "tag 99" in text, text
+            # SIGUSR1 handler installed at init: must not kill us
+            os.kill(os.getpid(), signal.SIGUSR1)
+            # drain: receive the unexpected, satisfy the posted
+            got = np.zeros(4, np.float32)
+            comm.Recv(got, 1, tag=7)
+            comm.Send(np.ones(4, np.float32), 1, tag=98)
+            pending.wait()
+            snap = msgq.snapshot()
+            # collective frames (barrier rounds from peers' Finalize)
+            # may legitimately park; the p2p queues must be empty
+            assert not [p for p in snap["posted"]
+                        if not p["collective"]], snap
+            assert not [u for u in snap["unexpected"]
+                        if not u["collective"]], snap
+        else:
+            comm.Send(np.full(4, 2.0, np.float32), 0, tag=7)
+            comm.Barrier()
+            got = np.zeros(4, np.float32)
+            comm.Recv(got, 0, tag=98)
+            comm.Send(np.full(4, 3.0, np.float32), 0, tag=99)
+    """, 2)
