@@ -21,6 +21,10 @@ from ompi_tpu.datatype.datatype import Datatype, from_numpy_dtype
 
 Buffer = Union[np.ndarray, bytearray, memoryview, bytes]
 
+#: above this many total spans the convertor switches from a
+#: materialized span table to windowed per-range generation (big-count)
+_SPAN_WINDOW_LIMIT = 1 << 22
+
 
 def _writable_byte_view(buf: Buffer) -> np.ndarray:
     if isinstance(buf, np.ndarray):
@@ -57,8 +61,18 @@ class Convertor:
             raise ValueError(
                 f"datatype {dtype.name} has negative lb={dtype.lb}; "
                 "pass a buffer view that starts at lb or resize the type")
+        self._windowed = False
         if dtype.is_contiguous:
             self._spans = None  # fast path: one contiguous range
+        elif count * len(dtype.spans) > _SPAN_WINDOW_LIMIT:
+            # big-count (the fork's defining feature,
+            # ompi/util/count_disp_array.h:21-45 size_t count arrays):
+            # a materialized span table would be O(count) memory, so
+            # window-generate spans per pack/unpack range instead —
+            # the reference's iterative pack stack never materializes
+            # the full description either (opal_datatype_pack.c).
+            self._windowed = True
+            self._spans = None
         else:
             self._spans = dtype.spans_for_count(count)
             self._cum = np.concatenate(
@@ -98,7 +112,9 @@ class Convertor:
         if end <= start:
             return b""
         src = self._flat(writable=False)
-        if self._spans is None:
+        if self._windowed:
+            out = self._gather_win(src, start, end)
+        elif self._spans is None:
             out = src[start:end].tobytes()
         elif start == 0 and end == self.packed_size:
             out = self._move_full(src, scatter=False)
@@ -150,16 +166,52 @@ class Convertor:
         return idx
 
     def _gather(self, src: np.ndarray, start: int, end: int) -> bytes:
-        spans, cum = self._spans, self._cum
-        i0 = int(np.searchsorted(cum, start, side="right")) - 1
-        i1 = int(np.searchsorted(cum, end, side="left"))
-        parts = []
-        for i in range(i0, i1):
-            off, ln = int(spans[i, 0]), int(spans[i, 1])
-            s0 = max(0, start - int(cum[i]))
-            s1 = min(ln, end - int(cum[i]))
-            parts.append(src[off + s0:off + s1])
-        return np.concatenate(parts).tobytes() if parts else b""
+        return _gather_range(src, self._spans, self._cum, start,
+                             end).tobytes()
+
+    # -- big-count windowed movement --------------------------------------
+    def _window_spans(self, e0: int, e1: int):
+        """Span table + packed-byte cumsum for elements [e0, e1) —
+        generated on demand so memory is O(window), not O(count)."""
+        espans = self.dtype.spans
+        base = np.arange(e0, e1, dtype=np.int64) * self.dtype.extent
+        offs = (espans[:, 0][None, :] + base[:, None]).reshape(-1)
+        lens = np.tile(espans[:, 1], e1 - e0)
+        spans = np.stack([offs, lens], axis=1)
+        return spans, np.concatenate(([0], np.cumsum(lens)))
+
+    def _win_iter(self, start: int, end: int):
+        """Yield (window spans, window cum, local start, local end,
+        out position) chunks covering packed bytes [start, end)."""
+        esize = self.dtype.size
+        W = max(1, _SPAN_WINDOW_LIMIT //
+                max(1, len(self.dtype.spans)))
+        last = (end - 1) // esize + 1  # first element past the range:
+        # never generate spans beyond what the fragment touches (a
+        # 64KB fragment must cost O(fragment), not O(window limit))
+        e = start // esize
+        pos = 0
+        while pos < end - start:
+            we = min(self.count, e + W, last)
+            spans, cum = self._window_spans(e, we)
+            wb0 = e * esize
+            s = max(start, wb0) - wb0
+            t = min(end, we * esize) - wb0
+            yield spans, cum, s, t, pos
+            pos += t - s
+            e = we
+
+    def _gather_win(self, src: np.ndarray, start: int,
+                    end: int) -> bytes:
+        out = np.empty(end - start, np.uint8)
+        for spans, cum, s, t, pos in self._win_iter(start, end):
+            out[pos:pos + (t - s)] = _gather_range(src, spans, cum, s, t)
+        return out.tobytes()
+
+    def _scatter_win(self, dst: np.ndarray, src: np.ndarray,
+                     start: int, end: int) -> None:
+        for spans, cum, s, t, pos in self._win_iter(start, end):
+            _scatter_range(dst, src[pos:pos + (t - s)], spans, cum, s, t)
 
     # -- unpack -----------------------------------------------------------
     def unpack(self, data: bytes) -> int:
@@ -171,7 +223,9 @@ class Convertor:
         end = min(self.packed_size, start + len(data))
         n = end - start
         src = np.frombuffer(data, dtype=np.uint8, count=n)
-        if self._spans is None:
+        if self._windowed:
+            self._scatter_win(dst, src, start, end)
+        elif self._spans is None:
             dst[start:end] = src
         elif start == 0 and end == self.packed_size:
             self._move_full(dst, scatter=True, wire=src)
@@ -184,17 +238,37 @@ class Convertor:
 
     def _scatter(self, dst: np.ndarray, src: np.ndarray,
                  start: int, end: int) -> None:
-        spans, cum = self._spans, self._cum
-        i0 = int(np.searchsorted(cum, start, side="right")) - 1
-        i1 = int(np.searchsorted(cum, end, side="left"))
-        pos = 0
-        for i in range(i0, i1):
-            off, ln = int(spans[i, 0]), int(spans[i, 1])
-            s0 = max(0, start - int(cum[i]))
-            s1 = min(ln, end - int(cum[i]))
-            take = s1 - s0
-            dst[off + s0:off + s1] = src[pos:pos + take]
-            pos += take
+        _scatter_range(dst, src, self._spans, self._cum, start, end)
+
+
+def _gather_range(src: np.ndarray, spans: np.ndarray, cum: np.ndarray,
+                  start: int, end: int) -> np.ndarray:
+    """Collect packed bytes [start, end) (cum coordinates) from src."""
+    i0 = int(np.searchsorted(cum, start, side="right")) - 1
+    i1 = int(np.searchsorted(cum, end, side="left"))
+    parts = []
+    for i in range(i0, i1):
+        off, ln = int(spans[i, 0]), int(spans[i, 1])
+        s0 = max(0, start - int(cum[i]))
+        s1 = min(ln, end - int(cum[i]))
+        parts.append(src[off + s0:off + s1])
+    return np.concatenate(parts) if parts else \
+        np.empty(0, dtype=np.uint8)
+
+
+def _scatter_range(dst: np.ndarray, src: np.ndarray, spans: np.ndarray,
+                   cum: np.ndarray, start: int, end: int) -> None:
+    """Place packed bytes [start, end) (cum coordinates) into dst."""
+    i0 = int(np.searchsorted(cum, start, side="right")) - 1
+    i1 = int(np.searchsorted(cum, end, side="left"))
+    pos = 0
+    for i in range(i0, i1):
+        off, ln = int(spans[i, 0]), int(spans[i, 1])
+        s0 = max(0, start - int(cum[i]))
+        s1 = min(ln, end - int(cum[i]))
+        take = s1 - s0
+        dst[off + s0:off + s1] = src[pos:pos + take]
+        pos += take
 
 
 def pack_external(datarep: str, buf: Buffer, dtype: Datatype,

@@ -53,6 +53,14 @@ def _merge(arr: np.ndarray) -> np.ndarray:
     return np.stack([offs, lens], axis=1)
 
 
+#: hard cap on a materialized type descriptor (~1 GB of span table).
+#: Big-count transfers belong on the API count — Send(buf, count=huge,
+#: dtype=small) streams through the convertor's windowed span
+#: generation with O(window) memory (the reference encodes such types
+#: as O(1) DT_LOOP descriptors; a span table cannot, so we bound it).
+_MAX_DESCRIPTOR_SPANS = 1 << 26
+
+
 def _tile(spans: np.ndarray, n: int, stride: int) -> np.ndarray:
     """n copies of a span table at byte stride, merged. Vectorized."""
     if n == 1:
@@ -61,6 +69,12 @@ def _tile(spans: np.ndarray, n: int, stride: int) -> np.ndarray:
     if len(spans) == 1 and stride == spans[0, 1]:
         # contiguous tiling collapses to one span
         return np.array([[spans[0, 0], stride * n]], dtype=np.int64)
+    if n * len(spans) > _MAX_DESCRIPTOR_SPANS:
+        raise ValueError(
+            f"type descriptor would need {n * len(spans):,} spans "
+            f"(> {_MAX_DESCRIPTOR_SPANS:,}); move the repetition to "
+            "the transfer count — Send(buf, count, small_dtype) "
+            "streams any count with O(1) descriptor memory")
     reps = np.arange(n, dtype=np.int64) * stride
     offs = (spans[None, :, 0] + reps[:, None]).reshape(-1)
     lens = np.broadcast_to(spans[None, :, 1],

@@ -50,9 +50,10 @@ def _parse_buf(buf) -> Tuple[Any, int, Optional[Datatype]]:
             "device array passed to an operation without a device "
             "path. Device-interposed collectives: Allreduce, Bcast, "
             "Reduce, Allgather, Alltoall, Reduce_scatter_block, "
-            "Scatter, Gather (sendbuf device, recvbuf None -> returns "
-            "a new device array). For other operations stage manually "
-            "with np.asarray(arr) / jax.device_put.")
+            "Scatter, Gather, Scan, Exscan (sendbuf device, recvbuf "
+            "None -> returns a new device array). For other "
+            "operations stage manually with np.asarray(arr) / "
+            "jax.device_put.")
     mv = memoryview(arr)
     return arr, mv.nbytes, None
 
@@ -480,6 +481,9 @@ def _Scan(self, sendbuf, recvbuf=None, op=op_mod.SUM) -> None:
     self.check_failed()
     if _is_dev(sendbuf):
         return self.coll.scan_dev(self, sendbuf, op)
+    if recvbuf is None:
+        raise TypeError("Scan with a host sendbuf requires recvbuf "
+                        "(recvbuf=None is the device-array form)")
     sarr, count, dt = _parse_buf(sendbuf)
     rarr = _parse_buf(recvbuf)[0]
     self.coll.scan(self, sarr, rarr, count, dt, op)
@@ -490,6 +494,9 @@ def _Exscan(self, sendbuf, recvbuf=None, op=op_mod.SUM) -> None:
     self.check_failed()
     if _is_dev(sendbuf):
         return self.coll.exscan_dev(self, sendbuf, op)
+    if recvbuf is None:
+        raise TypeError("Exscan with a host sendbuf requires recvbuf "
+                        "(recvbuf=None is the device-array form)")
     sarr, count, dt = _parse_buf(sendbuf)
     rarr = _parse_buf(recvbuf)[0]
     self.coll.exscan(self, sarr, rarr, count, dt, op)

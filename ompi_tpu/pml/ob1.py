@@ -226,10 +226,12 @@ class Ob1:
             if dtype is None:
                 dtype = dtype_of(buf)
             conv = Convertor(buf, dtype, count)
-            if memchecker.enabled():
+            if memchecker.enabled() and count:
                 # reference: MEMCHECKER annotation on every send entry
                 # (ompi/mpi/c/send.c) — flag sends of undefined bytes,
                 # bounded to the count*extent span actually packed
+                # (zero-count sends read nothing: skipped above, since
+                # nbytes=0 means "whole buffer" to the interval map)
                 memchecker.check_defined(buf, "send",
                                          count * dtype.extent)
         if sync:

@@ -51,8 +51,12 @@ def snapshot() -> Dict:
     inst = pml.instance()
     out: Dict = {"posted": [], "unexpected": [], "pending_sends": [],
                  "communicators": []}
-    # live communicator handles (mpihandles DLL payload)
-    for cid, c in sorted(getattr(comm_mod, "_comms", {}).items()):
+    # live communicator handles (mpihandles DLL payload); copy under
+    # the registry lock — snapshot() may run from a watchdog thread
+    # while the main thread creates/frees communicators
+    with comm_mod._comms_lock:
+        comms = sorted(comm_mod._comms.items())
+    for cid, c in comms:
         if c is None:
             continue
         out["communicators"].append({
