@@ -48,6 +48,17 @@ _default_det = cvar.register(
          "'linear' (exact rank-order fold, bit-identical to coll/basic)",
     choices=["", "ring", "linear"], level=4)
 
+_hier_var = cvar.register(
+    "coll_xla_hier", "auto", str,
+    help="hierarchical ICI x DCN execution for comms spanning slices "
+         "(coll/han's split-level algorithms on device, coll_han.h:"
+         "62-63): 'auto' groups member devices by slice_index when "
+         "comm ranks are slice-contiguous, 'off' always flat, an "
+         "integer N forces N slices (testing on the virtual mesh). "
+         "Deterministic modes always use the flat 1-D schedule — the "
+         "split-level fold order differs from the rank-order "
+         "contract.", level=5)
+
 #: ops whose reduction is expressible as a traced elementwise fold
 _TRACEABLE_OPS = {
     "MPI_SUM", "MPI_PROD", "MPI_MIN", "MPI_MAX", "MPI_LAND", "MPI_LOR",
@@ -80,6 +91,48 @@ class _Ctx:
         self.n = len(devs)
         self.in_sharding = NamedSharding(self.mesh, P(AXIS))
         self.fns = {}  # (kind, shape, dtype, ...) -> compiled callable
+        # hierarchical ICI x DCN mesh (rank-major rows = slices) when
+        # the comm spans slices and ranks are slice-contiguous
+        self.mesh2d = None
+        n_slices = self._detect_slices(devs)
+        if n_slices and 1 < n_slices < self.n:
+            from ompi_tpu.parallel import hierarchical as H
+
+            grid = np.array(devs).reshape(n_slices,
+                                          self.n // n_slices)
+            self.mesh2d = Mesh(grid, (H.DCN_AXIS, H.ICI_AXIS))
+            self.in_sharding2d = NamedSharding(
+                self.mesh2d, P((H.DCN_AXIS, H.ICI_AXIS)))
+
+    @staticmethod
+    def _detect_slices(devs) -> int:
+        """Number of DCN groups (0 = stay flat). 'auto' requires comm
+        rank order to be slice-contiguous with equal-size slices so
+        mesh rows ARE physical slices; anything else degrades to flat
+        (correct, just not hierarchy-optimized)."""
+        mode = _hier_var.get()
+        if mode == "off":
+            return 0
+        if mode != "auto":
+            try:
+                n = int(mode)
+            except ValueError:
+                return 0
+            return n if n > 1 and len(devs) % n == 0 else 0
+        slices = [getattr(d, "slice_index", None) for d in devs]
+        if any(s is None for s in slices):
+            return 0
+        groups = []
+        for s in slices:  # must be contiguous runs of equal length
+            if not groups or groups[-1][0] != s:
+                groups.append([s, 0])
+            groups[-1][1] += 1
+        ids = [g[0] for g in groups]
+        if len(set(ids)) != len(ids):  # a slice appears twice: ranks
+            return 0                   # interleave slices -> flat
+        if len({g[1] for g in groups}) != 1:
+            return 0  # ragged slices cannot form a mesh
+        return len(groups) if len(groups) > 1 else 0
 
     def replica_groups(self):
         """Device-id groups this comm's collectives compile to
@@ -87,13 +140,14 @@ class _Ctx:
         return [[d.id for d in self.mesh.devices.tolist()]]
 
     # -- plumbing ---------------------------------------------------------
-    def to_global(self, x):
+    def to_global(self, x, sharding=None):
         """Local device array -> global array sharded (n, *shape) on
-        AXIS (rank r's contribution at index r)."""
+        the comm axis/axes (rank r's contribution at index r)."""
         jax = self.jax
         x = jax.device_put(x, self.my)
         return jax.make_array_from_single_device_arrays(
-            (self.n,) + x.shape, self.in_sharding, [x[None]])
+            (self.n,) + x.shape, sharding or self.in_sharding,
+            [x[None]])
 
     def my_shard(self, out):
         """This rank's shard of an AXIS-sharded result."""
@@ -105,14 +159,26 @@ class _Ctx:
             fn = self.fns[key] = build()
         return fn
 
-    def smap(self, body, out_varying: bool):
-        """jit(shard_map(body)) over the comm mesh. Body sees the local
-        (1, *shape) block; out_varying selects P(AXIS) vs replicated."""
+    def smap(self, body, out_varying: bool, mesh=None, spec=None):
+        """jit(shard_map(body)) over the comm mesh (or the 2-level
+        ICI x DCN mesh when passed). Body sees the local (1, *shape)
+        block; out_varying selects the sharded vs replicated spec."""
         jax, P = self.jax, self.P
-        out_spec = P(AXIS) if out_varying else P()
+        spec = spec if spec is not None else P(AXIS)
+        out_spec = spec if out_varying else P()
         return jax.jit(jax.shard_map(
-            body, mesh=self.mesh, in_specs=P(AXIS), out_specs=out_spec,
-            check_vma=False))
+            body, mesh=mesh if mesh is not None else self.mesh,
+            in_specs=spec, out_specs=out_spec, check_vma=False))
+
+    def to_global_hier(self, x):
+        return self.to_global(x, self.in_sharding2d)
+
+    def smap_hier(self, body, out_varying: bool):
+        """Mesh rows are slices; row-major device order = comm rank."""
+        from ompi_tpu.parallel import hierarchical as H
+
+        return self.smap(body, out_varying, mesh=self.mesh2d,
+                         spec=self.P((H.DCN_AXIS, H.ICI_AXIS)))
 
 
 def _ctx(comm) -> _Ctx:
@@ -152,13 +218,22 @@ def allreduce_dev(comm, sendbuf, op=op_mod.SUM,
 
     ctx = _ctx(comm)
     opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
+    hier = det is None and ctx.mesh2d is not None
 
     def build():
+        if hier:  # han split-level over ICI x DCN (deterministic
+            # modes stay flat: the split fold order differs from the
+            # rank-order bit-identical contract)
+            from ompi_tpu.parallel import hierarchical as H
+
+            return ctx.smap_hier(lambda a: H.allreduce(a[0], op=opn),
+                                 out_varying=False)
         return ctx.smap(lambda a: C.allreduce(a[0], AXIS, opn, det),
                         out_varying=False)
 
     fn = ctx.compiled(_key(sendbuf, "allreduce", opn.name, det), build)
-    return ctx.my_shard(fn(ctx.to_global(sendbuf)))
+    to_g = ctx.to_global_hier if hier else ctx.to_global
+    return ctx.my_shard(fn(to_g(sendbuf)))
 
 
 def reduce_dev(comm, sendbuf, op=op_mod.SUM, root: int = 0,
@@ -177,12 +252,22 @@ def bcast_dev(comm, buf, root: int = 0):
     if comm.size == 1:
         return buf
     ctx = _ctx(comm)
+    hier = ctx.mesh2d is not None
 
     def build():
+        if hier:
+            from ompi_tpu.parallel import hierarchical as H
+
+            ici = ctx.mesh2d.devices.shape[1]
+            return ctx.smap_hier(
+                lambda a: H.bcast(a[0], root_dcn=root // ici,
+                                  root_ici=root % ici),
+                out_varying=False)
         return ctx.smap(_bcast_body(root), out_varying=False)
 
     fn = ctx.compiled(_key(buf, "bcast", root), build)
-    return ctx.my_shard(fn(ctx.to_global(buf)))
+    to_g = ctx.to_global_hier if hier else ctx.to_global
+    return ctx.my_shard(fn(to_g(buf)))
 
 
 def _bcast_body(root: int):
@@ -224,13 +309,21 @@ def alltoall_dev(comm, sendbuf):
     from ompi_tpu.parallel import collectives as C
 
     ctx = _ctx(comm)
+    hier = ctx.mesh2d is not None
 
     def build():
+        if hier:  # two-phase: every byte crosses DCN exactly once;
+            # output is source-rank-major, the MPI alltoall order
+            from ompi_tpu.parallel import hierarchical as H
+
+            return ctx.smap_hier(lambda a: H.alltoall(a[0]),
+                                 out_varying=True)
         return ctx.smap(lambda a: C.alltoall(a[0], AXIS, 0, 0),
                         out_varying=True)
 
     fn = ctx.compiled(_key(sendbuf, "alltoall"), build)
-    return ctx.my_shard(fn(ctx.to_global(sendbuf)))
+    to_g = ctx.to_global_hier if hier else ctx.to_global
+    return ctx.my_shard(fn(to_g(sendbuf)))
 
 
 def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,

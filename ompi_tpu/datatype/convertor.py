@@ -90,6 +90,14 @@ class Convertor:
     def done(self) -> bool:
         return self.position >= self.packed_size
 
+    @property
+    def is_contig_layout(self) -> bool:
+        """True iff packed bytes == the buffer's own byte layout (the
+        zero-copy precondition). NOTE: ``_spans is None`` alone does
+        NOT mean contiguous — windowed big-count convertors also carry
+        no materialized table while being non-contiguous."""
+        return self._spans is None and not self._windowed
+
     def set_position(self, pos: int) -> None:
         """Reposition (pipelined restart). Restarting from 0 resets the
         running checksum; repositioning mid-stream with checksumming on
@@ -241,11 +249,39 @@ class Convertor:
         _scatter_range(dst, src, self._spans, self._cum, start, end)
 
 
+_SPAN_LOOP_MAX = 64  # below this a python loop beats index building
+
+
+def _range_index(spans: np.ndarray, cum: np.ndarray, start: int,
+                 end: int) -> np.ndarray:
+    """Flat byte-index vector for packed range [start, end) — the
+    vectorized movement the materialized path gets from _gather_index,
+    built for just the touched spans (O(range), not O(layout))."""
+    i0 = int(np.searchsorted(cum, start, side="right")) - 1
+    i1 = int(np.searchsorted(cum, end, side="left"))
+    offs = spans[i0:i1, 0].copy()
+    lens = spans[i0:i1, 1].copy()
+    head = start - int(cum[i0])
+    if head > 0:
+        offs[0] += head
+        lens[0] -= head
+    tail = int(cum[i1]) - end
+    if tail > 0:
+        lens[-1] -= tail
+    n = int(lens.sum())
+    starts = np.concatenate(([0], np.cumsum(lens[:-1])))
+    return (np.repeat(offs, lens)
+            + np.arange(n, dtype=np.int64)
+            - np.repeat(starts, lens))
+
+
 def _gather_range(src: np.ndarray, spans: np.ndarray, cum: np.ndarray,
                   start: int, end: int) -> np.ndarray:
     """Collect packed bytes [start, end) (cum coordinates) from src."""
     i0 = int(np.searchsorted(cum, start, side="right")) - 1
     i1 = int(np.searchsorted(cum, end, side="left"))
+    if i1 - i0 > _SPAN_LOOP_MAX:
+        return src[_range_index(spans, cum, start, end)]
     parts = []
     for i in range(i0, i1):
         off, ln = int(spans[i, 0]), int(spans[i, 1])
@@ -261,6 +297,9 @@ def _scatter_range(dst: np.ndarray, src: np.ndarray, spans: np.ndarray,
     """Place packed bytes [start, end) (cum coordinates) into dst."""
     i0 = int(np.searchsorted(cum, start, side="right")) - 1
     i1 = int(np.searchsorted(cum, end, side="left"))
+    if i1 - i0 > _SPAN_LOOP_MAX:
+        dst[_range_index(spans, cum, start, end)] = src[:end - start]
+        return
     pos = 0
     for i in range(i0, i1):
         off, ln = int(spans[i, 0]), int(spans[i, 1])

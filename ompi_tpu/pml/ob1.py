@@ -293,7 +293,7 @@ class Ob1:
             return None
         conv = req.conv
         flat = conv._flat(False)
-        if conv._spans is None and flat.flags["C_CONTIGUOUS"]:
+        if conv.is_contig_layout and flat.flags["C_CONTIGUOUS"]:
             req.sc_keep = flat
             addr = flat.ctypes.data
         else:
@@ -631,7 +631,7 @@ class Ob1:
         take = min(size, req.conv.packed_size)
         try:
             flat = req.conv._flat(True)
-            if req.conv._spans is None and flat.flags["C_CONTIGUOUS"]:
+            if req.conv.is_contig_layout and flat.flags["C_CONTIGUOUS"]:
                 # contiguous receiver: pull straight into the user
                 # buffer — the actual single copy
                 smsc.read(pid, addr, memoryview(flat)[:take])

@@ -53,9 +53,17 @@ def snapshot() -> Dict:
                  "communicators": []}
     # live communicator handles (mpihandles DLL payload); copy under
     # the registry lock — snapshot() may run from a watchdog thread
-    # while the main thread creates/frees communicators
-    with comm_mod._comms_lock:
-        comms = sorted(comm_mod._comms.items())
+    # while the main thread creates/frees communicators. Non-blocking:
+    # the SIGUSR1 handler runs on the main thread between bytecodes,
+    # and blocking on a lock that same (suspended) thread holds would
+    # deadlock the rank — fall back to a lockless dict copy (atomic
+    # enough under the GIL for a diagnostic).
+    got = comm_mod._comms_lock.acquire(blocking=False)
+    try:
+        comms = sorted(dict(comm_mod._comms).items())
+    finally:
+        if got:
+            comm_mod._comms_lock.release()
     for cid, c in comms:
         if c is None:
             continue

@@ -124,3 +124,35 @@ def test_big_count_checksum_consistent():
         assert a.checksum == b.checksum
     finally:
         cv._SPAN_WINDOW_LIMIT = old
+
+
+def test_windowed_rndv_single_copy_correct():
+    """Regression: a windowed (big-count) convertor has _spans None but
+    is NOT contiguous — the smsc single-copy path must pack, not
+    expose the raw buffer (silent corruption otherwise). Message is
+    rendezvous-sized so the RNDV+cma path actually runs."""
+    from tests import harness
+
+    harness.run_ranks("""
+        import ompi_tpu.datatype.convertor as cv
+        cv._SPAN_WINDOW_LIMIT = 64   # force windowing at test scale
+        from ompi_tpu import datatype as dt
+        vec = dt.vector(8, 4, 7, dt.DOUBLE)   # 8 spans, gaps of 3
+        count = 500                            # 128000 packed bytes
+        n_elems = count * 7 * 8  # buffer covering count extents
+        if rank == 0:
+            buf = np.arange(n_elems, dtype=np.float64)
+            conv = cv.Convertor(buf, vec, count)
+            assert conv._windowed and not conv.is_contig_layout
+            comm.Send((buf, count, vec), 1, tag=5)
+        else:
+            out = np.full(n_elems, -1.0, np.float64)
+            comm.Recv((out, count, vec), 0, tag=5)
+            # oracle: unpack a reference pack into a fresh buffer
+            src = np.arange(n_elems, dtype=np.float64)
+            wire = cv.Convertor(src, vec, count).pack()
+            want = np.full(n_elems, -1.0, np.float64)
+            c = cv.Convertor(want, vec, count)
+            c.unpack(wire)
+            np.testing.assert_array_equal(out, want)
+    """, 2)

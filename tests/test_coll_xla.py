@@ -248,3 +248,53 @@ def test_scan_staging_fallback():
                                   np.full(4, exp_ex, np.float32))
     assert pvar.read("coll_accelerator_staged") >= 2
     """, 3)
+
+
+HIER_MCA = {"device_plane": "on", "coll_xla_hier": "2"}
+
+
+def test_hierarchical_collectives_on_sliced_comm():
+    """coll_xla_hier=2: the comm's devices form a 2-slice ICI x DCN
+    mesh and allreduce/bcast/alltoall run han-style split-level
+    schedules — results must match the flat contract exactly."""
+    run_ranks("""
+    import jax
+    import jax.numpy as jnp
+    from ompi_tpu.coll import xla as coll_xla
+    ctx = None
+    x = jnp.arange(8, dtype=jnp.float32) + rank
+    r = comm.Allreduce(x)
+    ctx = comm._coll_xla_ctx
+    assert ctx.mesh2d is not None, "hier mesh not built"
+    assert ctx.mesh2d.devices.shape == (2, size // 2)
+    exp = size * np.arange(8, dtype=np.float32) + sum(range(size))
+    np.testing.assert_allclose(np.asarray(r), exp, rtol=1e-6)
+    # bcast from a non-zero root (maps to dcn 1 on the 2-slice mesh)
+    b = comm.Bcast(jnp.full(5, float(rank), jnp.float32), root=3)
+    np.testing.assert_array_equal(np.asarray(b), np.full(5, 3.0))
+    # alltoall: source-rank-major output order
+    blk = 2
+    a = jnp.arange(size * blk, dtype=jnp.int32) + 100 * rank
+    out = np.asarray(comm.Alltoall(a))
+    for src in range(size):
+        np.testing.assert_array_equal(
+            out[src * blk:(src + 1) * blk],
+            np.arange(rank * blk, (rank + 1) * blk) + 100 * src)
+    # deterministic mode must stay flat (rank-order fold contract)
+    d = comm.Allreduce(x, deterministic="linear")
+    conts = [np.arange(8, dtype=np.float32) + rr for rr in range(size)]
+    want = conts[0]
+    for c in conts[1:]:
+        want = want + c
+    np.testing.assert_array_equal(np.asarray(d), want)
+    """, 4, mca=HIER_MCA)
+
+
+def test_hier_off_and_indivisible_stay_flat():
+    run_ranks("""
+    import jax.numpy as jnp
+    r = comm.Allreduce(jnp.ones(4, jnp.float32))
+    ctx = comm._coll_xla_ctx
+    assert ctx.mesh2d is None  # 3 ranks don't split into 2 slices
+    np.testing.assert_array_equal(np.asarray(r), np.full(4, 3.0))
+    """, 3, mca=HIER_MCA)
