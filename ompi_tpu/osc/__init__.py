@@ -235,6 +235,10 @@ class Window:
         if ctx:
             ctx.acquire()
         try:
+            if opname == "MPI_NO_OP":
+                return  # MPI-3.1 §11.3.4: no-op reads (Fetch_and_op /
+                # Get_accumulate) must not modify the target — the
+                # generic fold below would write the origin operand
             view = self._target_view(disp, data.size, data.dtype.str)
             if opname == "MPI_REPLACE":
                 view[:] = data.reshape(-1)

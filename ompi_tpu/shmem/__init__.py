@@ -238,6 +238,58 @@ def atomic_compare_swap(dest: SymArray, cond, value, pe: int,
     return result[0]
 
 
+def atomic_swap(dest: SymArray, value, pe: int, index: int = 0):
+    """shmem_atomic_swap: unconditional exchange (REPLACE fetch-op)."""
+    st = _require()
+    result = np.empty(1, dtype=dest.dtype)
+    st.win.Fetch_and_op(np.asarray([value], dtype=dest.dtype), result,
+                        pe, disp=dest.byte_disp(index),
+                        op=op_mod.REPLACE)
+    pvar.record("shmem_atomic")
+    return result[0]
+
+
+def atomic_fetch(src: SymArray, pe: int, index: int = 0):
+    """shmem_atomic_fetch: atomic read (NO_OP fetch-op — ordered with
+    other atomics at the target, unlike a plain g())."""
+    st = _require()
+    result = np.empty(1, dtype=src.dtype)
+    st.win.Fetch_and_op(np.zeros(1, dtype=src.dtype), result, pe,
+                        disp=src.byte_disp(index), op=op_mod.NO_OP)
+    pvar.record("shmem_atomic")
+    return result[0]
+
+
+def atomic_set(dest: SymArray, value, pe: int, index: int = 0) -> None:
+    """shmem_atomic_set: atomic write (REPLACE, result discarded)."""
+    atomic_swap(dest, value, pe, index)
+
+
+# -- distributed locks (shmem_set_lock / test_lock / clear_lock) -----------
+# Reference: oshmem/shmem/c/shmem_lock.c — a symmetric long used as a
+# lock word. Redesign: the lock word lives on PE 0 (every PE spins the
+# same location, the simple-common-case of the reference's MCS-like
+# queue) and acquisition is atomic compare-and-swap 0 -> my_pe+1.
+
+def set_lock(lock: SymArray, index: int = 0) -> None:
+    me = my_pe() + 1
+    while True:
+        prev = atomic_compare_swap(lock, 0, me, 0, index)
+        if prev == 0:
+            return
+        progress.progress()
+
+
+def test_lock(lock: SymArray, index: int = 0) -> bool:
+    """True = lock acquired (returns immediately)."""
+    return atomic_compare_swap(lock, 0, my_pe() + 1, 0, index) == 0
+
+
+def clear_lock(lock: SymArray, index: int = 0) -> None:
+    quiet()  # releases happen-after the critical section's puts
+    atomic_set(lock, 0, 0, index)
+
+
 # -- collectives (scoll/mpi: delegate to the comm's coll table) ------------
 
 def barrier_all() -> None:
@@ -271,6 +323,48 @@ def max_to_all(dest: SymArray, source: SymArray) -> None:
 
 def min_to_all(dest: SymArray, source: SymArray) -> None:
     _to_all(dest, source, op_mod.MIN)
+
+
+def prod_to_all(dest: SymArray, source: SymArray) -> None:
+    _to_all(dest, source, op_mod.PROD)
+
+
+def and_to_all(dest: SymArray, source: SymArray) -> None:
+    _to_all(dest, source, op_mod.BAND)
+
+
+def or_to_all(dest: SymArray, source: SymArray) -> None:
+    _to_all(dest, source, op_mod.BOR)
+
+
+def xor_to_all(dest: SymArray, source: SymArray) -> None:
+    _to_all(dest, source, op_mod.BXOR)
+
+
+def alltoall(dest: SymArray, source: SymArray) -> None:
+    """shmem_alltoall: PE i's block j lands in PE j's block i (equal
+    block sizes; scoll/mpi -> coll alltoall)."""
+    st = _require()
+    n = st.comm.size
+    flat = source.local.reshape(-1)
+    if flat.size % n:
+        raise errors.MPIError(
+            errors.ERR_ARG,
+            f"alltoall: {flat.size} elements not divisible by {n} PEs")
+    st.comm.Alltoall(np.array(flat, copy=True),
+                     dest.local.reshape(-1))
+
+
+def collect(dest: SymArray, source: SymArray, nelems: int) -> None:
+    """shmem_collect: concatenate variable-size contributions in PE
+    order (Allgatherv over the delegated comm)."""
+    st = _require()
+    cbuf = np.zeros(st.comm.size, np.int64)
+    st.comm.Allgather(np.asarray([nelems], np.int64), cbuf)
+    st.comm.Allgatherv(np.array(source.local.reshape(-1)[:nelems],
+                                copy=True),
+                       dest.local.reshape(-1),
+                       [int(c) for c in cbuf])
 
 
 def _to_all(dest: SymArray, source: SymArray, op) -> None:

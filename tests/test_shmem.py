@@ -72,3 +72,86 @@ def test_collectives():
         assert (b.local == 2.0).all(), b.local
         shmem.finalize()
     """, 3, timeout=120)
+
+
+def test_swap_fetch_set_atomics():
+    run_ranks("""
+        from ompi_tpu import shmem
+        shmem.init(heap_size=1 << 16)
+        me, n = shmem.my_pe(), shmem.n_pes()
+        slot = shmem.zeros(1, dtype=np.int64)
+        shmem.barrier_all()
+        if me == 1:
+            shmem.atomic_set(slot, 41, 0)
+            prev = shmem.atomic_swap(slot, 42, 0)
+            assert prev == 41, prev
+            assert shmem.atomic_fetch(slot, 0) == 42
+        shmem.barrier_all()
+        if me == 0:
+            assert slot.local[0] == 42, slot.local
+        shmem.finalize()
+    """, 2, timeout=120)
+
+
+def test_locks_serialize_critical_sections():
+    run_ranks("""
+        from ompi_tpu import shmem
+        shmem.init(heap_size=1 << 16)
+        me, n = shmem.my_pe(), shmem.n_pes()
+        lock = shmem.zeros(1, dtype=np.int64)
+        total = shmem.zeros(1, dtype=np.int64)
+        shmem.barrier_all()
+        for _ in range(5):
+            shmem.set_lock(lock)
+            # read-modify-write under the lock (racy without it)
+            cur = shmem.g(total, 0)
+            shmem.p(total, cur + 1, 0)
+            shmem.quiet()
+            shmem.clear_lock(lock)
+        shmem.barrier_all()
+        if me == 0:
+            assert total.local[0] == 5 * n, total.local
+        # test_lock on a held lock reports failure
+        shmem.set_lock(lock)
+        assert not shmem.test_lock(lock) or n == 1
+        shmem.clear_lock(lock)
+        shmem.finalize()
+    """, 3, timeout=180)
+
+
+def test_alltoall_collect_and_reductions():
+    run_ranks("""
+        from ompi_tpu import shmem
+        shmem.init(heap_size=1 << 18)
+        me, n = shmem.my_pe(), shmem.n_pes()
+        src = shmem.zeros(n * 2, dtype=np.int64)
+        dst = shmem.zeros(n * 2, dtype=np.int64)
+        src.local[:] = np.arange(n * 2) + 100 * me
+        shmem.barrier_all()
+        shmem.alltoall(dst, src)
+        for j in range(n):
+            want = np.arange(me * 2, me * 2 + 2) + 100 * j
+            assert (dst.local[j * 2:(j + 1) * 2] == want).all(), dst.local
+        # variable collect: PE i contributes i+1 elements
+        csrc = shmem.zeros(n, dtype=np.int64)
+        csrc.local[:me + 1] = me
+        cdst = shmem.zeros(n * (n + 1) // 2, dtype=np.int64)
+        shmem.barrier_all()
+        shmem.collect(cdst, csrc, me + 1)
+        off = 0
+        for j in range(n):
+            assert (cdst.local[off:off + j + 1] == j).all(), cdst.local
+            off += j + 1
+        # bit reductions
+        b = shmem.zeros(1, dtype=np.int64)
+        o = shmem.zeros(1, dtype=np.int64)
+        b.local[0] = 1 << me
+        shmem.or_to_all(o, b)
+        assert o.local[0] == (1 << n) - 1, o.local
+        p = shmem.zeros(1, dtype=np.int64)
+        b.local[0] = me + 2
+        shmem.prod_to_all(p, b)
+        import math
+        assert p.local[0] == math.prod(range(2, n + 2)), p.local
+        shmem.finalize()
+    """, 3, timeout=180)
