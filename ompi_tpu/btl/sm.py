@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import mmap
 import os
-import socket
 import struct
 from typing import Dict, Optional
 
@@ -168,7 +167,7 @@ class SmBtl(base.Btl):
         rte.init()
         if rte.size == 1:
             return False  # nothing intra-host to do; self btl covers it
-        rte.modex_send("btl_sm_host", socket.gethostname())
+        rte.modex_send("btl_sm_host", rte.hostname())
         self._dir = os.environ.get("OMPI_TPU_SHM_DIR", "/dev/shm")
         if not os.path.isdir(self._dir):
             return False
@@ -177,7 +176,7 @@ class SmBtl(base.Btl):
         # removes any attach-vs-unlink race at teardown).
         same_host = [p for p in rte.world_ranks() if p != rte.rank
                      and rte.modex_recv("btl_sm_host", p)
-                     == socket.gethostname()]
+                     == rte.hostname()]
         for p in same_host:
             self._out[p] = _Ring(self._path(rte.rank, p),
                                  self.ring_size, create=True)

@@ -11,6 +11,7 @@ progress engine polls via selectors (the libevent equivalent).
 from __future__ import annotations
 
 import errno
+import os
 import selectors
 import socket
 import struct
@@ -28,7 +29,16 @@ _out = output.stream("btl_tcp")
 def _routable_addr() -> str:
     """Best routable local address (reference: btl/tcp publishes per-NIC
     addresses via the modex and scores reachability). UDP-connect trick
-    needs no traffic; loopback fallback keeps single-host jobs working."""
+    needs no traffic; loopback fallback keeps single-host jobs working.
+
+    A launcher-daemon-assigned per-host address (OMPI_TPU_BIND_ADDR)
+    wins outright: multi-host jobs publish the address the daemon
+    selected for this node, and fake-multi-host tests pin distinct
+    loopback addresses (127.0.0.2/...) so inter-"node" traffic
+    demonstrably rides this btl."""
+    bind = os.environ.get("OMPI_TPU_BIND_ADDR")
+    if bind:
+        return bind
     try:
         probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         try:
@@ -57,7 +67,8 @@ class TcpBtl(base.Btl):
     def open(self) -> bool:
         self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listen.bind(("0.0.0.0", 0))
+        self._listen.bind((os.environ.get("OMPI_TPU_BIND_ADDR", "0.0.0.0"),
+                           0))
         self._listen.listen(128)
         self._listen.setblocking(False)
         self._sel.register(self._listen, selectors.EVENT_READ, "accept")

@@ -21,7 +21,6 @@ way).
 from __future__ import annotations
 
 import hashlib
-import socket
 
 import numpy as np
 
@@ -47,7 +46,9 @@ def _node_color(comm) -> int:
         # contiguous blocks of ranks pretend to share a node
         per = -(-comm.size // k)
         return comm.rank // per
-    host = socket.gethostname()
+    from ompi_tpu.runtime import rte
+
+    host = rte.hostname()
     return int.from_bytes(
         hashlib.sha1(host.encode()).digest()[:4], "little") & 0x7FFFFFFF
 

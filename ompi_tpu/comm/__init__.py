@@ -184,9 +184,10 @@ class Communicator:
         """MPI_Comm_split_type(MPI_COMM_TYPE_SHARED): all our ranks are
         reachable by shared memory within a host; color by hostname."""
         import hashlib
-        import socket as _s
 
-        host = _s.gethostname()
+        from ompi_tpu.runtime import rte
+
+        host = rte.hostname()
         # stable digest: Python's hash() is salted per process
         color = int.from_bytes(
             hashlib.sha1(host.encode()).digest()[:4], "little") \

@@ -829,9 +829,9 @@ def Is_initialized() -> bool:
 
 
 def Get_processor_name() -> str:
-    import socket
+    from ompi_tpu.runtime import rte
 
-    return socket.gethostname()
+    return rte.hostname()
 
 
 def Wtime() -> float:

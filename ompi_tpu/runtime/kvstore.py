@@ -214,6 +214,12 @@ class Store:
             return self._ftgather(tag, rank, value, ranks, hb_timeout)
         return ("err", f"unknown op {op!r}")
 
+    def counter_value(self, key: str) -> int:
+        """In-process read of an atomic counter (head-side aggregation,
+        e.g. the launcher's job-wide FT clean-exit tally)."""
+        with self._cond:
+            return self._counters.get(key, 0)
+
     def seed_counter(self, key: str, value: int) -> None:
         """Pre-claim counter space (the launcher seeds the spawn
         world-rank watermark with the initial world size, so

@@ -1,13 +1,29 @@
 """tpurun — the mpirun equivalent.
 
 Reference: ompi/tools/mpirun/main.c:32-180 is a thin argv translator that
-execs prterun; PRRTE daemons fork/exec the ranks. Here the launcher itself
-plays the daemon: it serves the rendezvous store in-process and forks N rank
-processes with the environment contract from ompi_tpu.runtime.rte.
+execs prterun; PRRTE daemons fork/exec the ranks per host. Here:
+
+* single-host (default): the launcher itself plays the daemon — it
+  serves the rendezvous store in-process and forks N rank processes
+  with the environment contract from ompi_tpu.runtime.rte.
+* multi-host (``--host``/``--hostfile``): the launcher starts one
+  *daemon* per host (the prted analog: ``launcher --daemon``) through a
+  launch agent (``ssh`` for real remote hosts; ``local`` forks the
+  daemon on this machine — the fake-multi-host test lane, where each
+  "host" gets its own hostname + loopback address). Each daemon
+  connects back to the store, forks its local rank block with correct
+  LOCAL_RANK/LOCAL_SIZE/hostname, and supervises it (waitpid
+  authoritative failure notices, as PRRTE daemons do for ULFM).
 
 Usage:
     python -m ompi_tpu.runtime.launcher -n 4 [--mca KEY VALUE]... prog.py ...
     python -m ompi_tpu.runtime.launcher -n 4 --func pkg.mod:fn   # run fn()
+    python -m ompi_tpu.runtime.launcher --host a:2,b:2 prog.py   # 2x2 ranks
+    python -m ompi_tpu.runtime.launcher --hostfile hosts prog.py
+
+Host specs: ``name[:slots[:addr]]`` — addr is the IP the host's btl/tcp
+binds and publishes (daemons export it as OMPI_TPU_BIND_ADDR).
+Hostfile lines: ``name [slots=K] [addr=IP]`` (# comments).
 
 Exit code: 0 if every rank exits 0; otherwise the first nonzero rank code.
 On a rank crash the remaining ranks are terminated (mpirun behavior).
@@ -22,21 +38,69 @@ import subprocess
 import sys
 import time
 import uuid
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 from ompi_tpu.runtime import kvstore
 
 
+class HostSpec(NamedTuple):
+    name: str
+    slots: int = 1
+    addr: Optional[str] = None  # btl/tcp bind+publish address
+
+
+def parse_host_list(spec: str) -> List[HostSpec]:
+    """``h1:2,h2:2:127.0.0.3`` -> [HostSpec...]."""
+    hosts = []
+    for part in spec.split(","):
+        if not part:
+            continue
+        bits = part.split(":")
+        hosts.append(HostSpec(bits[0],
+                              int(bits[1]) if len(bits) > 1 else 1,
+                              bits[2] if len(bits) > 2 else None))
+    return hosts
+
+
+def parse_hostfile(path: str) -> List[HostSpec]:
+    """mpirun-hostfile analog: ``name [slots=K] [addr=IP]`` per line."""
+    hosts = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            fields = line.split()
+            slots, addr = 1, None
+            for f in fields[1:]:
+                if f.startswith("slots="):
+                    slots = int(f[6:])
+                elif f.startswith("addr="):
+                    addr = f[5:]
+            hosts.append(HostSpec(fields[0], slots, addr))
+    return hosts
+
+
 def build_env(rank: int, size: int, store_addr, jobid: str,
               mca: Optional[Dict[str, str]] = None,
-              base_env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+              base_env: Optional[Dict[str, str]] = None,
+              local_rank: Optional[int] = None,
+              local_size: Optional[int] = None,
+              hostname: Optional[str] = None,
+              bind_addr: Optional[str] = None) -> Dict[str, str]:
     env = dict(base_env if base_env is not None else os.environ)
     env["OMPI_TPU_RANK"] = str(rank)
     env["OMPI_TPU_SIZE"] = str(size)
-    env["OMPI_TPU_LOCAL_RANK"] = str(rank)
-    env["OMPI_TPU_LOCAL_SIZE"] = str(size)
+    env["OMPI_TPU_LOCAL_RANK"] = str(
+        rank if local_rank is None else local_rank)
+    env["OMPI_TPU_LOCAL_SIZE"] = str(
+        size if local_size is None else local_size)
     env["OMPI_TPU_JOBID"] = jobid
     env["OMPI_TPU_STORE_ADDR"] = f"{store_addr[0]}:{store_addr[1]}"
+    if hostname:
+        env["OMPI_TPU_HOSTNAME"] = hostname
+    if bind_addr:
+        env["OMPI_TPU_BIND_ADDR"] = bind_addr
     for k, v in (mca or {}).items():
         env[f"OMPI_TPU_{k.upper()}"] = v
     # Rank processes must not grab the real TPU: the device plane is the
@@ -86,6 +150,122 @@ def launch(argv: Sequence[str], nprocs: int,
         store.stop()
 
 
+def _head_addr(agent: str, bind: Optional[str]) -> str:
+    """Address the store binds and daemons dial back to. Local agent
+    (fake hosts on this machine): loopback. ssh agent: the best
+    routable address per util.net's reachability scoring."""
+    if bind:
+        return bind
+    if agent == "local":
+        return "127.0.0.1"
+    from ompi_tpu.util import net
+
+    return net.best_address()
+
+
+def launch_hosts(argv: Sequence[str], hosts: Sequence[HostSpec],
+                 mca: Optional[Dict[str, str]] = None,
+                 timeout: Optional[float] = None,
+                 agent: str = "local",
+                 bind: Optional[str] = None) -> int:
+    """Multi-host launch: one daemon per host (prted analog), each
+    forking its local rank block. Reference: prterun starting prted
+    daemons which fork/exec the ranks per node (SURVEY §3.2);
+    btl/tcp endpoints then cross hosts via the modex
+    (opal/mca/btl/tcp/btl_tcp_component.c:1191-1240)."""
+    store = kvstore.Store(host=_head_addr(agent, bind)).start()
+    jobid = uuid.uuid4().hex[:12]
+    total = sum(h.slots for h in hosts)
+    store.seed_counter(f"ww:{jobid}", total)
+    store_addr = f"{store.addr[0]}:{store.addr[1]}"
+    daemons: List[subprocess.Popen] = []
+    try:
+        base = 0
+        for h in hosts:
+            cmd = [sys.executable, "-m", "ompi_tpu.runtime.launcher",
+                   "--daemon", "--store", store_addr, "--jobid", jobid,
+                   "--host-name", h.name, "--rank-base", str(base),
+                   "--local-n", str(h.slots), "--world-size", str(total)]
+            if h.addr:
+                cmd += ["--bind-addr", h.addr]
+            if timeout is not None:
+                cmd += ["--timeout", str(timeout)]
+            for k, v in (mca or {}).items():
+                cmd += ["--mca", k, v]
+            cmd += ["--"] + list(argv)
+            if agent == "ssh":
+                import shlex
+
+                pkg_root = os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))))
+                remote = "cd {} && env PYTHONPATH={} {}".format(
+                    shlex.quote(os.getcwd()), shlex.quote(pkg_root),
+                    " ".join(shlex.quote(c) for c in cmd))
+                full = ["ssh", "-o", "BatchMode=yes", h.name, remote]
+                daemons.append(subprocess.Popen(full))
+            else:
+                daemons.append(subprocess.Popen(cmd))
+            base += h.slots
+        # daemons supervise their ranks; the head aggregates daemons.
+        # +30s grace over the per-daemon timeout so daemons time out
+        # first and report 124 themselves.
+        rc = _wait_all(daemons, None if timeout is None
+                       else timeout + 30)
+        ft = (mca or {}).get("ft", "0") not in ("0", "false", "")
+        if rc == 0 and ft:
+            # job-level "did anything survive" check: per-daemon it
+            # would wrongly fail a host whose every rank was faulted
+            # while survivors ran elsewhere (ULFM tolerates that).
+            # Daemons publish their clean-exit counts; zero across the
+            # whole job means nothing survived the injected faults.
+            if store.counter_value(f"ftclean:{jobid}") == 0:
+                return 137
+        return rc
+    finally:
+        reap(daemons)
+        store.stop()
+
+
+def run_daemon(ns) -> int:
+    """The prted analog: fork and supervise this host's rank block."""
+    # head-initiated teardown (peer-host failure or timeout) arrives as
+    # SIGTERM; convert it to SystemExit so the finally-reap below kills
+    # this host's ranks instead of orphaning them (prted kills its
+    # local procs on daemon exit)
+    signal.signal(signal.SIGTERM, lambda s, f: sys.exit(143))
+    host, _, port = ns.store.partition(":")
+    store_addr = (host, int(port))
+    mca = {k: v for k, v in ns.mca}
+    ft = mca.get("ft", "0") not in ("0", "false", "")
+    client = kvstore.Client(store_addr) if ft else None
+    argv = list(ns.command)
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    if argv and argv[0].endswith(".py"):
+        # wrapped HERE with the daemon's own interpreter, never the
+        # head's (whose sys.executable may not exist on this host)
+        argv = [sys.executable] + argv
+    procs: List[subprocess.Popen] = []
+    try:
+        for i in range(ns.local_n):
+            env = build_env(ns.rank_base + i, ns.world_size, store_addr,
+                            ns.jobid, mca, local_rank=i,
+                            local_size=ns.local_n,
+                            hostname=ns.host_name,
+                            bind_addr=ns.bind_addr)
+            procs.append(subprocess.Popen(argv, env=env))
+        rc, clean = _wait_stats(procs, ns.timeout, store=client,
+                                rank_base=ns.rank_base,
+                                all_killed_fails=False)
+        if client is not None:
+            client.inc(f"ftclean:{ns.jobid}", clean)
+        return rc
+    finally:
+        reap(procs)
+        if client is not None:
+            client.close()
+
+
 def reap(procs: Sequence[subprocess.Popen],
          grace: float = 5.0) -> None:
     """Terminate stragglers, then kill after a grace period (shared by
@@ -102,9 +282,23 @@ def reap(procs: Sequence[subprocess.Popen],
 
 def _wait_all(procs: List[subprocess.Popen],
               timeout: Optional[float],
-              store: Optional[kvstore.Store] = None) -> int:
-    """store != None enables FT mode: signal deaths are declared to the
-    store instead of tearing the job down."""
+              store=None, rank_base: int = 0) -> int:
+    rc, _ = _wait_stats(procs, timeout, store, rank_base)
+    return rc
+
+
+def _wait_stats(procs: List[subprocess.Popen],
+                timeout: Optional[float],
+                store=None, rank_base: int = 0,
+                all_killed_fails: bool = True):
+    """Returns (rc, clean_exits). store != None enables FT mode: signal
+    deaths are declared to the store instead of tearing the job down
+    (store is a kvstore.Store in-process or a kvstore.Client from a
+    daemon; rank_base maps local proc index -> world rank).
+    all_killed_fails: the single-host "nothing survived the faults"
+    check; daemons pass False — the head aggregates clean-exit counts
+    job-wide, so one fully-faulted host must not fail survivors
+    elsewhere."""
     deadline = None if timeout is None else time.monotonic() + timeout
     pending = set(range(len(procs)))
     first_bad = 0
@@ -121,7 +315,8 @@ def _wait_all(procs: List[subprocess.Popen],
                 if rc == 0:
                     clean_exits += 1
                 if killed and store is not None:
-                    store.mark_dead(i, f"killed by signal {rc - 128}")
+                    store.mark_dead(rank_base + i,
+                                    f"killed by signal {rc - 128}")
                     last_killed_rc = rc
                     continue  # ULFM: survivors keep running
                 if rc != 0 and first_bad == 0:
@@ -130,7 +325,7 @@ def _wait_all(procs: List[subprocess.Popen],
                         from ompi_tpu.util import show_help
 
                         show_help.show(
-                            "launcher", "rank-died", rank=i,
+                            "launcher", "rank-died", rank=rank_base + i,
                             cause=f"signal {rc - 128}")
                     # a rank died abnormally: bring the job down (mpirun
                     # kills remaining ranks on abnormal termination)
@@ -142,12 +337,13 @@ def _wait_all(procs: List[subprocess.Popen],
             if deadline is not None and time.monotonic() > deadline:
                 for j in pending:
                     procs[j].kill()
-                return 124
-    if first_bad == 0 and clean_exits == 0 and last_killed_rc:
+                return 124, clean_exits
+    if (all_killed_fails and first_bad == 0 and clean_exits == 0
+            and last_killed_rc):
         # FT mode with every rank killed: the job did not survive
         # anything — that is a failure, not a tolerated fault
-        return last_killed_rc
-    return first_bad
+        return last_killed_rc, clean_exits
+    return first_bad, clean_exits
 
 
 def main(args: Optional[Sequence[str]] = None) -> int:
@@ -158,8 +354,34 @@ def main(args: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--timeout", type=float, default=None)
     ap.add_argument("--func", default=None,
                     help="run a python function 'pkg.mod:fn' per rank")
+    ap.add_argument("--host", default=None,
+                    help="host list 'name[:slots[:addr]],...'")
+    ap.add_argument("--hostfile", default=None,
+                    help="hostfile: 'name [slots=K] [addr=IP]' lines")
+    ap.add_argument("--launch-agent", default="ssh",
+                    choices=["ssh", "local"],
+                    help="how daemons are started on hosts ('local' "
+                         "forks them on this machine — test lane)")
+    ap.add_argument("--bind", default=None,
+                    help="address the rendezvous store binds")
+    # daemon (prted-analog) flags — internal, set by launch_hosts
+    ap.add_argument("--daemon", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--store", help=argparse.SUPPRESS)
+    ap.add_argument("--jobid", help=argparse.SUPPRESS)
+    ap.add_argument("--host-name", help=argparse.SUPPRESS)
+    ap.add_argument("--rank-base", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--local-n", type=int, default=1,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--world-size", type=int, default=1,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--bind-addr", default=None, help=argparse.SUPPRESS)
     ap.add_argument("command", nargs=argparse.REMAINDER)
     ns = ap.parse_args(args)
+
+    if ns.daemon:
+        return run_daemon(ns)
 
     mca = {k: v for k, v in ns.mca}
     if ns.func:
@@ -177,8 +399,20 @@ def main(args: Optional[Sequence[str]] = None) -> int:
         if cmd and cmd[0] == "--":
             cmd = cmd[1:]
         # mpirun execs the program; for ergonomics a *.py argument runs
-        # under the current interpreter
-        argv = [sys.executable] + cmd if cmd[0].endswith(".py") else cmd
+        # under the current interpreter. Multi-host keeps the bare
+        # command: each DAEMON wraps .py with its own local
+        # interpreter (the head's sys.executable path may not exist on
+        # remote hosts).
+        if ns.host or ns.hostfile:
+            argv = cmd
+        else:
+            argv = ([sys.executable] + cmd if cmd[0].endswith(".py")
+                    else cmd)
+    if ns.host or ns.hostfile:
+        hosts = (parse_hostfile(ns.hostfile) if ns.hostfile
+                 else parse_host_list(ns.host))
+        return launch_hosts(argv, hosts, mca, ns.timeout,
+                            agent=ns.launch_agent, bind=ns.bind)
     return launch(argv, ns.nprocs, mca, ns.timeout)
 
 

@@ -38,6 +38,21 @@ def is_launched() -> bool:
     return "OMPI_TPU_STORE_ADDR" in os.environ
 
 
+def hostname() -> str:
+    """This rank's node name — the single source of node identity for
+    every locality decision (btl/sm qualification, coll/han split,
+    MPI_Comm_split_type, MPI_Get_processor_name).
+
+    The launcher daemon sets OMPI_TPU_HOSTNAME per host so that
+    multi-host jobs (and fake-multi-host tests on one machine —
+    reference: oversubscribed localhost standing in for a cluster,
+    SURVEY §4) agree on who shares a node.
+    """
+    import socket
+
+    return os.environ.get("OMPI_TPU_HOSTNAME") or socket.gethostname()
+
+
 def init() -> None:
     """Connect to the store (or start a singleton one)."""
     global _client, _local_store, rank, size, jobid, local_rank, local_size

@@ -31,9 +31,7 @@ mpi.Finalize()
 """
 
 
-def run_ranks(body: str, n: int, mca: Optional[Dict[str, str]] = None,
-              timeout: float = 120, prelude: bool = True) -> None:
-    """Run `body` (indented python) in n ranks; assert all exit 0."""
+def _run_script(launch_fn, body: str, prelude: bool) -> None:
     src = (_PRELUDE if prelude else "") + textwrap.dedent(body) \
         + (_EPILOGUE if prelude else "")
     with tempfile.NamedTemporaryFile(
@@ -41,8 +39,26 @@ def run_ranks(body: str, n: int, mca: Optional[Dict[str, str]] = None,
         fh.write(src)
         path = fh.name
     try:
-        rc = launcher.launch([sys.executable, path], n, mca=mca,
-                             timeout=timeout)
+        rc = launch_fn([sys.executable, path])
         assert rc == 0, f"ranks exited with {rc}\n--- script ---\n{src}"
     finally:
         os.unlink(path)
+
+
+def run_ranks(body: str, n: int, mca: Optional[Dict[str, str]] = None,
+              timeout: float = 120, prelude: bool = True) -> None:
+    """Run `body` (indented python) in n ranks; assert all exit 0."""
+    _run_script(
+        lambda argv: launcher.launch(argv, n, mca=mca, timeout=timeout),
+        body, prelude)
+
+
+def run_hosts(body: str, hosts, mca: Optional[Dict[str, str]] = None,
+              timeout: float = 180, prelude: bool = True) -> None:
+    """Run `body` across launcher.HostSpec's via local daemons (the
+    fake-multi-host lane: per-host hostnames + loopback addresses)."""
+    _run_script(
+        lambda argv: launcher.launch_hosts(argv, hosts, mca=mca,
+                                           timeout=timeout,
+                                           agent="local"),
+        body, prelude)
