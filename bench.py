@@ -114,7 +114,35 @@ def _bench_staging():
     for x in xs:
         a.to_host(x)
     d2h = 3 * nbytes / (time.perf_counter() - t0) / 1e9
-    return d2h, h2d
+    # CONTROL (r2 VERDICT weak #3): raw jax.device_get with no
+    # framework in the path — proves the component adds no overhead
+    # over the platform's D2H bound
+    raw = [mk(float(i + 10)) for i in range(3)]
+    jax.block_until_ready(raw)
+    t0 = time.perf_counter()
+    for x in raw:
+        np.asarray(jax.device_get(x))
+    d2h_raw = 3 * nbytes / (time.perf_counter() - t0) / 1e9
+    # MITIGATION attempt: chunked concurrent readback via
+    # copy_to_host_async on device-side slices (the mirror of the
+    # chunked-put H2D win). If the platform serializes reads
+    # device-side this matches d2h; if not, it beats it.
+    try:
+        ys = [mk(float(i + 20)) for i in range(3)]
+        jax.block_until_ready(ys)
+        t0 = time.perf_counter()
+        for y in ys:
+            parts = [y[i * (n // 8):(i + 1) * (n // 8)]
+                     for i in range(8)]
+            jax.block_until_ready(parts)
+            for p in parts:
+                p.copy_to_host_async()
+            for p in parts:
+                np.asarray(p)
+        d2h_chunked = 3 * nbytes / (time.perf_counter() - t0) / 1e9
+    except Exception:
+        d2h_chunked = None
+    return d2h, h2d, d2h_raw, d2h_chunked
 
 
 def main() -> None:
@@ -123,9 +151,9 @@ def main() -> None:
     # (loss), and the first D2H degrades this platform's uplink (see
     # _bench_staging) — h2d must be measured before any read
     try:
-        d2h, h2d = _bench_staging()
+        d2h, h2d, d2h_raw, d2h_chunked = _bench_staging()
     except Exception:
-        d2h = h2d = None
+        d2h = h2d = d2h_raw = d2h_chunked = None
     tokens_per_s, tflops, loss = _bench_train_step()
 
     import jax
@@ -162,6 +190,10 @@ def main() -> None:
                 100.0 * tflops / peak, 1),
             "final_loss": round(loss, 4),
             "staging_d2h_GBs": None if d2h is None else round(d2h, 2),
+            "staging_d2h_raw_GBs":
+                None if d2h_raw is None else round(d2h_raw, 2),
+            "staging_d2h_chunked_GBs":
+                None if d2h_chunked is None else round(d2h_chunked, 2),
             "staging_h2d_GBs": None if h2d is None else round(h2d, 2),
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),

@@ -150,6 +150,38 @@ def _rank_main() -> None:
         results["p2p_rndv_8MB_pingpong"] = {
             "s_per_op": t, "GBs": 2 * nbytes / t / 1e9}
 
+    # -- device-buffer p2p: pipelined vs monolithic staging ---------------
+    # (pml/accel_p2p: D2H of chunk k+1 overlaps the send of chunk k;
+    # the monolithic control sets one chunk = whole message, i.e. the
+    # pre-round-3 stage-then-send order with zero overlap)
+    if size >= 2 and dev_ok:
+        import jax
+        import jax.numpy as jnp
+
+        from ompi_tpu.core import cvar as _cvar
+        from ompi_tpu.pml import accel_p2p  # noqa: F401 — registers cvar
+
+        dn = 4 << 20  # 4 MB of f32
+        dx = jnp.ones(dn // 4, jnp.float32)
+        jax.block_until_ready(dx)
+        chunk_var = _cvar.lookup("pml_accel_chunk_bytes")
+
+        def dev_pingpong():
+            if rank == 0:
+                comm.Send(dx, dest=1, tag=11)
+                comm.Recv(dx, source=1, tag=11)
+            elif rank == 1:
+                got = comm.Recv(dx, source=0, tag=11)
+                comm.Send(got, dest=0, tag=11)
+            comm.Barrier()
+
+        for label, chunk in (("pipelined", 1 << 20),
+                             ("monolithic", 1 << 30)):
+            chunk_var.set(chunk)
+            t = _timed(comm, dev_pingpong, 3)
+            results[f"p2p_device_4MB_{label}"] = {
+                "s_per_op": t, "GBs": 2 * dn / t / 1e9}
+
     if rank == 0:
         from ompi_tpu.core import cvar
 

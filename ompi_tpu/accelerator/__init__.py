@@ -16,6 +16,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
+
 from ompi_tpu.core import registry
 
 framework = registry.framework("accelerator")
@@ -51,6 +53,9 @@ class Accelerator(registry.Component):
     def check_addr(self, buf) -> bool:
         """True if buf is device-resident (reference: check_addr)."""
         return False
+
+    # module-level helper lives below (is_device_buffer) so every
+    # device-dispatch layer shares ONE predicate
 
     def to_host(self, buf):
         """Device -> host numpy copy (memcpy DtoH)."""
@@ -203,3 +208,15 @@ def reset_for_testing() -> None:
     global _current
     _current = None
     framework.close_components()
+
+
+def is_device_buffer(buf) -> bool:
+    """THE device-buffer predicate every dispatch layer shares
+    (reference: accelerator check_addr on each API entry,
+    coll_accelerator_allreduce.c check_buf). Cheap host-type
+    early-outs keep the hot host path free of accelerator calls."""
+    if buf is None or isinstance(
+            buf, (np.ndarray, bytes, bytearray, memoryview, tuple,
+                  str)):
+        return False
+    return current().check_addr(buf)

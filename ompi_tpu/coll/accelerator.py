@@ -108,7 +108,81 @@ def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
     return _stage_out(recv, sendbuf)
 
 
-def scatter_dev(comm, sendbuf, root=0):
+def barrier_dev(comm):
+    """No device payload to stage: the host barrier IS the semantics."""
+    pvar.record("coll_accelerator_staged")
+    comm.coll.barrier(comm)
+
+
+def allgatherv_dev(comm, sendbuf, counts):
+    pvar.record("coll_accelerator_staged")
+    host = _stage_in(sendbuf)
+    total = int(sum(counts))
+    recv = np.empty((total,) + host.shape[1:], host.dtype)
+    displs = np.concatenate(
+        [[0], np.cumsum(np.asarray(counts[:-1]))]).tolist()
+    row = int(np.prod(host.shape[1:], dtype=np.int64)) or 1
+    comm.coll.allgatherv(comm, host.reshape(-1),
+                         recv.reshape(-1),
+                         [int(c) * row for c in counts],
+                         [int(d) * row for d in displs], None)
+    return _stage_out(recv, sendbuf)
+
+
+def gatherv_dev(comm, sendbuf, counts, root=0):
+    pvar.record("coll_accelerator_staged")
+    host = _stage_in(sendbuf)
+    row = int(np.prod(host.shape[1:], dtype=np.int64)) or 1
+    recv = (np.empty((int(sum(counts)),) + host.shape[1:], host.dtype)
+            if comm.rank == root else None)
+    displs = np.concatenate(
+        [[0], np.cumsum(np.asarray(counts[:-1]))]).tolist()
+    comm.coll.gatherv(comm, host.reshape(-1),
+                      None if recv is None else recv.reshape(-1),
+                      [int(c) * row for c in counts],
+                      [int(d) * row for d in displs], None, root)
+    if comm.rank != root:
+        return None
+    return _stage_out(recv, sendbuf)
+
+
+def alltoallv_dev(comm, sendbuf, scounts, rcounts, max_count=None):
+    pvar.record("coll_accelerator_staged")
+    host = _stage_in(sendbuf)
+    rest = host.shape[1:]
+    row = int(np.prod(rest, dtype=np.int64)) or 1
+    recv = np.empty((int(sum(rcounts)),) + rest, host.dtype)
+    sdispls = np.concatenate(
+        [[0], np.cumsum(np.asarray(scounts[:-1]))]).tolist()
+    rdispls = np.concatenate(
+        [[0], np.cumsum(np.asarray(rcounts[:-1]))]).tolist()
+    comm.coll.alltoallv(comm, host.reshape(-1), recv.reshape(-1),
+                        [int(c) * row for c in scounts],
+                        [int(d) * row for d in sdispls],
+                        [int(c) * row for c in rcounts],
+                        [int(d) * row for d in rdispls], None)
+    return _stage_out(recv, sendbuf)
+
+
+def scatterv_dev(comm, sendbuf, counts, root=0, like=None):
+    """Same obj-channel design as scatter_dev: ragged chunks ride the
+    object channel with their shapes, no metadata round."""
+    pvar.record("coll_accelerator_staged")
+    if comm.rank == root:
+        host = _stage_in(sendbuf)
+        chunks = []
+        off = 0
+        for c in counts:
+            chunks.append(host[off:off + int(c)])
+            off += int(c)
+    else:
+        chunks = None
+    chunk = comm.coll.scatter_obj(comm, chunks, root)
+    return _stage_out(np.asarray(chunk),
+                      sendbuf if comm.rank == root else like)
+
+
+def scatter_dev(comm, sendbuf, root=0, like=None):
     """One obj-channel collective (exactly one tag consumed on every
     rank) so the chunk shape/dtype ride along with the data — no
     separate metadata round that could desynchronize tag sequences."""
@@ -160,6 +234,26 @@ def exscan_dev(comm, sendbuf, op=op_mod.SUM, deterministic=None):
     return _stage_out(recv, sendbuf)
 
 
+def _istaged(fn):
+    """Staged i-variant: the host collective runs synchronously (the
+    staging path has no async substrate), then the result is wrapped in
+    the same request type the device path returns — honest completion,
+    uniform caller contract."""
+    def islot(*args, **kwargs):
+        from ompi_tpu.coll.xla import DeviceRequest
+
+        return DeviceRequest(fn(*args, **kwargs))
+    islot.__name__ = "i" + fn.__name__
+    return islot
+
+
+def ibarrier_dev(comm):
+    from ompi_tpu.coll.xla import DeviceRequest
+
+    barrier_dev(comm)
+    return DeviceRequest(None)
+
+
 @framework.register
 class CollAccelerator(CollModule):
     NAME = "accelerator"
@@ -180,4 +274,25 @@ class CollAccelerator(CollModule):
             "gather_dev": gather_dev,
             "scan_dev": scan_dev,
             "exscan_dev": exscan_dev,
+            "barrier_dev": barrier_dev,
+            "allgatherv_dev": allgatherv_dev,
+            "gatherv_dev": gatherv_dev,
+            "alltoallv_dev": alltoallv_dev,
+            "scatterv_dev": scatterv_dev,
+            "ibarrier_dev": ibarrier_dev,
+            "iallreduce_dev": _istaged(allreduce_dev),
+            "ibcast_dev": _istaged(bcast_dev),
+            "ireduce_dev": _istaged(reduce_dev),
+            "iallgather_dev": _istaged(allgather_dev),
+            "igather_dev": _istaged(gather_dev),
+            "ialltoall_dev": _istaged(alltoall_dev),
+            "ireduce_scatter_block_dev":
+                _istaged(reduce_scatter_block_dev),
+            "iscatter_dev": _istaged(scatter_dev),
+            "iscan_dev": _istaged(scan_dev),
+            "iexscan_dev": _istaged(exscan_dev),
+            "iallgatherv_dev": _istaged(allgatherv_dev),
+            "igatherv_dev": _istaged(gatherv_dev),
+            "ialltoallv_dev": _istaged(alltoallv_dev),
+            "iscatterv_dev": _istaged(scatterv_dev),
         }

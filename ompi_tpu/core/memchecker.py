@@ -28,6 +28,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Tuple
 
+import numpy as np
+
 from ompi_tpu.core import cvar, pvar
 from ompi_tpu.errors import MPIError
 
@@ -58,6 +60,18 @@ def _interval(arr, nbytes: int = 0) -> Tuple[int, int]:
     touches (a recv of count elements into a larger buffer must not
     shadow the untouched tail)."""
     try:
+        if isinstance(arr, np.ndarray):
+            # byte_bounds handles non-contiguous/negative-stride views
+            # where ctypes.data is not the lowest address and nbytes
+            # overstates the touched span
+            try:
+                from numpy.lib.array_utils import byte_bounds
+            except ImportError:  # numpy < 2
+                byte_bounds = np.byte_bounds
+            lo, hi = byte_bounds(arr)
+            if nbytes > 0 and arr.flags["C_CONTIGUOUS"]:
+                hi = min(hi, lo + nbytes)
+            return lo, hi
         start = arr.ctypes.data
         total = arr.nbytes
     except AttributeError:

@@ -179,9 +179,12 @@ def buffer_key(buf, cache: "Rcache"):
     weakref finalizer that invalidates the entry when the buffer dies —
     the analog of rcache's memory-hook invalidation on munmap
     (opal/memoryhooks/). Registered once per (buffer, cache): repeat
-    calls on a hot path must not pile up finalizer objects. Falls back
-    to the bare id for objects that cannot carry weak references (the
-    entry then ages out by LRU)."""
+    calls on a hot path must not pile up finalizer objects.
+
+    Returns None for objects that cannot carry weak references:
+    without the death hook a recycled id() could alias a dead object's
+    entry and hand back stale cached state, so such objects get no
+    cache key at all (callers skip caching)."""
     key = id(buf)
     token = (key, id(cache))
     with _fin_lock:
@@ -199,4 +202,5 @@ def buffer_key(buf, cache: "Rcache"):
     except TypeError:
         with _fin_lock:
             _fin_registered.discard(token)
+        return None
     return key

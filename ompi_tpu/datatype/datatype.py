@@ -53,12 +53,25 @@ def _merge(arr: np.ndarray) -> np.ndarray:
     return np.stack([offs, lens], axis=1)
 
 
-#: hard cap on a materialized type descriptor (~1 GB of span table).
+#: cap on a materialized type descriptor (default ~1 GB of span table).
 #: Big-count transfers belong on the API count — Send(buf, count=huge,
 #: dtype=small) streams through the convertor's windowed span
 #: generation with O(window) memory (the reference encodes such types
 #: as O(1) DT_LOOP descriptors; a span table cannot, so we bound it).
-_MAX_DESCRIPTOR_SPANS = 1 << 26
+#: A cvar so big-memory hosts can raise it (the bound rejects some
+#: huge derived-type constructions at Type_*create time that would
+#: previously have been attempted).
+from ompi_tpu.core import cvar as _cvar
+
+_max_spans_var = _cvar.register(
+    "datatype_max_descriptor_spans", 1 << 26, int,
+    help="Maximum spans a materialized derived-type descriptor may "
+         "hold (each span is 16 bytes; the default caps descriptor "
+         "memory at ~1 GB). Constructions above the cap raise at "
+         "type-creation time: put the repetition in the transfer "
+         "count instead — Send(buf, count, small_dtype) streams any "
+         "count with O(window) memory. Raise on big-memory hosts to "
+         "allow larger materialized types.", level=6)
 
 
 def _tile(spans: np.ndarray, n: int, stride: int) -> np.ndarray:
@@ -69,12 +82,14 @@ def _tile(spans: np.ndarray, n: int, stride: int) -> np.ndarray:
     if len(spans) == 1 and stride == spans[0, 1]:
         # contiguous tiling collapses to one span
         return np.array([[spans[0, 0], stride * n]], dtype=np.int64)
-    if n * len(spans) > _MAX_DESCRIPTOR_SPANS:
+    cap = _max_spans_var.get()
+    if n * len(spans) > cap:
         raise ValueError(
             f"type descriptor would need {n * len(spans):,} spans "
-            f"(> {_MAX_DESCRIPTOR_SPANS:,}); move the repetition to "
-            "the transfer count — Send(buf, count, small_dtype) "
-            "streams any count with O(1) descriptor memory")
+            f"(> {cap:,}; cvar datatype_max_descriptor_spans); move "
+            "the repetition to the transfer count — Send(buf, count, "
+            "small_dtype) streams any count with O(1) descriptor "
+            "memory")
     reps = np.arange(n, dtype=np.int64) * stride
     offs = (spans[None, :, 0] + reps[:, None]).reshape(-1)
     lens = np.broadcast_to(spans[None, :, 1],
@@ -140,6 +155,8 @@ class Datatype:
         (derived dtype, count) skip the O(spans*count) rebuild; LRU
         eviction bounds memory for adversarial count diversity."""
         key = _mpool.buffer_key(self, _span_cache)  # id + death hook
+        if key is None:  # no weakref support: uncacheable (a recycled
+            return _tile(self.spans, count, self.extent)  # id aliases)
         per_count = _span_cache.lookup(key)
         if per_count is not None and count in per_count:
             return per_count[count]

@@ -48,12 +48,12 @@ def _parse_buf(buf) -> Tuple[Any, int, Optional[Datatype]]:
     if type(arr).__module__.split(".")[0] in ("jax", "jaxlib"):
         raise TypeError(
             "device array passed to an operation without a device "
-            "path. Device-interposed collectives: Allreduce, Bcast, "
-            "Reduce, Allgather, Alltoall, Reduce_scatter_block, "
-            "Scatter, Gather, Scan, Exscan (sendbuf device, recvbuf "
-            "None -> returns a new device array). For other "
-            "operations stage manually with np.asarray(arr) / "
-            "jax.device_put.")
+            "path. Device-interposed entries: Send/Recv (pipelined "
+            "bounce-buffer staging), the blocking and nonblocking "
+            "collectives incl. v-variants (sendbuf device, recvbuf "
+            "None -> returns a new device array), Barrier(device="
+            "True). For other operations stage manually with "
+            "np.asarray(arr) / jax.device_put.")
     mv = memoryview(arr)
     return arr, mv.nbytes, None
 
@@ -184,6 +184,13 @@ def _sendrecv(self, obj, dest: int, source: int = ANY_SOURCE,
 def _Send(self, buf, dest: int, tag: int = 0) -> None:
     self.check_revoked()
     _check_rank(self, dest)
+    if _is_dev(buf):
+        # pipelined bounce-buffer staging (ob1 accelerator analog):
+        # D2H of chunk k+1 overlaps the wire send of chunk k
+        from ompi_tpu.pml import accel_p2p
+
+        pvar.record("send")
+        return accel_p2p.send_dev(self, buf, dest, tag)
     arr, count, dt = _parse_buf(buf)
     pvar.record("send")
     pml.current().send(self, arr, count, dt, dest, tag)
@@ -224,8 +231,20 @@ def _Bsend(self, buf, dest: int, tag: int = 0) -> None:
 
 
 def _Recv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
-          status: Optional[Status] = None) -> Status:
+          status: Optional[Status] = None):
+    """Device path: ``buf`` (a jax array) is the shape/dtype template
+    and the received data comes back as a NEW device array (PJRT
+    buffers are immutable); the host path fills ``buf`` in place and
+    returns the Status."""
     self.check_revoked()
+    if _is_dev(buf):
+        from ompi_tpu.pml import accel_p2p
+
+        out, st = accel_p2p.recv_dev(self, buf, source, tag)
+        if status is not None:
+            status.source, status.tag = st.source, st.tag
+            status.count, status.error = st.count, st.error
+        return out
     arr, count, dt = _parse_buf(buf)
     st = pml.current().recv(self, arr, count, dt, source, tag)
     if status is not None:
@@ -301,21 +320,39 @@ def _Recv_init(self, buf, source: int = ANY_SOURCE,
 # -- collectives (capitalized: buffers; lowercase: objects) --
 
 def _is_dev(buf) -> bool:
-    """True when buf is a device-resident array (reference:
-    accelerator check_addr on every collective entry,
-    coll_accelerator_allreduce.c check_buf)."""
-    if buf is None or buf is IN_PLACE or isinstance(buf, tuple):
-        return False
-    if isinstance(buf, (np.ndarray, bytes, bytearray, memoryview)):
+    """True when buf is a device-resident array (the shared predicate
+    accelerator.is_device_buffer — reference: check_addr on every
+    collective entry, coll_accelerator_allreduce.c check_buf)."""
+    if buf is IN_PLACE:
         return False
     from ompi_tpu import accelerator
 
-    return accelerator.current().check_addr(buf)
+    return accelerator.is_device_buffer(buf)
 
 
-def _Barrier(self) -> None:
+def _require_packed_displs(counts, displs, what: str) -> None:
+    """Device v-variants slice the send buffer as PACKED segments; a
+    caller-supplied send-side displacement layout would silently move
+    the wrong data, so it is rejected (recv-side displs are a host
+    layout concept — device results come back packed by design)."""
+    if displs is None:
+        return
+    packed = np.concatenate(
+        [[0], np.cumsum(np.asarray(counts[:-1]))]).tolist()
+    if list(displs) != packed:
+        raise ValueError(
+            f"{what}: the device path requires packed send "
+            f"displacements {packed}, got {list(displs)}; stage to "
+            "host (np.asarray) for custom send layouts")
+
+
+def _Barrier(self, device: bool = False) -> None:
+    """device=True rendezvouses on the device plane (a compiled
+    1-element psum over ICI) instead of the host transports."""
     self.check_revoked()
     self.check_failed()
+    if device:
+        return self.coll.barrier_dev(self)
     self.coll.barrier(self)
 
 
@@ -373,9 +410,13 @@ def _Gather(self, sendbuf, recvbuf=None, root: int = 0):
 
 
 def _Gatherv(self, sendbuf, recvbuf, counts, displs=None,
-             root: int = 0) -> None:
+             root: int = 0):
     self.check_revoked()
     self.check_failed()
+    if _is_dev(sendbuf):
+        # device path returns the packed (sum(counts), ...) array on
+        # root (displs are a host-layout concept); recvbuf unused
+        return self.coll.gatherv_dev(self, sendbuf, counts, root)
     sarr = _parse_buf(sendbuf)[0]
     rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
     if displs is None:
@@ -391,16 +432,24 @@ def _Scatter(self, sendbuf, recvbuf=None, root: int = 0,
     self.check_revoked()
     self.check_failed()
     if _is_dev(sendbuf) or device:
-        return self.coll.scatter_dev(self, sendbuf, root)
+        return self.coll.scatter_dev(self, sendbuf, root,
+                                     like=recvbuf)
     rarr, count, dt = _parse_buf(recvbuf)
     sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
     self.coll.scatter(self, sarr, rarr, count, dt, root)
 
 
 def _Scatterv(self, sendbuf, recvbuf, counts, displs=None,
-              root: int = 0) -> None:
+              root: int = 0, device: bool = False):
+    """Device path (root's sendbuf on device, or device=True): returns
+    this rank's (counts[rank], ...) segment as a new device array;
+    recvbuf serves as the non-root shape/dtype template (``like``)."""
     self.check_revoked()
     self.check_failed()
+    if _is_dev(sendbuf) or device:
+        _require_packed_displs(counts, displs, "Scatterv")
+        return self.coll.scatterv_dev(self, sendbuf, counts, root,
+                                      like=recvbuf)
     rarr = _parse_buf(recvbuf)[0]
     sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
     if displs is None:
@@ -419,9 +468,11 @@ def _Allgather(self, sendbuf, recvbuf=None):
     self.coll.allgather(self, sarr, rarr, count, dt)
 
 
-def _Allgatherv(self, sendbuf, recvbuf, counts, displs=None) -> None:
+def _Allgatherv(self, sendbuf, recvbuf, counts, displs=None):
     self.check_revoked()
     self.check_failed()
+    if _is_dev(sendbuf):
+        return self.coll.allgatherv_dev(self, sendbuf, counts)
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if displs is None:
@@ -442,9 +493,16 @@ def _Alltoall(self, sendbuf, recvbuf=None):
 
 
 def _Alltoallv(self, sendbuf, recvbuf, scounts, rcounts,
-               sdispls=None, rdispls=None) -> None:
+               sdispls=None, rdispls=None, max_count=None):
+    """Device path: ``max_count`` (e.g. a fixed MoE expert capacity)
+    makes the ragged exchange entirely host-free; without it one tiny
+    host max-allreduce sizes the padded cells."""
     self.check_revoked()
     self.check_failed()
+    if _is_dev(sendbuf):
+        _require_packed_displs(scounts, sdispls, "Alltoallv")
+        return self.coll.alltoallv_dev(self, sendbuf, scounts, rcounts,
+                                       max_count=max_count)
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if sdispls is None:
@@ -502,18 +560,28 @@ def _Exscan(self, sendbuf, recvbuf=None, op=op_mod.SUM) -> None:
     self.coll.exscan(self, sarr, rarr, count, dt, op)
 
 
-# -- nonblocking collectives (MPI-3 i-variants via coll/libnbc) --
+# -- nonblocking collectives (MPI-3 i-variants via coll/libnbc; device
+# buffers dispatch async on the device plane and return a readiness-
+# backed DeviceRequest whose .array is the result) --
 
-def _Ibarrier(self) -> rq.Request:
+def _Ibarrier(self, device: bool = False) -> rq.Request:
+    if device:
+        return self.coll.ibarrier_dev(self)
     return self.coll.ibarrier(self)
 
 
 def _Ibcast(self, buf, root: int = 0) -> rq.Request:
+    if _is_dev(buf):
+        return self.coll.ibcast_dev(self, buf, root)
     arr, count, dt = _parse_buf(buf)
     return self.coll.ibcast(self, arr, count, dt, root)
 
 
-def _Iallreduce(self, sendbuf, recvbuf, op=op_mod.SUM) -> rq.Request:
+def _Iallreduce(self, sendbuf, recvbuf=None, op=op_mod.SUM,
+                deterministic=None) -> rq.Request:
+    if _is_dev(sendbuf):
+        return self.coll.iallreduce_dev(self, sendbuf, op,
+                                        deterministic=deterministic)
     if sendbuf is IN_PLACE:
         rarr, count, dt = _parse_buf(recvbuf)
         return self.coll.iallreduce(self, IN_PLACE, rarr, count, dt, op)
@@ -522,32 +590,44 @@ def _Iallreduce(self, sendbuf, recvbuf, op=op_mod.SUM) -> rq.Request:
                                 count, dt, op)
 
 
-def _Ireduce(self, sendbuf, recvbuf, op=op_mod.SUM,
+def _Ireduce(self, sendbuf, recvbuf=None, op=op_mod.SUM,
              root: int = 0) -> rq.Request:
+    if _is_dev(sendbuf):
+        return self.coll.ireduce_dev(self, sendbuf, op, root)
     sarr, count, dt = _parse_buf(sendbuf)
     rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
     return self.coll.ireduce(self, sarr, rarr, count, dt, op, root)
 
 
-def _Igather(self, sendbuf, recvbuf, root: int = 0) -> rq.Request:
+def _Igather(self, sendbuf, recvbuf=None, root: int = 0) -> rq.Request:
+    if _is_dev(sendbuf):
+        return self.coll.igather_dev(self, sendbuf, root)
     sarr, count, dt = _parse_buf(sendbuf)
     rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
     return self.coll.igather(self, sarr, rarr, count, dt, root)
 
 
-def _Iscatter(self, sendbuf, recvbuf, root: int = 0) -> rq.Request:
+def _Iscatter(self, sendbuf, recvbuf=None, root: int = 0,
+              device: bool = False) -> rq.Request:
+    if _is_dev(sendbuf) or device:
+        return self.coll.iscatter_dev(self, sendbuf, root,
+                                      like=recvbuf)
     rarr, count, dt = _parse_buf(recvbuf)
     sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
     return self.coll.iscatter(self, sarr, rarr, count, dt, root)
 
 
-def _Iallgather(self, sendbuf, recvbuf) -> rq.Request:
+def _Iallgather(self, sendbuf, recvbuf=None) -> rq.Request:
+    if _is_dev(sendbuf):
+        return self.coll.iallgather_dev(self, sendbuf)
     sarr, count, dt = _parse_buf(sendbuf)
     return self.coll.iallgather(self, sarr, _parse_buf(recvbuf)[0],
                                 count, dt)
 
 
-def _Ialltoall(self, sendbuf, recvbuf) -> rq.Request:
+def _Ialltoall(self, sendbuf, recvbuf=None) -> rq.Request:
+    if _is_dev(sendbuf):
+        return self.coll.ialltoall_dev(self, sendbuf)
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     count = np.asarray(sarr).size // self.size
@@ -556,6 +636,8 @@ def _Ialltoall(self, sendbuf, recvbuf) -> rq.Request:
 
 def _Igatherv(self, sendbuf, recvbuf, counts, displs=None,
               root: int = 0) -> rq.Request:
+    if _is_dev(sendbuf):
+        return self.coll.igatherv_dev(self, sendbuf, counts, root)
     sarr = _parse_buf(sendbuf)[0]
     rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
     if displs is None:
@@ -565,7 +647,11 @@ def _Igatherv(self, sendbuf, recvbuf, counts, displs=None,
 
 
 def _Iscatterv(self, sendbuf, recvbuf, counts, displs=None,
-               root: int = 0) -> rq.Request:
+               root: int = 0, device: bool = False) -> rq.Request:
+    if _is_dev(sendbuf) or device:
+        _require_packed_displs(counts, displs, "Iscatterv")
+        return self.coll.iscatterv_dev(self, sendbuf, counts, root,
+                                       like=recvbuf)
     rarr = _parse_buf(recvbuf)[0]
     sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
     if displs is None:
@@ -576,6 +662,8 @@ def _Iscatterv(self, sendbuf, recvbuf, counts, displs=None,
 
 def _Iallgatherv(self, sendbuf, recvbuf, counts,
                  displs=None) -> rq.Request:
+    if _is_dev(sendbuf):
+        return self.coll.iallgatherv_dev(self, sendbuf, counts)
     sarr = IN_PLACE if sendbuf is IN_PLACE else _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if displs is None:
@@ -586,7 +674,12 @@ def _Iallgatherv(self, sendbuf, recvbuf, counts,
 
 
 def _Ialltoallv(self, sendbuf, recvbuf, scounts, rcounts,
-                sdispls=None, rdispls=None) -> rq.Request:
+                sdispls=None, rdispls=None,
+                max_count=None) -> rq.Request:
+    if _is_dev(sendbuf):
+        _require_packed_displs(scounts, sdispls, "Ialltoallv")
+        return self.coll.ialltoallv_dev(self, sendbuf, scounts,
+                                        rcounts, max_count=max_count)
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if sdispls is None:
@@ -597,7 +690,9 @@ def _Ialltoallv(self, sendbuf, recvbuf, scounts, rcounts,
                                 rcounts, rdispls, dtype_of(sarr))
 
 
-def _Iscan(self, sendbuf, recvbuf, op=op_mod.SUM) -> rq.Request:
+def _Iscan(self, sendbuf, recvbuf=None, op=op_mod.SUM) -> rq.Request:
+    if _is_dev(sendbuf):
+        return self.coll.iscan_dev(self, sendbuf, op)
     rarr, rcount, rdt = _parse_buf(recvbuf)
     if sendbuf is IN_PLACE:
         return self.coll.iscan(self, IN_PLACE, rarr, rcount, rdt, op)
@@ -605,7 +700,9 @@ def _Iscan(self, sendbuf, recvbuf, op=op_mod.SUM) -> rq.Request:
     return self.coll.iscan(self, sarr, rarr, count, dt, op)
 
 
-def _Iexscan(self, sendbuf, recvbuf, op=op_mod.SUM) -> rq.Request:
+def _Iexscan(self, sendbuf, recvbuf=None, op=op_mod.SUM) -> rq.Request:
+    if _is_dev(sendbuf):
+        return self.coll.iexscan_dev(self, sendbuf, op)
     rarr, rcount, rdt = _parse_buf(recvbuf)
     if sendbuf is IN_PLACE:
         return self.coll.iexscan(self, IN_PLACE, rarr, rcount, rdt, op)
@@ -613,8 +710,10 @@ def _Iexscan(self, sendbuf, recvbuf, op=op_mod.SUM) -> rq.Request:
     return self.coll.iexscan(self, sarr, rarr, count, dt, op)
 
 
-def _Ireduce_scatter_block(self, sendbuf, recvbuf,
+def _Ireduce_scatter_block(self, sendbuf, recvbuf=None,
                            op=op_mod.SUM) -> rq.Request:
+    if _is_dev(sendbuf):
+        return self.coll.ireduce_scatter_block_dev(self, sendbuf, op)
     rarr, count, dt = _parse_buf(recvbuf)
     return self.coll.ireduce_scatter_block(
         self, _parse_buf(sendbuf)[0], rarr, count, dt, op)
@@ -813,6 +912,26 @@ def Init():
     from ompi_tpu.runtime import state
 
     return state.init()
+
+
+def Session_init(info=None):
+    """MPI-4 MPI_Session_init: an instance handle with NO world model
+    (reference: ompi/mpi/c/session_init.c over ompi/instance). Query
+    psets, derive groups, build comms via Comm_create_from_group —
+    see runtime.state.Session."""
+    from ompi_tpu.runtime import state
+
+    return state.Session(info)
+
+
+def Group_from_session_pset(session, pset_name: str):
+    return session.group_from_pset(pset_name)
+
+
+def Comm_create_from_group(group, tag: str = "org.ompi_tpu.default"):
+    from ompi_tpu.comm import comm_create_from_group
+
+    return comm_create_from_group(group, tag)
 
 
 def Finalize() -> None:

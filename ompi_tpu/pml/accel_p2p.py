@@ -1,0 +1,98 @@
+"""Device-buffer point-to-point: pipelined staging over the
+accelerator's async-copy stream.
+
+Reference: ompi/mca/pml/ob1/pml_ob1_accelerator.c:57-89 — ob1 moves
+device buffers through host bounce buffers tracked by outstanding-copy
+event arrays, so the D2H of fragment k overlaps the wire transfer of
+fragment k-1. Same schedule here: the sender submits every chunk's D2H
+to the accelerator's ordered stream up front, then sends each chunk as
+its event fires — the stream worker is copying chunk k+1 off the
+device while the main thread drives chunk k through the PML. The
+receiver overlaps in the mirror direction: each received chunk's H2D
+is dispatched asynchronously (PJRT) while the next chunk is on the
+wire.
+
+Both sides derive the chunking from ``pml_accel_chunk_bytes`` and the
+buffer size, so no extra protocol rides the wire; the cvar must be
+uniform across ranks (launcher-forwarded MCA values are).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.core import cvar, pvar
+
+_chunk_var = cvar.register(
+    "pml_accel_chunk_bytes", 4 << 20, int,
+    help="Bounce-buffer fragment size for device-buffer p2p staging "
+         "(the btl_accelerator_eager_limit/pipeline analog). Sender "
+         "D2H of chunk k+1 overlaps the send of chunk k; must be "
+         "uniform across ranks (chunk boundaries are derived, not "
+         "negotiated).", level=6)
+
+
+def _chunk_elems(dtype) -> int:
+    return max(1, _chunk_var.get() // np.dtype(dtype).itemsize)
+
+
+def send_dev(comm, buf, dest: int, tag: int) -> None:
+    """Pipelined device->wire send of a jax array. A tiny header
+    message carries the element count so the receiver's chunk
+    schedule follows the SENDER's size (MPI semantics: recv count >=
+    send count succeeds with Status reporting the actual amount)."""
+    from ompi_tpu import accelerator
+
+    acc = accelerator.current()
+    pvar.record("accel_p2p_send")
+    flat = buf.reshape(-1)
+    n = flat.size
+    comm.Send(np.array([n], np.int64), dest=dest, tag=tag)
+    if n == 0:
+        return
+    step = _chunk_elems(flat.dtype)
+    # submit ALL D2H copies to the ordered stream first: the worker
+    # stays ahead of the wire (outstanding-copy events, ob1-style)
+    events = [acc.copy_async(flat[a:a + step])
+              for a in range(0, n, step)]
+    for ev in events:
+        comm.Send(ev.wait(), dest=dest, tag=tag)
+
+
+def recv_dev(comm, like, source: int, tag: int):
+    """Pipelined wire->device receive; returns (new device array,
+    final Status). ``like`` supplies shape/dtype (jax arrays are
+    immutable — in-place recv is impossible on PJRT buffers); the
+    result is shaped by ``like`` with the sender's data in the leading
+    elements when the message is shorter (host-recv semantics)."""
+    import jax.numpy as jnp
+
+    from ompi_tpu import errors
+    from ompi_tpu import accelerator
+
+    acc = accelerator.current()
+    pvar.record("accel_p2p_recv")
+    cap = int(np.prod(like.shape, dtype=np.int64))
+    dtype = np.dtype(like.dtype)
+    hdr = np.zeros(1, np.int64)
+    st = comm.Recv(hdr, source=source, tag=tag)
+    # chunks of one message must all come from the matched peer
+    # (per-(src,tag) non-overtaking makes this deterministic)
+    source, tag = st.source, st.tag
+    n = int(hdr[0])
+    if n > cap:
+        raise errors.TruncateError(
+            f"device recv truncation: message of {n} elements exceeds "
+            f"template capacity {cap}")
+    step = _chunk_elems(dtype)
+    parts = []
+    for a in range(0, n, step):
+        host = np.empty(min(step, n - a), dtype)
+        st = comm.Recv(host, source=source, tag=tag)
+        parts.append(acc.to_device(host))  # async H2D overlaps next recv
+    if n < cap:  # short message: zero-fill the tail, like-shaped
+        parts.append(jnp.zeros(cap - n, like.dtype))
+    out = parts[0] if len(parts) == 1 else jnp.concatenate(
+        parts or [jnp.zeros(0, like.dtype)])
+    st.count = n * dtype.itemsize  # total, not the last fragment
+    return out.reshape(like.shape), st

@@ -131,6 +131,17 @@ class RecvRequest(rq.Request):
             self.status.cancelled = True
             self.complete()
 
+    def complete(self, error: int = 0) -> None:
+        # pooled obj scratch returns to the mpool on EVERY completion
+        # path (success, truncation, cancel, FT sweep), and the
+        # convertor reference is dropped with it so no completed
+        # request aliases a recycled pool buffer
+        if self.is_obj and self.buf is not None:
+            mpool.pool.give(self.buf)
+            self.buf = None
+            self.conv = None
+        super().complete(error)
+
 
 class _Unexpected:
     """Parked arrival that found no posted recv."""
@@ -665,9 +676,7 @@ class Ob1:
         if req.is_obj and req.status.error == 0:
             req._obj = pickle.loads(
                 bytes(memoryview(req.buf)[:req.total]))
-            mpool.pool.give(req.buf)
-            req.buf = None
-        req.complete(req.status.error)
+        req.complete(req.status.error)  # releases pooled obj scratch
         if peruse.active:
             peruse.fire(peruse.REQ_COMPLETE, ctx=req.ctx,
                         src=req.status.source, tag=req.status.tag,

@@ -20,6 +20,8 @@ from ompi_tpu.runtime import rte
 _lock = threading.RLock()
 _initialized = False
 _finalized = False
+_instance_up = False
+_instance_users = 0
 _world = None
 _self_comm = None
 _out = output.stream("runtime")
@@ -33,19 +35,20 @@ def is_finalized() -> bool:
     return _finalized
 
 
-def init(thread_level: int = 0):
-    """Bring up the instance; returns COMM_WORLD.
+def init_instance() -> None:
+    """Bring up the INSTANCE — everything below the world model.
 
-    Order mirrors ompi_mpi_instance_init_common (instance.c:360):
-    rte/PMIx first, then frameworks, then endpoint exchange (modex),
-    then communicator construction + collective selection.
+    This is ompi_mpi_instance_init_common (instance.c:360): rte/PMIx,
+    accelerator + device plane, pml selection, interposition, tool
+    hooks. MPI-4 Sessions consume exactly this (no COMM_WORLD is
+    built); MPI_Init layers the world model on top — the reference's
+    real init engine is the session machinery and ompi_mpi_init is a
+    consumer of it (instance.c:822, SURVEY §1.2).
     """
-    global _initialized, _world, _self_comm
+    global _instance_up
     with _lock:
-        if _finalized:
-            raise RuntimeError("init after finalize (MPI semantics)")
-        if _initialized:
-            return _world
+        if _instance_up:
+            return
         rte.init()
         _out.verbose(2, "rte up: rank %d/%d job %s",
                      rte.rank, rte.size, rte.jobid)
@@ -57,14 +60,13 @@ def init(thread_level: int = 0):
 
         # multi-controller device plane (opt-in; collective over the
         # world, must precede comm construction so coll/xla can qualify
-        # during COMM_WORLD's coll table selection)
+        # during any comm's coll table selection)
         from ompi_tpu.runtime import device_plane
 
         if device_plane.requested():
             device_plane.init_plane()
 
         from ompi_tpu import pml
-        from ompi_tpu.comm import build_world
 
         pml.select()
         # interposition layers stack over the selected PML before any
@@ -80,6 +82,60 @@ def init(thread_level: int = 0):
         from ompi_tpu.tools import msgq as _msgq
 
         _msgq.install_signal_dump()
+        _instance_up = True
+        atexit.register(_atexit_finalize)
+
+
+def _acquire() -> None:
+    """One more instance user (a Session, or the world model)."""
+    global _instance_users
+    with _lock:
+        init_instance()
+        _instance_users += 1
+
+
+def _release() -> None:
+    """Drop an instance user; the last one tears the transports down
+    (the reference refcounts ompi_mpi_instance the same way —
+    ompi_mpi_instance_retain/release). Resets _instance_up so a later
+    Session_init re-initializes a fresh instance instead of handing
+    back dead transports (MPI-4 allows sessions after a full
+    teardown); the world model's once-only rule lives in _finalized,
+    which only finalize() sets."""
+    global _instance_users, _instance_up
+    with _lock:
+        _instance_users = max(0, _instance_users - 1)
+        if _instance_users > 0 or not _instance_up:
+            return
+        try:
+            if rte.size > 1:
+                # every rank must have drained its last messages before
+                # any transport tears down (unlink/close races)
+                rte.fence("finalize", timeout=30.0)
+        except Exception:
+            pass
+        from ompi_tpu import pml
+
+        pml.finalize()
+        registry.close_all()
+        _instance_up = False
+
+
+def init(thread_level: int = 0):
+    """Bring up the world model; returns COMM_WORLD.
+
+    A consumer of the session engine: instance first
+    (:func:`init_instance`), then COMM_WORLD/SELF + the ULFM detector
+    (ompi_mpi_init.c:359 over instance.c:822)."""
+    global _initialized, _world, _self_comm
+    with _lock:
+        if _finalized:
+            raise RuntimeError("init after finalize (MPI semantics)")
+        if _initialized:
+            return _world
+        _acquire()
+        from ompi_tpu.comm import build_world
+
         _world, _self_comm = build_world()
 
         # ULFM detector (opt-in: --mca ft 1); after comm construction so
@@ -90,7 +146,6 @@ def init(thread_level: int = 0):
         if _ft_detector.enabled() and rte.size > 1:
             _ft_detector.start()
         _initialized = True
-        atexit.register(_atexit_finalize)
         return _world
 
 
@@ -107,88 +162,135 @@ def comm_self():
 
 
 def finalize() -> None:
+    """MPI_Finalize: tear down the world model, release its instance
+    ref (the last user — an open Session keeps transports alive)."""
     global _finalized, _initialized, _world, _self_comm
     with _lock:
         if _finalized or not _initialized:
             _finalized = True
             return
+        # the world model finalizes exactly once, regardless of open
+        # sessions (a later Init must raise even while a session keeps
+        # the instance alive)
+        _finalized = True
         from ompi_tpu.ft import detector as _ft_detector
 
         try:
             # FT mode: a rank can die mid-barrier and strand live peers
             # that wait on each other (the classic ULFM hang revoke
-            # exists for) — the dead-tolerant store fence below is the
-            # shutdown rendezvous instead.
+            # exists for) — the dead-tolerant store fence in _release
+            # is the shutdown rendezvous instead.
             if (_world is not None and rte.size > 1
                     and _ft_detector.get() is None):
                 _world.barrier()
         except Exception:
             pass
-        try:
-            if rte.size > 1:
-                # every rank must have drained its last messages before
-                # any transport tears down (unlink/close races). Bounded:
-                # a rank whose barrier failed still fences, and a dead
-                # peer cannot hang survivors past the timeout.
-                rte.fence("finalize", timeout=30.0)
-        except Exception:
-            pass
-        from ompi_tpu import pml
-
         _ft_detector.stop()
-        pml.finalize()
-        registry.close_all()
-        _finalized = True
         _initialized = False
         _world = None
         _self_comm = None
+        _release()
 
 
 def _atexit_finalize() -> None:
     try:
+        for s in list(_open_sessions):
+            s.finalize()
         if _initialized and not _finalized:
             finalize()
     except Exception:
         pass
 
 
-class Session:
-    """MPI-4 session (reference: ompi/instance — MPI_Session_init).
+_open_sessions: set = set()
 
-    Sessions share the underlying instance; each provides group queries
-    from named process sets and communicator creation from groups.
+
+class Session:
+    """MPI-4 session (reference: ompi/instance/instance.c:360,822 and
+    ompi/mpi/c/session_init.c).
+
+    A session is an independent handle on the shared instance — it
+    brings up rte/pml/accelerator WITHOUT building COMM_WORLD (the
+    no-world-model path): process sets are queried by name, turned
+    into groups, and comms are built from groups via the store-brokered
+    ``comm_create_from_group`` agreement. MPI_Init is a *consumer* of
+    the same engine (init() layers the world model over
+    init_instance()), exactly the reference's structure.
+
+    Process sets: ``mpi://WORLD``, ``mpi://SELF`` (mandatory per
+    MPI-4) and ``ompi_tpu://HOST`` (this node's ranks — the PMIx
+    host-pset analog the reference exposes via PRRTE).
     """
 
     PSET_WORLD = "mpi://WORLD"
     PSET_SELF = "mpi://SELF"
+    PSET_HOST = "ompi_tpu://HOST"
 
     def __init__(self, info: Optional[dict] = None) -> None:
         self.info = dict(info or {})
-        init()
+        _acquire()
         self._open = True
+        _open_sessions.add(self)
 
+    # -- process sets (MPI_Session_get_num_psets / get_nth_pset) --------
     def num_psets(self) -> int:
-        return 2
+        return len(self.psets())
 
     def psets(self):
-        return [self.PSET_WORLD, self.PSET_SELF]
+        return [self.PSET_WORLD, self.PSET_SELF, self.PSET_HOST]
+
+    def get_nth_pset(self, n: int) -> str:
+        return self.psets()[n]
+
+    def pset_info(self, name: str) -> dict:
+        """MPI_Session_get_pset_info: at minimum mpi_size."""
+        return {"mpi_size": len(self.group_from_pset(name).ranks)}
 
     def group_from_pset(self, name: str):
+        """MPI_Group_from_session_pset — groups are built directly
+        from rte knowledge, no communicator required."""
         if not self._open:
             raise RuntimeError("session finalized")
+        from ompi_tpu.comm import Group
+
         if name == self.PSET_WORLD:
-            return world().group
+            return Group(rte.world_ranks())
         if name == self.PSET_SELF:
-            return comm_self().group
+            return Group([rte.rank])
+        if name == self.PSET_HOST:
+            return Group(_host_ranks())
         raise KeyError(f"unknown process set {name!r}")
 
     def comm_from_group(self, group, tag: str = "org.ompi_tpu.default"):
+        """MPI_Comm_create_from_group (via the session, per MPI-4)."""
+        if not self._open:
+            raise RuntimeError("session finalized")
         from ompi_tpu.comm import comm_create_from_group
 
         return comm_create_from_group(group, tag)
 
     def finalize(self) -> None:
-        self._open = False
+        """MPI_Session_finalize: drops this session's instance ref;
+        the last ref tears the transports down."""
+        if self._open:
+            self._open = False
+            _open_sessions.discard(self)
+            _release()
+
+
+def _host_ranks():
+    """World ranks on this node (the host pset): one hostname
+    exchange through the store, cached for the process lifetime."""
+    global _host_ranks_cache
+    if _host_ranks_cache is None:
+        me = rte.hostname()
+        rte.modex_send("pset_host", me)
+        _host_ranks_cache = [w for w in rte.world_ranks()
+                             if rte.modex_recv("pset_host", w) == me]
+    return _host_ranks_cache
+
+
+_host_ranks_cache = None
 
 
 def abort(code: int = 1, reason: str = "MPI_Abort") -> None:

@@ -29,10 +29,14 @@ from typing import Dict, List
 from ompi_tpu.core import cvar
 
 dump_on_signal = cvar.register(
-    "mpir_dump_on_signal", "on", str,
+    "mpir_dump_on_signal", "off", str,
     help="Install a SIGUSR1 handler that dumps PML match queues and "
          "communicator handles to stderr — the debugger-attach "
-         "(MPIR/ompi_msgq_dll) equivalent for hung-rank triage.",
+         "(MPIR/ompi_msgq_dll) equivalent for hung-rank triage. "
+         "Opt-in: installing it changes the process-wide SIGUSR1 "
+         "disposition (default action is terminate) and the dump runs "
+         "Python printing inside a signal handler, which a production "
+         "job should not do silently.",
     choices=["on", "off"], level=5)
 
 

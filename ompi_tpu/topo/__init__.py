@@ -181,16 +181,28 @@ def _attach(comm: Communicator, topo) -> Communicator:
 def _Create_cart(self, dims: Sequence[int],
                  periods: Optional[Sequence[bool]] = None,
                  reorder: bool = False) -> Optional[Communicator]:
-    """MPI_Cart_create. reorder is accepted and ignored (rank order is
-    already arbitrary under the launcher; the reference's reorder is a
-    hint too)."""
+    """MPI_Cart_create. With ``reorder=True`` on the device plane, the
+    stencil graph is placed onto the ranks' device-mesh coordinates so
+    halo neighbors land on ICI neighbors (the treematch analog —
+    ompi/mca/topo/treematch/; see topo.reorder). Off-plane the hint is
+    identity, as in the reference when no topology is available."""
     dims = list(dims)
     periods = [False] * len(dims) if periods is None else list(periods)
     n = math.prod(dims) if dims else 1
     if n > self.size:
         raise ValueError(f"cart size {n} exceeds comm size {self.size}")
+    key = self.rank
+    if reorder and n > 1 and self.rank < n:
+        from ompi_tpu.topo import reorder as reorder_mod
+
+        perm = reorder_mod.permute_for(
+            self, reorder_mod.cart_weights(dims, periods))
+        if perm is not None:
+            # perm[cart position] = old rank playing it; my new cart
+            # rank is the position I was assigned
+            key = perm.index(self.rank)
     color = 0 if self.rank < n else UNDEFINED
-    sub = self.split(color, key=self.rank)
+    sub = self.split(color, key=key)
     if sub is None:
         return None
     return _attach(sub, CartTopo(dims, periods))
@@ -253,8 +265,27 @@ def _Create_dist_graph_adjacent(
         self, sources: Sequence[int], destinations: Sequence[int],
         reorder: bool = False) -> Communicator:
     """MPI_Dist_graph_create_adjacent: every rank supplies its own
-    in/out neighbor lists; no redistribution needed."""
-    sub = self.split(0, key=self.rank)
+    in/out neighbor lists. ``reorder=True`` places the (gathered)
+    graph onto device-mesh coordinates: the edge lists describe the
+    VIRTUAL topology by rank number, so a process reassigned to rank v
+    adopts the adjacency originally specified for v (MPI reorder
+    semantics; treematch analog — see topo.reorder)."""
+    key = self.rank
+    if reorder and self.size > 1:
+        from ompi_tpu.topo import reorder as reorder_mod
+
+        alladj = self.allgather((list(sources), list(destinations)))
+        w = np.zeros((self.size, self.size))
+        for r, (srcs, dsts) in enumerate(alladj):
+            for s in srcs:
+                w[s, r] += 1.0
+            for d in dsts:
+                w[r, d] += 1.0
+        perm = reorder_mod.permute_for(self, w)
+        if perm is not None:
+            key = perm.index(self.rank)
+            sources, destinations = alladj[key]
+    sub = self.split(0, key=key)
     return _attach(sub, DistGraphTopo(sources, destinations))
 
 

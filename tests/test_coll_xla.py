@@ -298,3 +298,147 @@ def test_hier_off_and_indivisible_stay_flat():
     assert ctx.mesh2d is None  # 3 ranks don't split into 2 slices
     np.testing.assert_array_equal(np.asarray(r), np.full(4, 3.0))
     """, 3, mca=HIER_MCA)
+
+
+def test_vvariant_collectives_device_no_staging():
+    """allgatherv/gatherv/scatterv/alltoallv on device: ragged blocks
+    pad-to-max, one compiled collective, zero host staging
+    (r2 VERDICT missing #4)."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    counts = [1, 3, 2, 2][:size]
+
+    # allgatherv: rank r contributes counts[r] rows
+    mine = jnp.arange(counts[rank], dtype=jnp.float32) + 10 * rank
+    packed = comm.Allgatherv(mine, None, counts)
+    exp = np.concatenate([np.arange(counts[r], dtype=np.float32)
+                          + 10 * r for r in range(size)])
+    np.testing.assert_array_equal(np.asarray(packed), exp)
+
+    # gatherv
+    g = comm.Gatherv(mine, None, counts, root=1)
+    if rank == 1:
+        np.testing.assert_array_equal(np.asarray(g), exp)
+    else:
+        assert g is None
+
+    # scatterv: root splits ragged segments; non-roots derive shapes
+    # from the cached metadata round
+    if rank == 0:
+        seg = comm.Scatterv(jnp.asarray(exp), None, counts, root=0)
+    else:
+        seg = comm.Scatterv(None, None, counts, root=0, device=True)
+    np.testing.assert_array_equal(
+        np.asarray(seg),
+        np.arange(counts[rank], dtype=np.float32) + 10 * rank)
+
+    # alltoallv: rank r sends (r + d) % size rows to dest d
+    scounts = [(rank + d) % size for d in range(size)]
+    rcounts = [(s + rank) % size for s in range(size)]
+    send = jnp.concatenate([
+        jnp.full((scounts[d],), 100 * rank + d, jnp.float32)
+        for d in range(size)]) if sum(scounts) else jnp.zeros(
+            (0,), jnp.float32)
+    out = comm.Alltoallv(send, None, scounts, rcounts)
+    exp = np.concatenate([
+        np.full(rcounts[s], 100 * s + rank, np.float32)
+        for s in range(size)]) if sum(rcounts) else np.zeros(
+            (0,), np.float32)
+    np.testing.assert_array_equal(np.asarray(out), exp)
+
+    # explicit max_count (the fixed-capacity MoE pattern: host-free)
+    out2 = comm.Alltoallv(send, None, scounts, rcounts,
+                          max_count=size)
+    np.testing.assert_array_equal(np.asarray(out2), exp)
+
+    assert pvar.read("coll_accelerator_staged") == 0
+    assert pvar.read("coll_xla_device") >= 4
+    """, 4, mca=MCA)
+
+
+def test_nonblocking_device_collectives_no_staging():
+    """i-collectives on device buffers: PJRT-async dispatch wrapped in
+    readiness-backed requests; zero staging (r2 VERDICT missing #3)."""
+    run_ranks("""
+    import jax
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    from ompi_tpu.coll.xla import DeviceRequest
+
+    x = jnp.arange(32, dtype=jnp.float32) + rank
+    r1 = comm.Iallreduce(x)
+    r2 = comm.Ibcast(jnp.full((8,), float(rank), jnp.float32), root=2)
+    r3 = comm.Iallgather(jnp.full((2,), float(rank), jnp.float32))
+    assert all(isinstance(r, DeviceRequest) for r in (r1, r2, r3))
+    for r in (r1, r2, r3):
+        r.wait()
+        assert r.test()
+    exp = size * np.arange(32, dtype=np.float32) + sum(range(size))
+    np.testing.assert_array_equal(np.asarray(r1.array), exp)
+    np.testing.assert_array_equal(np.asarray(r2.array),
+                                  np.full(8, 2.0, np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(r3.array),
+        np.arange(size, dtype=np.float32)[:, None]
+        * np.ones(2, np.float32))
+
+    # nonblocking barrier on the device plane
+    rb = comm.Ibarrier(device=True)
+    rb.wait()
+
+    # nonblocking v-variant
+    counts = list(range(1, size + 1))
+    rv = comm.Iallgatherv(
+        jnp.full((counts[rank],), float(rank), jnp.float32), None,
+        counts)
+    rv.wait()
+    expv = np.concatenate([np.full(counts[r], float(r), np.float32)
+                           for r in range(size)])
+    np.testing.assert_array_equal(np.asarray(rv.array), expv)
+
+    # reduce on a non-root completes immediately with no array
+    rr = comm.Ireduce(x, root=0)
+    rr.wait()
+    if rank != 0:
+        assert rr.array is None
+
+    assert pvar.read("coll_accelerator_staged") == 0
+    """, 4, mca=MCA)
+
+
+def test_scatter_metadata_round_cached():
+    """The scatter metadata host round runs once per (comm, root); a
+    root-side signature change raises instead of silently diverging
+    (r2 VERDICT weak #4)."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    for _ in range(3):
+        if rank == 0:
+            mine = comm.Scatter(jnp.arange(size * 2, dtype=jnp.float32),
+                                root=0)
+        else:
+            mine = comm.Scatter(None, None, root=0, device=True)
+        np.testing.assert_array_equal(
+            np.asarray(mine), np.arange(2, dtype=np.float32) + 2 * rank)
+    meta = comm._coll_xla_scatter_meta
+    assert list(meta) == [("scatter", 0)], meta
+    if rank == 0:
+        try:
+            comm.Scatter(jnp.arange(size * 4, dtype=jnp.float32),
+                         root=0)
+        except ValueError as e:
+            assert "signature changed" in str(e)
+        else:
+            raise AssertionError("shape change must raise")
+    """, 3, mca=MCA)
+
+
+def test_device_barrier():
+    run_ranks("""
+    from ompi_tpu.core import pvar
+    comm.Barrier(device=True)
+    assert pvar.read("coll_xla_device") >= 1
+    assert pvar.read("coll_accelerator_staged") == 0
+    """, 4, mca=MCA)

@@ -55,4 +55,4 @@ def test_queues_visible_and_drain():
             got = np.zeros(4, np.float32)
             comm.Recv(got, 0, tag=98)
             comm.Send(np.full(4, 3.0, np.float32), 0, tag=99)
-    """, 2)
+    """, 2, mca={"mpir_dump_on_signal": "on"})  # opt-in triage knob

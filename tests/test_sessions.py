@@ -1,0 +1,101 @@
+"""MPI-4 sessions — the instance engine without the world model.
+
+Reference: ompi/instance/instance.c:360,822 (the real init engine),
+ompi/mpi/c/session_init.c; MPI_Init is a consumer of the same engine.
+"""
+
+from ompi_tpu.runtime import launcher
+from tests.harness import run_hosts, run_ranks
+
+
+def test_session_only_no_world_model():
+    """A comm built purely via sessions runs a collective — COMM_WORLD
+    is never constructed (the no-world-model application of MPI-4)."""
+    run_ranks("""
+        import numpy as np
+        from ompi_tpu import mpi
+        from ompi_tpu.runtime import state
+
+        s = mpi.Session_init({"thread_level": "single"})
+        assert not state.is_initialized(), "world model must not exist"
+        assert s.num_psets() >= 2
+        names = [s.get_nth_pset(i) for i in range(s.num_psets())]
+        assert "mpi://WORLD" in names and "mpi://SELF" in names
+
+        g = mpi.Group_from_session_pset(s, "mpi://WORLD")
+        assert s.pset_info("mpi://WORLD")["mpi_size"] == g.size
+        comm = s.comm_from_group(g, "test.sessions.world")
+        out = np.zeros(4, np.float32)
+        comm.Allreduce(np.full(4, comm.rank + 1, np.float32), out)
+        assert (out == sum(range(1, g.size + 1))).all(), out
+
+        gs = s.group_from_pset("mpi://SELF")
+        cself = s.comm_from_group(gs, "test.sessions.self")
+        assert cself.size == 1
+
+        assert not state.is_initialized(), "still no world model"
+        s.finalize()
+    """, 3, prelude=False)
+
+
+def test_session_groups_and_set_algebra():
+    run_ranks("""
+        from ompi_tpu import mpi
+        import numpy as np
+
+        s = mpi.Session_init()
+        g = s.group_from_pset("mpi://WORLD")
+        # derived subgroup -> comm (MPI_Group_incl + create_from_group)
+        sub = g.incl(list(range(0, g.size, 2)))
+        if sub.rank != mpi.UNDEFINED:
+            c = s.comm_from_group(sub, "test.sessions.even")
+            out = np.zeros(1, np.int64)
+            c.Allreduce(np.array([1], np.int64), out)
+            assert out[0] == sub.size
+        s.finalize()
+    """, 4, prelude=False)
+
+
+def test_init_is_session_consumer():
+    """MPI_Init layers the world model over the session engine; an
+    open session keeps transports alive across MPI_Finalize."""
+    run_ranks("""
+        import numpy as np
+        from ompi_tpu import mpi
+        from ompi_tpu.runtime import state
+
+        s = mpi.Session_init()
+        comm = mpi.Init()          # world model on the same instance
+        assert state.is_initialized()
+        out = np.zeros(1, np.int64)
+        comm.Allreduce(np.array([2], np.int64), out)
+        assert out[0] == 2 * comm.size
+
+        g = s.group_from_pset("mpi://WORLD")
+        c2 = s.comm_from_group(g, "test.sessions.after_init")
+        mpi.Finalize()             # world gone; session still usable
+        out2 = np.zeros(1, np.int64)
+        c2.Allreduce(np.array([3], np.int64), out2)
+        assert out2[0] == 3 * c2.size
+        s.finalize()               # last ref: transports tear down
+    """, 3, prelude=False)
+
+
+def test_session_host_pset_multihost():
+    """ompi_tpu://HOST resolves to this node's ranks (the PMIx host
+    pset analog) — proven across two fake hosts."""
+    run_hosts("""
+        from ompi_tpu import mpi
+        import numpy as np
+
+        s = mpi.Session_init()
+        hg = s.group_from_pset("ompi_tpu://HOST")
+        assert hg.size == 2, hg.ranks
+        assert (rank in hg.ranks)
+        c = s.comm_from_group(hg, "test.sessions.host")
+        out = np.zeros(1, np.int64)
+        c.Allreduce(np.array([1], np.int64), out)
+        assert out[0] == 2
+        s.finalize()
+    """, [launcher.HostSpec("fakeA", 2, "127.0.0.2"),
+          launcher.HostSpec("fakeB", 2, "127.0.0.3")])

@@ -192,3 +192,75 @@ def test_cart_matches_device_mesh_groups():
     groups_mesh_pos = [sorted(flat_ids[i] for i in g)
                        for g in groups_mesh]
     assert groups_mesh_pos == groups_cart
+
+
+# -- reorder: the treematch analog on device-mesh coordinates -----------
+
+def test_place_path_graph_on_line():
+    """Unit: a path graph placed on a line of coordinates must put
+    consecutive path vertices on adjacent slots (cost-optimal)."""
+    import numpy as np
+
+    from ompi_tpu.topo import reorder
+
+    n = 6
+    w = np.zeros((n, n))
+    for v in range(n - 1):
+        w[v, v + 1] = 1.0
+    coords = [(i,) for i in range(n)]
+    perm = reorder.place(w, coords)
+    assert sorted(perm) == list(range(n))
+    for v in range(n - 1):
+        assert abs(perm[v] - perm[v + 1]) == 1, perm
+
+
+def test_cart_weights_stencil():
+    import numpy as np
+
+    from ompi_tpu.topo import reorder
+
+    w = reorder.cart_weights([2, 3], [False, True])
+    # rank 0 = (0,0): right (0,1)=1, wrap-left (0,2)=2, down (1,0)=3
+    assert w[0, 1] == 1 and w[0, 2] == 1 and w[0, 3] == 1
+    assert w[0, 4] == 0
+    # non-periodic dim 0: (0,0) has no up neighbor
+    assert np.all(w.diagonal() == 0)
+
+
+def test_reorder_identity_off_plane():
+    """Without the device plane, reorder stays a no-op hint."""
+    run_ranks("""
+        cart = comm.Create_cart([2, 2], reorder=True)
+        # identity: cart rank == comm rank
+        assert cart.rank == rank
+    """, 4)
+
+
+def test_dist_graph_reorder_places_heavy_edges_on_neighbors():
+    """A scrambled virtual path (0-2, 2-1, 1-3) reordered on the
+    device plane: consecutive path vertices must land on
+    coordinate-adjacent devices, and each process adopts the
+    adjacency of the vertex it now plays (assert on permutation)."""
+    run_ranks("""
+        import numpy as np
+        from ompi_tpu.runtime import device_plane
+
+        # virtual path over rank NUMBERS: 0-2-1-3
+        outs = {0: [2], 2: [1], 1: [3], 3: []}
+        ins = {2: [0], 1: [2], 3: [1], 0: []}
+        dg = comm.Create_dist_graph_adjacent(
+            ins[rank], outs[rank], reorder=True)
+        # each process adopted the adjacency of its NEW rank number
+        srcs, dsts = dg.Dist_graph_neighbors()
+        assert list(srcs) == ins[dg.rank], (rank, dg.rank, srcs)
+        assert list(dsts) == outs[dg.rank], (rank, dg.rank, dsts)
+        # device coordinates per new rank: path edges must be adjacent
+        my_id = device_plane.my_device().id
+        ids = dg.allgather(my_id)
+        # positions along the (id-ordered) device line: path edges
+        # must land on adjacent devices
+        line = sorted(ids)
+        pos = [line.index(i) for i in ids]
+        for a, b in ((0, 2), (2, 1), (1, 3)):
+            assert abs(pos[a] - pos[b]) == 1, (ids, pos, a, b)
+    """, 4, mca={"device_plane": "on"})
