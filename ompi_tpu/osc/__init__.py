@@ -182,6 +182,20 @@ class Window:
             _, disp, data = msg
             self._target_put(disp, data)
             self._send(src, ("ack",))
+        elif kind == "puts":  # strided put (shmem_iput transport)
+            _, disp, stride, data = msg
+            if data.size:
+                with self._local_mutex:
+                    view = self._target_view(disp, data.size,
+                                             data.dtype.str, stride)
+                    view[:] = data.reshape(-1)
+                    self._dirty = True
+            self._send(src, ("ack",))
+        elif kind == "gets":  # strided get (shmem_iget transport)
+            _, req_id, disp, stride, count, dtstr = msg
+            view = (self._target_view(disp, count, dtstr, stride)
+                    if count else np.empty(0, np.dtype(dtstr)))
+            self._send(src, ("get_reply", req_id, np.array(view)))
         elif kind == "get":
             _, req_id, disp, count, dtstr = msg
             flat = self._target_view(disp, count, dtstr)
@@ -245,11 +259,18 @@ class Window:
         else:
             _out.verbose(1, "unknown osc message %r", kind)
 
-    def _target_view(self, disp: int, count: int, dtstr: str):
+    def _target_view(self, disp: int, count: int, dtstr: str,
+                     stride: int = 1):
+        """count elements at element-stride ``stride`` from byte
+        displacement disp. The byte slice is taken BEFORE .view(dt):
+        viewing the whole window tail would require its length to be
+        an itemsize multiple, which arbitrary disp/window sizes are
+        not."""
         dt = np.dtype(dtstr)
         start = disp * self.disp_unit
-        flat = self.base.reshape(-1).view(np.uint8)[start:]
-        return flat[:count * dt.itemsize].view(dt)
+        span = ((count - 1) * stride + 1) * dt.itemsize if count else 0
+        flat = self.base.reshape(-1).view(np.uint8)[start:start + span]
+        return flat.view(dt)[::stride]
 
     def _target_put(self, disp: int, data: np.ndarray) -> None:
         with self._local_mutex:
@@ -399,6 +420,31 @@ class Window:
                 return s.status
 
         return _R()
+
+    def Put_strided(self, buf, target: int, disp: int = 0,
+                    stride: int = 1) -> None:
+        """Elements of buf land at disp, disp+stride, ... (element
+        stride in buf's dtype units) — the shmem_iput transport; one
+        AM message regardless of element count."""
+        pvar.record("osc_put")
+        data = np.ascontiguousarray(self._stage_origin(buf))
+        self._count_op(target, ackable=True)
+        self._local_or_send(target, ("puts", disp, int(stride), data))
+
+    def Get_strided(self, buf, target: int, disp: int = 0,
+                    stride: int = 1) -> None:
+        """Fills buf with target elements at disp, disp+stride, ...
+        (the shmem_iget transport)."""
+        pvar.record("osc_get")
+        req = _WinRequest(self)
+        req_id = self._alloc_id()
+        self._pending[req_id] = ("get", (buf, req))
+        self._count_op(target)
+        arr = np.asarray(buf)
+        self._local_or_send(
+            target, ("gets", req_id, disp, int(stride), arr.size,
+                     arr.dtype.str))
+        req.wait()
 
     def Rget(self, buf, target: int, disp: int = 0) -> Request:
         req = _WinRequest(self)

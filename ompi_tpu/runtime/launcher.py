@@ -147,6 +147,7 @@ def launch(argv: Sequence[str], nprocs: int,
         return _wait_all(procs, timeout, store=store if ft else None)
     finally:
         reap(procs)
+        cleanup_shm(jobid)
         store.stop()
 
 
@@ -262,8 +263,26 @@ def run_daemon(ns) -> int:
         return rc
     finally:
         reap(procs)
+        cleanup_shm(ns.jobid)  # this host's rings/heaps
         if client is not None:
             client.close()
+
+
+def cleanup_shm(jobid: str) -> None:
+    """Reap job-scoped /dev/shm artifacts — btl/sm rings
+    (ompi_tpu_<jobid>_AtoB) and shmem symmetric heaps
+    (ompi_tpu_shmem_<jobid>_R) — that SIGKILLed or crashed ranks
+    could not unlink themselves. tmpfs is RAM: leaks accumulate until
+    reboot, so the supervising launcher/daemon sweeps them."""
+    import glob
+
+    d = os.environ.get("OMPI_TPU_SHM_DIR", "/dev/shm")
+    for pat in (f"ompi_tpu_{jobid}_*", f"ompi_tpu_shmem_{jobid}_*"):
+        for p in glob.glob(os.path.join(d, pat)):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
 
 def reap(procs: Sequence[subprocess.Popen],

@@ -73,15 +73,54 @@ class SymArray:
 
 class _Shmem:
     def __init__(self, heap_size: int) -> None:
+        import os
+
         from ompi_tpu import mpi, osc
+        from ompi_tpu.runtime import rte
 
         self.comm = mpi.Init()
-        self.heap_arr = np.zeros(heap_size, dtype=np.uint8)
+        # /dev/shm-backed heap (reference: sshmem/mmap symmetric
+        # segments) so same-host peers can shmem_ptr-map it directly
+        self._shm_dir = os.environ.get("OMPI_TPU_SHM_DIR", "/dev/shm")
+        self._shm_path = None
+        self.heap_arr = self._map_heap(rte.rank, heap_size,
+                                       create=True)
+        if self.heap_arr is None:  # no shm dir: private heap,
+            self.heap_arr = np.zeros(heap_size, dtype=np.uint8)
+            # shmem_ptr then degrades to None for every remote PE
         self.win = osc.win_create(self.comm, self.heap_arr, disp_unit=1)
         self.heap = self.heap_arr  # flat uint8 view
         self.brk = 0
+        # shmem_ptr peer maps: world rank -> np view (or None)
+        rte.modex_send("shmem_host", rte.hostname())
+        self._peer_maps = {}
         # session-long passive exposure: SHMEM one-sided is always legal
         self.win.Lock_all()
+
+    def _map_heap(self, world_rank: int, heap_size: int,
+                  create: bool):
+        import mmap
+        import os
+
+        from ompi_tpu.runtime import rte
+
+        if not os.path.isdir(self._shm_dir):
+            return None
+        path = os.path.join(
+            self._shm_dir, f"ompi_tpu_shmem_{rte.jobid}_{world_rank}")
+        try:
+            fd = os.open(path, os.O_RDWR | (os.O_CREAT if create
+                                            else 0), 0o600)
+        except OSError:
+            return None
+        try:
+            if create:
+                os.ftruncate(fd, heap_size)
+                self._shm_path = path
+            mm = mmap.mmap(fd, heap_size)
+        finally:
+            os.close(fd)
+        return np.frombuffer(mm, dtype=np.uint8)
 
 
 def _require() -> _Shmem:
@@ -102,6 +141,8 @@ def init(heap_size: Optional[int] = None) -> None:
 def finalize() -> None:
     global _state
     if _state is not None:
+        import os
+
         st = _state
         _state = None
         try:
@@ -109,6 +150,11 @@ def finalize() -> None:
             st.win.Free()
         except Exception:  # noqa: BLE001 — teardown best-effort
             pass
+        if st._shm_path:
+            try:
+                os.unlink(st._shm_path)
+            except OSError:
+                pass
 
 
 def my_pe() -> int:
@@ -146,13 +192,33 @@ def free(sym: SymArray) -> None:
 
 # -- RMA (shmem_put/get and friends over spml) -----------------------------
 
+def _win_put(win, dest: SymArray, value, pe: int, index: int) -> None:
+    data = np.ascontiguousarray(value, dtype=dest.dtype)
+    win.Put(data, pe, disp=dest.byte_disp(index))
+    pvar.record("shmem_put")
+
+
+def _win_get(win, src: SymArray, pe: int, count: Optional[int],
+             index: int) -> np.ndarray:
+    n = count if count is not None else int(np.prod(src.shape or (1,)))
+    out = np.empty(n, dtype=src.dtype)
+    win.Get(out, pe, disp=src.byte_disp(index))
+    pvar.record("shmem_get")
+    return out.reshape(src.shape if count is None else (n,))
+
+
+def _win_fetch_add(win, dest: SymArray, value, pe: int, index: int):
+    result = np.empty(1, dtype=dest.dtype)
+    win.Fetch_and_op(np.asarray([value], dtype=dest.dtype), result,
+                     pe, disp=dest.byte_disp(index), op=op_mod.SUM)
+    pvar.record("shmem_atomic")
+    return result[0]
+
+
 def put(dest: SymArray, value, pe: int, index: int = 0) -> None:
     """shmem_putmem: blocking-until-buffered put (delivery ordering to
     one PE preserved by the osc AM channel)."""
-    st = _require()
-    data = np.ascontiguousarray(value, dtype=dest.dtype)
-    st.win.Put(data, pe, disp=dest.byte_disp(index))
-    pvar.record("shmem_put")
+    _win_put(_require().win, dest, value, pe, index)
 
 
 def put_nbi(dest: SymArray, value, pe: int, index: int = 0):
@@ -167,12 +233,7 @@ def put_nbi(dest: SymArray, value, pe: int, index: int = 0):
 def get(src: SymArray, pe: int, count: Optional[int] = None,
         index: int = 0) -> np.ndarray:
     """shmem_getmem: blocking get; returns a fresh array."""
-    st = _require()
-    n = count if count is not None else int(np.prod(src.shape or (1,)))
-    out = np.empty(n, dtype=src.dtype)
-    st.win.Get(out, pe, disp=src.byte_disp(index))
-    pvar.record("shmem_get")
-    return out.reshape(src.shape if count is None else (n,))
+    return _win_get(_require().win, src, pe, count, index)
 
 
 def p(dest: SymArray, value, pe: int, index: int = 0) -> None:
@@ -183,6 +244,204 @@ def p(dest: SymArray, value, pe: int, index: int = 0) -> None:
 def g(src: SymArray, pe: int, index: int = 0):
     """shmem_g — single element."""
     return get(src, pe, count=1, index=index)[0]
+
+
+def iput(dest: SymArray, value, pe: int, tst: int = 1, sst: int = 1,
+         nelems: Optional[int] = None, index: int = 0) -> None:
+    """shmem_iput: strided put — element i of the (sst-strided) source
+    lands at target offset index + i*tst. One AM message (an
+    osc strided-put), not a per-element loop."""
+    st = _require()
+    src = np.ascontiguousarray(value, dtype=dest.dtype).reshape(-1)
+    if nelems == 0 or src.size == 0:
+        return  # SHMEM: zero elements moves nothing
+    if nelems is not None:
+        src = src[: (nelems - 1) * sst + 1]
+    data = np.ascontiguousarray(src[::sst])
+    st.win.Put_strided(data, pe, disp=dest.byte_disp(index),
+                       stride=tst)
+    pvar.record("shmem_put")
+
+
+def iget(src: SymArray, pe: int, nelems: int, tst: int = 1,
+         sst: int = 1, index: int = 0) -> np.ndarray:
+    """shmem_iget: strided get — reads nelems elements at target
+    stride sst starting at index; returns them packed at stride tst
+    in a fresh array (tst > 1 interleaves zeros, matching the
+    local-strided-destination semantics)."""
+    st = _require()
+    if nelems == 0:
+        return np.empty(0, dtype=src.dtype)
+    packed = np.empty(nelems, dtype=src.dtype)
+    st.win.Get_strided(packed, pe, disp=src.byte_disp(index),
+                       stride=sst)
+    pvar.record("shmem_get")
+    if tst == 1:
+        return packed
+    out = np.zeros((nelems - 1) * tst + 1, dtype=src.dtype)
+    out[::tst] = packed
+    return out
+
+
+# -- contexts (shmem_ctx_create — independent completion streams) ----------
+
+class Ctx:
+    """A SHMEM context (reference: oshmem/shmem/c/shmem_ctx*.c,
+    spml.h ctx entries): an independent ordering/completion stream.
+    Redesign: each context owns its own osc window over the SAME
+    symmetric heap — a private AM channel, so quiet() on one context
+    never waits for another's traffic (the reference's per-ctx UCX
+    worker, as an epoch scope).
+
+    DIVERGENCE from the SHMEM spec, documented: ctx_create is
+    COLLECTIVE here (window construction dups a communicator — every
+    PE must call it, in the same order). Standard SHMEM contexts are
+    local; a program creating contexts on a subset of PEs must use
+    the default context on the others or restructure."""
+
+    def __init__(self) -> None:
+        from ompi_tpu import osc
+
+        st = _require()
+        self.win = osc.win_create(st.comm, st.heap_arr, disp_unit=1)
+        self.win.Lock_all()
+        self._open = True
+
+    def put(self, dest: SymArray, value, pe: int,
+            index: int = 0) -> None:
+        _win_put(self.win, dest, value, pe, index)
+
+    def get(self, src: SymArray, pe: int, count: Optional[int] = None,
+            index: int = 0) -> np.ndarray:
+        return _win_get(self.win, src, pe, count, index)
+
+    def atomic_fetch_add(self, dest: SymArray, value, pe: int,
+                         index: int = 0):
+        return _win_fetch_add(self.win, dest, value, pe, index)
+
+    def quiet(self) -> None:
+        """Completes THIS context's outstanding ops only."""
+        self.win.Flush_all()
+
+    def fence(self) -> None:
+        progress.progress()
+
+    def destroy(self) -> None:
+        if self._open:
+            self._open = False
+            try:
+                self.win.Unlock_all()
+                self.win.Free()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+
+def ctx_create(options: int = 0) -> Ctx:
+    """shmem_ctx_create (options accepted for API parity; the private
+    window already gives SERIALIZED/PRIVATE semantics). COLLECTIVE —
+    every PE must call, in the same order (see Ctx docstring)."""
+    return Ctx()
+
+
+def ctx_destroy(ctx: Ctx) -> None:
+    ctx.destroy()
+
+
+# -- teams (SHMEM 1.5 shmem_team_* — sub-groups of PEs) --------------------
+
+class Team:
+    """A SHMEM team: an ordered subset of PEs with its own collectives
+    (reference: oshmem teams over scoll; here the team IS a
+    communicator, exactly the scoll/mpi delegation)."""
+
+    def __init__(self, comm) -> None:
+        self._comm = comm
+
+    def my_pe(self) -> int:
+        return self._comm.rank
+
+    def n_pes(self) -> int:
+        return self._comm.size
+
+    def translate_pe(self, pe: int, dest: "Team") -> int:
+        """shmem_team_translate_pe: -1 when absent (SHMEM convention)."""
+        from ompi_tpu.comm import UNDEFINED
+
+        out = self._comm.group.translate(pe, dest._comm.group)
+        return -1 if out == UNDEFINED else out
+
+    def world_pe(self, pe: int) -> int:
+        """World PE number of team member ``pe`` (for put/get, which
+        always address world PEs — SHMEM's TEAM_WORLD ranking)."""
+        st = _require()
+        return st.comm.group._index[self._comm.group.ranks[pe]]
+
+    def sync(self) -> None:
+        """shmem_team_sync = quiet + team barrier."""
+        quiet()
+        self._comm.Barrier()
+
+    def broadcast(self, dest: SymArray, source: SymArray,
+                  root: int) -> None:
+        if self._comm.rank == root:
+            dest.local[...] = source.local
+        self._comm.Bcast(dest.local, root=root)
+
+    def sum_to_all(self, dest: SymArray, source: SymArray) -> None:
+        self._comm.Allreduce(np.array(source.local, copy=True),
+                             dest.local, op=op_mod.SUM)
+
+    def destroy(self) -> None:
+        self._comm.free()
+
+
+def team_world() -> Team:
+    return Team(_require().comm)
+
+
+def team_split_strided(parent: Team, start: int, stride: int,
+                       size: int) -> Optional[Team]:
+    """shmem_team_split_strided: members are parent PEs start,
+    start+stride, ...; returns None on non-members (SHMEM returns
+    SHMEM_TEAM_INVALID)."""
+    members = [start + i * stride for i in range(size)]
+    me = parent._comm.rank
+    color = 0 if me in members else None
+    from ompi_tpu.comm import UNDEFINED
+
+    sub = parent._comm.split(
+        color if color is not None else UNDEFINED,
+        key=members.index(me) if me in members else 0)
+    return Team(sub) if sub is not None else None
+
+
+# -- shmem_ptr (direct same-host load/store access) ------------------------
+
+def ptr(sym: SymArray, pe: int) -> Optional[np.ndarray]:
+    """shmem_ptr: a live numpy view of PE ``pe``'s symmetric object
+    for direct load/store, or None when no such mapping exists
+    (different host, or no /dev/shm backing) — the reference returns
+    NULL exactly the same way. Same-host mapping attaches the peer's
+    sshmem segment (reference: oshmem/mca/sshmem/mmap)."""
+    st = _require()
+    from ompi_tpu.runtime import rte
+
+    world = st.comm.group.ranks[pe]
+    if world == rte.rank:
+        return sym.local
+    if world not in st._peer_maps:
+        heap = None
+        if (st._shm_path is not None
+                and rte.modex_recv("shmem_host", world)
+                == rte.hostname()):
+            heap = st._map_heap(world, st.heap.size, create=False)
+        st._peer_maps[world] = heap
+    heap = st._peer_maps[world]
+    if heap is None:
+        return None
+    nbytes = int(np.prod(sym.shape or (1,))) * sym.dtype.itemsize
+    flat = heap[sym.offset:sym.offset + nbytes]
+    return flat.view(sym.dtype).reshape(sym.shape)
 
 
 # -- memory ordering (shmem_fence/quiet) -----------------------------------
@@ -214,12 +473,7 @@ def wait_until(sym: SymArray, cmp: str, value, index: int = 0) -> None:
 # -- atomics (shmem_atomic_* over osc accumulate) --------------------------
 
 def atomic_fetch_add(dest: SymArray, value, pe: int, index: int = 0):
-    st = _require()
-    result = np.empty(1, dtype=dest.dtype)
-    st.win.Fetch_and_op(np.asarray([value], dtype=dest.dtype), result,
-                        pe, disp=dest.byte_disp(index), op=op_mod.SUM)
-    pvar.record("shmem_atomic")
-    return result[0]
+    return _win_fetch_add(_require().win, dest, value, pe, index)
 
 
 def atomic_add(dest: SymArray, value, pe: int, index: int = 0) -> None:

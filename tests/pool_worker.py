@@ -1,0 +1,56 @@
+"""Pooled test-rank worker: one persistent MPI job executes many test
+bodies (reference analog: the CI batches its whole mpi4py suite under
+one mpiexec, .github/workflows/ompi_mpi4py.yaml:115-141, instead of
+one process group per test).
+
+Protocol over the job's own kvstore:
+  pool:<jobid>:task:<i>        -> body source (or __POOL_SHUTDOWN__)
+  pool:<jobid>:res:<i>:<rank>  -> ("ok", None) | ("err", traceback)
+
+Bodies run with the same globals the per-test harness prelude
+provides (np/mpi/comm/rank/size). A failed body poisons the pool — the
+harness kills it and never reuses it (collectives the failing rank
+skipped would leave peers desynchronized).
+"""
+
+import sys
+import traceback
+
+import numpy as np
+
+
+def main() -> int:
+    from ompi_tpu import mpi
+    from ompi_tpu.runtime import rte
+
+    comm = mpi.Init()
+    client = rte.client()
+    prefix = f"pool:{rte.jobid}"
+    i = 0
+    while True:
+        task = client.get(f"{prefix}:task:{i}", wait=True)
+        if task == "__POOL_SHUTDOWN__":
+            break
+        g = {"np": np, "mpi": mpi, "comm": comm,
+             "rank": comm.rank, "size": comm.size,
+             "__name__": f"pool_task_{i}"}
+        from ompi_tpu.core import pvar
+
+        pvar.reset()  # per-body counters, as a fresh process would see
+        try:
+            exec(compile(task, f"<pool-task-{i}>", "exec"), g)
+            res = ("ok", None)
+        except SystemExit as e:  # bodies use sys.exit(0) to skip
+            code = 0 if e.code in (None, 0) else e.code
+            res = ("ok", None) if code == 0 else (
+                "err", f"sys.exit({code})")
+        except BaseException:  # noqa: BLE001 — reported to the harness
+            res = ("err", traceback.format_exc())
+        client.put(f"{prefix}:res:{i}:{comm.rank}", res)
+        i += 1
+    mpi.Finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -143,3 +143,34 @@ def test_multihost_ft_cross_host_kill():
         sub.Allreduce(np.full(4, 1.0, np.float32), out)
         assert (out == 3).all()
     """, TWO_HOSTS, mca={"ft": "1"}, timeout=120)
+
+
+def test_multihost_device_plane_collectives():
+    """The distributed device plane spans the (fake-)host boundary:
+    jax.distributed bootstraps through the cross-host store, and
+    coll/xla executes device collectives with zero staging — the
+    forced 2-slice hierarchy (coll_xla_hier=2) makes the compiled
+    program the two-level ICI x DCN composition matching the 2-host
+    layout (the pod-analog of coll/han)."""
+    run_hosts("""
+        import jax.numpy as jnp
+        from ompi_tpu.core import pvar
+        r = comm.Allreduce(jnp.full(8, float(rank + 1), jnp.float32))
+        assert np.asarray(r)[0] == 10.0
+        # also the ragged + nonblocking device paths across hosts
+        counts = [1, 2, 1, 2]
+        packed = comm.Allgatherv(
+            jnp.full(counts[rank], float(rank), jnp.float32), None,
+            counts)
+        exp = np.concatenate([np.full(c, float(i), np.float32)
+                              for i, c in enumerate(counts)])
+        np.testing.assert_array_equal(np.asarray(packed), exp)
+        req = comm.Iallreduce(jnp.ones(4, jnp.float32))
+        req.wait()
+        assert np.asarray(req.array)[0] == 4.0
+        assert pvar.read("coll_accelerator_staged") == 0
+        assert pvar.read("coll_xla_device") >= 3
+        ctx = comm._coll_xla_ctx
+        assert ctx.mesh2d is not None, "forced 2-slice hierarchy"
+        assert ctx.mesh2d.devices.shape == (2, 2)
+    """, TWO_HOSTS, mca={"device_plane": "on", "coll_xla_hier": "2"})

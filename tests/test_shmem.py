@@ -155,3 +155,123 @@ def test_alltoall_collect_and_reductions():
         assert p.local[0] == math.prod(range(2, n + 2)), p.local
         shmem.finalize()
     """, 3, timeout=180)
+
+
+def test_ctx_independent_streams():
+    """shmem_ctx_create: per-context windows give independent
+    ordering/completion — ctx.quiet() completes only that context's
+    traffic; values land correctly on both streams."""
+    run_ranks("""
+    from ompi_tpu import shmem
+    shmem.init()
+    me, n = shmem.my_pe(), shmem.n_pes()
+    a = shmem.zeros(n, np.int64)
+    b = shmem.zeros(n, np.int64)
+    ctx = shmem.ctx_create()
+    nxt = (me + 1) % n
+    shmem.put(a, np.asarray([me + 1], np.int64), nxt, index=me)
+    ctx.put(b, np.asarray([10 * (me + 1)], np.int64), nxt, index=me)
+    ctx.quiet()
+    shmem.quiet()
+    shmem.barrier_all()
+    prev = (me - 1) % n
+    assert a.local[prev] == prev + 1
+    assert b.local[prev] == 10 * (prev + 1)
+    # peer nxt's slot me was written by ME (value me+1)
+    got = ctx.get(a, nxt)
+    assert got[me] == me + 1, got
+    # add 5 to peer nxt's (empty) slot nxt; my slot me then holds 5
+    x = ctx.atomic_fetch_add(a, 5, nxt, index=nxt)
+    assert x == 0
+    shmem.barrier_all()
+    assert a.local[me] == 5, a.local
+    shmem.ctx_destroy(ctx)
+    shmem.barrier_all()
+    shmem.finalize()
+    """, 3)
+
+
+def test_strided_iput_iget():
+    run_ranks("""
+    from ompi_tpu import shmem
+    shmem.init()
+    me, n = shmem.my_pe(), shmem.n_pes()
+    dst = shmem.zeros(12, np.float64)
+    nxt = (me + 1) % n
+    # every 3rd slot of the target gets [me, me+1, me+2, me+3]
+    shmem.iput(dst, np.arange(4, dtype=np.float64) + me, nxt, tst=3)
+    shmem.quiet()
+    shmem.barrier_all()
+    prev = (me - 1) % n
+    exp = np.zeros(12)
+    exp[::3] = np.arange(4) + prev
+    np.testing.assert_array_equal(dst.local, exp)
+    # strided read-back: every 3rd element of the peer's dst
+    got = shmem.iget(dst, nxt, nelems=4, sst=3)
+    np.testing.assert_array_equal(got, np.arange(4) + me)
+    # source stride: take every 2nd element of an 8-vector
+    src8 = np.arange(8, dtype=np.float64) * 10
+    shmem.barrier_all()
+    shmem.iput(dst, src8, nxt, tst=1, sst=2, nelems=4)
+    shmem.quiet()
+    shmem.barrier_all()
+    np.testing.assert_array_equal(dst.local[:4], src8[::2])
+    shmem.barrier_all()
+    shmem.finalize()
+    """, 2)
+
+
+def test_shmem_ptr_same_host():
+    """shmem_ptr: direct load/store view of a same-host peer's heap
+    (mmap sshmem segment); remote puts are visible through it."""
+    run_ranks("""
+    from ompi_tpu import shmem
+    shmem.init()
+    me, n = shmem.my_pe(), shmem.n_pes()
+    sym = shmem.zeros(4, np.int32)
+    sym.local[:] = 100 + me
+    shmem.barrier_all()
+    nxt = (me + 1) % n
+    view = shmem.ptr(sym, nxt)
+    assert view is not None, "same-host peers must be mappable"
+    np.testing.assert_array_equal(view, np.full(4, 100 + nxt))
+    # direct store through the pointer, visible at the owner
+    view[0] = 7000 + me
+    shmem.barrier_all()
+    prev = (me - 1) % n
+    assert sym.local[0] == 7000 + prev, sym.local
+    # self-ptr is the local view
+    assert shmem.ptr(sym, me) is not None
+    shmem.barrier_all()
+    shmem.finalize()
+    """, 3)
+
+
+def test_teams_split_and_collectives():
+    """SHMEM 1.5 teams: strided split, PE translation, team sync and
+    team reductions (reference: oshmem teams over scoll)."""
+    run_ranks("""
+    from ompi_tpu import shmem
+    shmem.init()
+    me, n = shmem.my_pe(), shmem.n_pes()
+    world = shmem.team_world()
+    assert world.my_pe() == me and world.n_pes() == n
+    evens = shmem.team_split_strided(world, 0, 2, (n + 1) // 2)
+    if me % 2 == 0:
+        assert evens is not None
+        assert evens.my_pe() == me // 2
+        assert evens.world_pe(evens.my_pe()) == me
+        assert world.translate_pe(me, evens) == me // 2
+        s = shmem.zeros(2, np.int64)
+        d = shmem.zeros(2, np.int64)
+        s.local[:] = me + 1
+        evens.sync()
+        evens.sum_to_all(d, s)
+        exp = sum(r + 1 for r in range(0, n, 2))
+        assert (d.local == exp).all(), d.local
+        evens.destroy()
+    else:
+        assert evens is None
+    shmem.barrier_all()
+    shmem.finalize()
+    """, 4)

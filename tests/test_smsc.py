@@ -84,7 +84,7 @@ def test_offer_declined_falls_back_to_streaming():
             comm.Recv((dst, 1, vec), source=0, tag=2)
             assert np.array_equal(dst[:, :16], src[:, :16])
             assert pvar.read("smsc_single_copies") == 0
-    """, 2, timeout=120)
+    """, 2, timeout=120, isolate=True)  # smsc.disqualify is process-permanent
 
 
 def test_many_large_messages_both_directions():

@@ -71,7 +71,7 @@ def test_replay_reconstructs_lost_data():
                 replayed.append(rb.copy())
             for p, r in zip(payloads, replayed):
                 assert np.array_equal(p, r)
-    """, 2, mca={"pml_v": "1"}, timeout=120)
+    """, 2, mca={"pml_v": "1"}, timeout=120, isolate=True)  # send-log replay counts assume a fresh log
 
 
 def test_determinant_persistence_and_truncation(tmp_path):
