@@ -1,0 +1,33 @@
+"""MPI-4 sessions: communicators without a world model.
+
+Reference analog: the Sessions examples of MPI-4 — query process
+sets, derive groups, build communicators; MPI_COMM_WORLD never
+exists.
+
+Run:  python -m ompi_tpu.runtime.launcher -n 4 examples/sessions.py
+"""
+
+import numpy as np
+
+from ompi_tpu import mpi
+from ompi_tpu.runtime import state
+
+session = mpi.Session_init({"thread_level": "single"})
+assert not state.is_initialized()  # no world model
+
+names = [session.get_nth_pset(i) for i in range(session.num_psets())]
+group = mpi.Group_from_session_pset(session, "mpi://WORLD")
+comm = session.comm_from_group(group, "examples.sessions")
+
+out = np.zeros(1, np.int64)
+comm.Allreduce(np.array([comm.rank + 1], np.int64), out)
+if comm.rank == 0:
+    print(f"psets: {names}")
+    print(f"sessions-only allreduce over {comm.size} ranks -> {out[0]}")
+
+# node-local sub-communicator from the host pset
+host_group = session.group_from_pset("ompi_tpu://HOST")
+host_comm = session.comm_from_group(host_group, "examples.host")
+print(f"rank {comm.rank}: {host_comm.size} rank(s) on my host")
+
+session.finalize()

@@ -330,6 +330,48 @@ def _is_dev(buf) -> bool:
     return accelerator.is_device_buffer(buf)
 
 
+def _Pack(self, inbuf, outbuf, position: int = 0) -> int:
+    """MPI_Pack: append inbuf's packed bytes into outbuf at position;
+    returns the new position (reference: ompi/mpi/c/pack.c over the
+    convertor — same engine here)."""
+    from ompi_tpu.datatype.convertor import Convertor
+
+    arr, count, dt = _parse_buf(inbuf)
+    data = Convertor(arr, dt, count).pack()
+    out = memoryview(outbuf).cast("B") if not isinstance(
+        outbuf, memoryview) else outbuf.cast("B")
+    if position + len(data) > len(out):
+        raise errors.TruncateError(
+            f"Pack: need {position + len(data)} bytes, outbuf has "
+            f"{len(out)}")
+    out[position:position + len(data)] = data
+    return position + len(data)
+
+
+def _Unpack(self, inbuf, position: int, outbuf) -> int:
+    """MPI_Unpack: consume packed bytes from inbuf at position into
+    outbuf; returns the new position."""
+    from ompi_tpu.datatype.convertor import Convertor
+
+    arr, count, dt = _parse_buf(outbuf)
+    conv = Convertor(arr, dt, count)
+    src = memoryview(inbuf).cast("B")
+    need = conv.packed_size
+    if position + need > len(src):
+        raise errors.TruncateError(
+            f"Unpack: need {need} bytes at position {position}, inbuf "
+            f"has {len(src)}")
+    conv.unpack(bytes(src[position:position + need]))
+    return position + need
+
+
+def _Pack_size(self, count: int, dtype) -> int:
+    """MPI_Pack_size: an upper bound on Pack output bytes."""
+    dt = dtype if isinstance(dtype, Datatype) else dtype_of(
+        np.empty(0, dtype))
+    return count * dt.size
+
+
 def _require_packed_displs(counts, displs, what: str) -> None:
     """Device v-variants slice the send buffer as PACKED segments; a
     caller-supplied send-side displacement layout would silently move
@@ -839,6 +881,7 @@ _API = {
     "Improbe": _Improbe, "Mrecv": _Mrecv,
     "Send_init": _Send_init, "Recv_init": _Recv_init,
     "Barrier": _Barrier, "barrier": _barrier,
+    "Pack": _Pack, "Unpack": _Unpack, "Pack_size": _Pack_size,
     "Bcast": _Bcast, "bcast": _bcast,
     "Reduce": _Reduce, "reduce": _reduce,
     "Allreduce": _Allreduce, "allreduce": _allreduce,

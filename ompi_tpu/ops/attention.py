@@ -41,6 +41,39 @@ def mha(q, k, v, causal: bool = True, scale: Optional[float] = None,
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
+def mha_auto(q, k, v, causal: bool = True,
+             scale: Optional[float] = None):
+    """mha with the TPU fast path: the pallas flash-attention kernel
+    (jax.experimental.pallas.ops.tpu) when tracing for TPU and shapes
+    satisfy its tiling (head_dim/seq multiples of the MXU tile) —
+    avoids materializing the [B,H,T,T] score tensor in HBM, the main
+    memory-traffic term of the reference mha. Falls back to the
+    reference implementation off-TPU or on any constraint miss, so
+    CPU tests and the distributed ring path are unaffected.
+
+    Measured (v5e, B4 T1024 H40 D128): the kernel is ~4% slower than
+    XLA's fused reference at this short-sequence shape — use it for
+    long-context single-device attention where the T x T score
+    materialization dominates, not as a blanket default."""
+    import jax
+
+    d = q.shape[-1]
+    if (jax.default_backend() == "tpu" and d % 128 == 0
+            and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0):
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention)
+
+            sm = scale if scale is not None else 1.0 / float(d) ** 0.5
+            out = flash_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=causal, sm_scale=sm)
+            return out.transpose(0, 2, 1, 3).astype(q.dtype)
+        except Exception:  # noqa: BLE001 — kernel constraints vary by
+            pass           # jax version; the reference is always valid
+    return mha(q, k, v, causal=causal, scale=scale)
+
+
 def online_softmax_block(q, k, v, o, l, m, mask=None,
                          scale: Optional[float] = None):
     """One flash-attention accumulation step over a KV block.

@@ -592,9 +592,87 @@ class Window:
         self.comm.free()
 
 
+class SharedWindow(Window):
+    """MPI_Win_allocate_shared (reference: osc/sm): the window's local
+    region lives in a /dev/shm segment, and :meth:`Shared_query`
+    returns a direct load/store numpy view of any peer's region —
+    zero-copy same-host RMA. All members must share a host (create
+    via split_type('shared'), per the standard's intent)."""
+
+    def __init__(self, comm, nbytes: int, disp_unit: int = 1) -> None:
+        import mmap
+        import os
+
+        from ompi_tpu.runtime import rte
+
+        hosts = comm.coll.allgather_obj(comm, rte.hostname())
+        if len(set(hosts)) != 1:
+            raise ValueError(
+                "Win_allocate_shared: members span hosts "
+                f"{sorted(set(hosts))}; use comm.split_type('shared') "
+                "to get a node-local communicator first")
+        wid = comm.coll.bcast_obj(
+            comm, rte.next_id("winshm") if comm.rank == 0 else None, 0)
+        self._seg_dir = os.environ.get("OMPI_TPU_SHM_DIR", "/dev/shm")
+        self._seg_fmt = os.path.join(
+            self._seg_dir, f"ompi_tpu_{rte.jobid}_winshm{wid}_{{}}")
+        self._seg_nbytes = nbytes
+        self._peer_views: Dict[int, np.ndarray] = {}
+        path = self._seg_fmt.format(comm.rank)
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        try:
+            os.ftruncate(fd, max(nbytes, 1))
+            mm = mmap.mmap(fd, max(nbytes, 1))
+        finally:
+            os.close(fd)
+        base = np.frombuffer(mm, dtype=np.uint8, count=nbytes)
+        # Window.__init__ ends with a barrier: every segment exists
+        # before any Shared_query can try to map it
+        super().__init__(comm, base, disp_unit)
+
+    def Shared_query(self, rank: int):
+        """(live numpy view of rank's region, disp_unit) — the direct
+        load/store path; AM Put/Get still work for uniformity."""
+        if rank == self.rank:
+            return self.base, self.disp_unit
+        view = self._peer_views.get(rank)
+        if view is None:
+            import mmap
+            import os
+
+            # the PEER's size — per-rank sizes are legal
+            # (MPI_Win_allocate_shared), and mapping past a smaller
+            # peer file would SIGBUS on access
+            peer_nbytes = self.peer_info[rank][0]
+            fd = os.open(self._seg_fmt.format(rank), os.O_RDWR)
+            try:
+                mm = mmap.mmap(fd, max(peer_nbytes, 1))
+            finally:
+                os.close(fd)
+            view = np.frombuffer(mm, dtype=np.uint8,
+                                 count=peer_nbytes)
+            self._peer_views[rank] = view
+        return view, self.peer_info[rank][1]
+
+    def Free(self) -> None:
+        import os
+
+        super().Free()
+        try:
+            os.unlink(self._seg_fmt.format(self.rank))
+        except OSError:
+            pass
+
+
 def win_create(comm, base: np.ndarray, disp_unit: int = 1) -> Window:
     """MPI_Win_create."""
     return Window(comm, base, disp_unit)
+
+
+def win_allocate_shared(comm, nbytes: int,
+                        disp_unit: int = 1) -> SharedWindow:
+    """MPI_Win_allocate_shared."""
+    return SharedWindow(comm, nbytes, disp_unit)
 
 
 def win_allocate(comm, shape, dtype=np.uint8,

@@ -145,6 +145,12 @@ def init(thread_level: int = 0):
 
         if _ft_detector.enabled() and rte.size > 1:
             _ft_detector.start()
+        # init hooks last: everything (comms, transports) is up
+        # (reference: hook framework callbacks at the end of
+        # ompi_mpi_init)
+        from ompi_tpu.core import hook as _hook
+
+        _hook.run_init(_world)
         _initialized = True
         return _world
 
@@ -173,6 +179,9 @@ def finalize() -> None:
         # sessions (a later Init must raise even while a session keeps
         # the instance alive)
         _finalized = True
+        from ompi_tpu.core import hook as _hook
+
+        _hook.run_finalize()
         from ompi_tpu.ft import detector as _ft_detector
 
         try:

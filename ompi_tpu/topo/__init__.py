@@ -261,6 +261,42 @@ def _Create_graph(self, index: Sequence[int], edges: Sequence[int],
     return _attach(sub, GraphTopo(index, edges))
 
 
+def _Create_dist_graph(self, sources: Sequence[int],
+                       degrees: Sequence[int],
+                       destinations: Sequence[int],
+                       reorder: bool = False) -> Communicator:
+    """MPI_Dist_graph_create (the general form): every rank may
+    contribute ARBITRARY edges — (sources[i], degrees[i]) says source
+    vertex sources[i] owns the next degrees[i] entries of
+    destinations. Contributions are gathered, redistributed into
+    per-vertex adjacency, then placed like the adjacent form
+    (reference: ompi/mca/topo/base/topo_base_dist_graph_create.c)."""
+    contrib = self.allgather(
+        (list(sources), list(degrees), list(destinations)))
+    outs = {r: [] for r in range(self.size)}
+    ins = {r: [] for r in range(self.size)}
+    for srcs, degs, dsts in contrib:
+        i = 0
+        for s, d in zip(srcs, degs):
+            for dst in dsts[i:i + d]:
+                outs[s].append(dst)
+                ins[dst].append(s)
+            i += d
+    key = self.rank
+    if reorder and self.size > 1:
+        from ompi_tpu.topo import reorder as reorder_mod
+
+        w = np.zeros((self.size, self.size))
+        for s in range(self.size):
+            for d in outs[s]:
+                w[s, d] += 1.0
+        perm = reorder_mod.permute_for(self, w)
+        if perm is not None:
+            key = perm.index(self.rank)
+    sub = self.split(0, key=key)
+    return _attach(sub, DistGraphTopo(ins[key], outs[key]))
+
+
 def _Create_dist_graph_adjacent(
         self, sources: Sequence[int], destinations: Sequence[int],
         reorder: bool = False) -> Communicator:
@@ -342,6 +378,7 @@ _API = {
     "Cart_shift": _Cart_shift,
     "Cart_get": _Cart_get,
     "Create_graph": _Create_graph,
+    "Create_dist_graph": _Create_dist_graph,
     "Create_dist_graph_adjacent": _Create_dist_graph_adjacent,
     "Graph_neighbors": _Graph_neighbors,
     "Dist_graph_neighbors": _Dist_graph_neighbors,

@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 from ompi_tpu import op
+from tests.harness import run_ranks
 from ompi_tpu.datatype import (
     BFLOAT16, DOUBLE, FLOAT, FLOAT_INT, INT32, Convertor, contiguous,
     create_struct, hindexed, indexed, resized, subarray, vector,
@@ -203,3 +204,33 @@ def test_pack_external32_roundtrip():
         raise AssertionError("datarep check missing")
     except errors.MPIError:
         pass
+
+
+def test_mpi_pack_unpack_roundtrip():
+    """MPI_Pack/Unpack over the convertor (ompi/mpi/c/pack.c analog),
+    including a non-contiguous derived type."""
+    run_ranks("""
+        from ompi_tpu.datatype import datatype as dt
+        a = np.arange(6, dtype=np.int32)
+        b = np.linspace(0, 1, 4, dtype=np.float64)
+        size = (comm.Pack_size(6, dt.INT32) + comm.Pack_size(4, dt.DOUBLE))
+        buf = bytearray(size)
+        pos = comm.Pack(a, buf, 0)
+        pos = comm.Pack(b, buf, pos)
+        assert pos == size
+        a2 = np.zeros_like(a)
+        b2 = np.zeros_like(b)
+        pos = comm.Unpack(buf, 0, a2)
+        pos = comm.Unpack(buf, pos, b2)
+        np.testing.assert_array_equal(a, a2)
+        np.testing.assert_array_equal(b, b2)
+        # derived vector type: pack gathers the strided elements
+        vec = dt.vector(3, 2, 4, dt.INT32)
+        src = np.arange(12, dtype=np.int32)
+        out = bytearray(comm.Pack_size(1, vec))
+        end = comm.Pack((src, 1, vec), out, 0)
+        assert end == vec.size
+        got = np.frombuffer(bytes(out[:end]), np.int32)
+        np.testing.assert_array_equal(
+            got, [0, 1, 4, 5, 8, 9])
+    """, 1)

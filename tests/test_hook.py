@@ -1,0 +1,46 @@
+"""hook framework — init/finalize interception + comm_method matrix
+(reference: ompi/mca/hook, the comm_method transport table)."""
+
+from tests.harness import run_ranks
+
+
+def test_hooks_run_at_init_and_finalize():
+    run_ranks("""
+        import sys
+        from ompi_tpu.core import hook
+        from ompi_tpu import mpi as mpi_mod
+
+        fired = {"init": None, "fini": 0}
+        hook.register(
+            at_init=lambda world: fired.__setitem__(
+                "init", (world.rank, world.size)),
+            at_finalize=lambda: fired.__setitem__("fini", 1))
+        comm = mpi_mod.Init()
+        assert fired["init"] == (comm.rank, comm.size), fired
+        mpi_mod.Finalize()
+        assert fired["fini"] == 1
+        sys.exit(0)
+    """, 2, prelude=False)
+
+
+def test_comm_method_matrix_prints():
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as fh:
+        fh.write("from ompi_tpu import mpi\n"
+                 "mpi.Init()\nmpi.Finalize()\n")
+        path = fh.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.runtime.launcher", "-n",
+             "2", "--mca", "hook_comm_method", "1", path],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "transport matrix" in proc.stderr, proc.stderr
+        assert "self" in proc.stderr
+    finally:
+        os.unlink(path)

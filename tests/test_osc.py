@@ -201,3 +201,30 @@ def test_host_window_device_array_errors():
         raise AssertionError("device_array on host window must raise")
     win.Free()
     """, 2)
+
+
+def test_win_allocate_shared_direct_access():
+    """MPI_Win_allocate_shared (osc/sm analog): Shared_query gives a
+    direct load/store view of a peer's /dev/shm region; AM-path Put
+    and direct stores see the same memory."""
+    run_ranks("""
+    from ompi_tpu import osc
+    win = osc.win_allocate_shared(comm, nbytes=64, disp_unit=1)
+    mine, du = win.Shared_query(comm.rank)
+    assert du == 1 and mine.size == 64
+    mine[:] = comm.rank
+    win.Fence()
+    peer = (comm.rank + 1) % comm.size
+    view, _ = win.Shared_query(peer)
+    assert (view[:8] == peer).all(), view[:8]
+    # direct store into the peer's region, visible to the owner
+    view[8] = 200 + comm.rank
+    win.Fence()
+    prev = (comm.rank - 1) % comm.size
+    assert mine[8] == 200 + prev, mine[8]
+    # the AM path shares the same memory
+    win.Put(np.full(4, 99, np.uint8), target=peer, disp=16)
+    win.Fence()
+    assert (mine[16:20] == 99).all()
+    win.Free()
+    """, 3)

@@ -264,3 +264,25 @@ def test_dist_graph_reorder_places_heavy_edges_on_neighbors():
         for a, b in ((0, 2), (2, 1), (1, 3)):
             assert abs(pos[a] - pos[b]) == 1, (ids, pos, a, b)
     """, 4, mca={"device_plane": "on"})
+
+
+def test_dist_graph_create_general():
+    """MPI_Dist_graph_create: arbitrary per-rank edge contributions
+    are redistributed into each vertex's adjacency."""
+    run_ranks("""
+        # rank 0 contributes ALL edges of a ring; others contribute none
+        if rank == 0:
+            srcs = list(range(size))
+            degs = [1] * size
+            dsts = [(s + 1) % size for s in range(size)]
+        else:
+            srcs, degs, dsts = [], [], []
+        dg = comm.Create_dist_graph(srcs, degs, dsts)
+        ins, outs = dg.Dist_graph_neighbors()
+        assert list(outs) == [(rank + 1) % size], outs
+        assert list(ins) == [(rank - 1) % size], ins
+        # neighborhood collective over the redistributed graph
+        recv = np.zeros(2, np.float64)
+        dg.Neighbor_allgather(np.full(2, float(rank)), recv)
+        assert (recv == (rank - 1) % size).all(), recv
+    """, 4)
