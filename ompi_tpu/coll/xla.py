@@ -718,6 +718,9 @@ class DeviceRequest:
     def free(self) -> None:
         pass
 
+    def retrieve_status(self):
+        return self.status
+
 
 def ibarrier_dev(comm):
     """Nonblocking device barrier: the 1-element psum is dispatched;

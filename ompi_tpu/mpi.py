@@ -335,11 +335,11 @@ def _Pack(self, inbuf, outbuf, position: int = 0) -> int:
     returns the new position (reference: ompi/mpi/c/pack.c over the
     convertor — same engine here)."""
     from ompi_tpu.datatype.convertor import Convertor
+    from ompi_tpu.datatype.datatype import BYTE
 
     arr, count, dt = _parse_buf(inbuf)
-    data = Convertor(arr, dt, count).pack()
-    out = memoryview(outbuf).cast("B") if not isinstance(
-        outbuf, memoryview) else outbuf.cast("B")
+    data = Convertor(arr, dt or BYTE, count).pack()
+    out = memoryview(outbuf).cast("B")
     if position + len(data) > len(out):
         raise errors.TruncateError(
             f"Pack: need {position + len(data)} bytes, outbuf has "
@@ -352,9 +352,10 @@ def _Unpack(self, inbuf, position: int, outbuf) -> int:
     """MPI_Unpack: consume packed bytes from inbuf at position into
     outbuf; returns the new position."""
     from ompi_tpu.datatype.convertor import Convertor
+    from ompi_tpu.datatype.datatype import BYTE
 
     arr, count, dt = _parse_buf(outbuf)
-    conv = Convertor(arr, dt, count)
+    conv = Convertor(arr, dt or BYTE, count)
     src = memoryview(inbuf).cast("B")
     need = conv.packed_size
     if position + need > len(src):
@@ -955,6 +956,13 @@ def Init():
     from ompi_tpu.runtime import state
 
     return state.init()
+
+
+def Grequest_start(query_fn=None, free_fn=None, cancel_fn=None):
+    """MPI_Grequest_start: returns a request the application completes
+    with req.complete() (MPI_Grequest_complete). Works with
+    wait/test/wait_all like any other request."""
+    return rq.GeneralizedRequest(query_fn, free_fn, cancel_fn)
 
 
 def Session_init(info=None):

@@ -81,11 +81,66 @@ class Request:
     def _cancel(self) -> None:  # best-effort; recv-only in practice
         pass
 
+    def retrieve_status(self) -> Status:
+        """The Status as handed to the caller at completion — the hook
+        point generalized requests use to run query_fn before the
+        status escapes (plural wait/test forms call this)."""
+        return self.status
+
     def start(self) -> None:  # persistent requests override
         raise RuntimeError("not a persistent request")
 
     def free(self) -> None:
         pass
+
+
+class GeneralizedRequest(Request):
+    """MPI_Grequest_start (reference: ompi/request/grequest.c): an
+    application-defined operation exposed as an MPI request. The app
+    calls :meth:`complete` (MPI_Grequest_complete) when its operation
+    finishes; query_fn fills the Status at wait/test success, free_fn
+    runs at free, cancel_fn(completed) at cancel."""
+
+    def __init__(self, query_fn=None, free_fn=None,
+                 cancel_fn=None) -> None:
+        super().__init__()
+        self._query_fn = query_fn
+        self._free_fn = free_fn
+        self._cancel_fn = cancel_fn
+        self._queried = False
+
+    def _maybe_query(self) -> None:
+        if self.completed and not self._queried:
+            self._queried = True
+            if self._query_fn is not None:
+                self._query_fn(self.status)
+
+    def retrieve_status(self) -> Status:
+        self._maybe_query()
+        return self.status
+
+    def test(self) -> bool:
+        done = super().test()
+        if done:
+            self._maybe_query()
+        return done
+
+    def wait(self, timeout=None):
+        st = super().wait(timeout)
+        self._maybe_query()
+        return st
+
+    def _cancel(self) -> None:
+        if self._cancel_fn is not None:
+            self._cancel_fn(self.completed)
+        if not self.completed:
+            self.status.cancelled = True
+            self.complete()
+
+    def free(self) -> None:
+        if self._free_fn is not None:
+            fn, self._free_fn = self._free_fn, None
+            fn()
 
 
 class CompletedRequest(Request):
@@ -107,7 +162,7 @@ def wait_all(reqs: Sequence[Request],
                         timeout=timeout)
     if not all(r.completed for r in reqs):
         raise TimeoutError("waitall timed out")
-    return [r.status for r in reqs]
+    return [r.retrieve_status() for r in reqs]
 
 
 def wait_any(reqs: Sequence[Request]) -> int:

@@ -81,14 +81,30 @@ def parse_hostfile(path: str) -> List[HostSpec]:
     return hosts
 
 
+def _bind_core_for(local_rank: int, bind_to: str) -> Optional[int]:
+    """CPU core for a local rank under --bind-to core (the
+    PRRTE-binding analog: round-robin over this host's allowed set).
+    The rank applies it via sched_setaffinity at rte.init."""
+    if bind_to != "core":
+        return None
+    try:
+        cores = sorted(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        return None
+    return cores[local_rank % len(cores)]
+
+
 def build_env(rank: int, size: int, store_addr, jobid: str,
               mca: Optional[Dict[str, str]] = None,
               base_env: Optional[Dict[str, str]] = None,
               local_rank: Optional[int] = None,
               local_size: Optional[int] = None,
               hostname: Optional[str] = None,
-              bind_addr: Optional[str] = None) -> Dict[str, str]:
+              bind_addr: Optional[str] = None,
+              bind_core: Optional[int] = None) -> Dict[str, str]:
     env = dict(base_env if base_env is not None else os.environ)
+    if bind_core is not None:
+        env["OMPI_TPU_BIND_CORE"] = str(bind_core)
     env["OMPI_TPU_RANK"] = str(rank)
     env["OMPI_TPU_SIZE"] = str(size)
     env["OMPI_TPU_LOCAL_RANK"] = str(
@@ -123,7 +139,8 @@ def build_env(rank: int, size: int, store_addr, jobid: str,
 
 def launch(argv: Sequence[str], nprocs: int,
            mca: Optional[Dict[str, str]] = None,
-           timeout: Optional[float] = None) -> int:
+           timeout: Optional[float] = None,
+           bind_to: str = "none") -> int:
     """Spawn nprocs ranks running ``python argv...``; returns exit code.
 
     FT mode (``--mca ft 1``): a rank killed by a signal is declared
@@ -142,7 +159,8 @@ def launch(argv: Sequence[str], nprocs: int,
     procs: List[subprocess.Popen] = []
     try:
         for r in range(nprocs):
-            env = build_env(r, nprocs, store.addr, jobid, mca)
+            env = build_env(r, nprocs, store.addr, jobid, mca,
+                            bind_core=_bind_core_for(r, bind_to))
             procs.append(subprocess.Popen(list(argv), env=env))
         return _wait_all(procs, timeout, store=store if ft else None)
     finally:
@@ -168,7 +186,8 @@ def launch_hosts(argv: Sequence[str], hosts: Sequence[HostSpec],
                  mca: Optional[Dict[str, str]] = None,
                  timeout: Optional[float] = None,
                  agent: str = "local",
-                 bind: Optional[str] = None) -> int:
+                 bind: Optional[str] = None,
+                 bind_to: str = "none") -> int:
     """Multi-host launch: one daemon per host (prted analog), each
     forking its local rank block. Reference: prterun starting prted
     daemons which fork/exec the ranks per node (SURVEY §3.2);
@@ -189,6 +208,8 @@ def launch_hosts(argv: Sequence[str], hosts: Sequence[HostSpec],
                    "--local-n", str(h.slots), "--world-size", str(total)]
             if h.addr:
                 cmd += ["--bind-addr", h.addr]
+            if bind_to != "none":
+                cmd += ["--bind-to", bind_to]
             if timeout is not None:
                 cmd += ["--timeout", str(timeout)]
             for k, v in (mca or {}).items():
@@ -253,7 +274,8 @@ def run_daemon(ns) -> int:
                             ns.jobid, mca, local_rank=i,
                             local_size=ns.local_n,
                             hostname=ns.host_name,
-                            bind_addr=ns.bind_addr)
+                            bind_addr=ns.bind_addr,
+                            bind_core=_bind_core_for(i, ns.bind_to))
             procs.append(subprocess.Popen(argv, env=env))
         rc, clean = _wait_stats(procs, ns.timeout, store=client,
                                 rank_base=ns.rank_base,
@@ -383,6 +405,10 @@ def main(args: Optional[Sequence[str]] = None) -> int:
                          "forks them on this machine — test lane)")
     ap.add_argument("--bind", default=None,
                     help="address the rendezvous store binds")
+    ap.add_argument("--bind-to", default="none",
+                    choices=["none", "core"],
+                    help="CPU binding per rank (PRRTE-binding analog: "
+                         "round-robin cores on each host)")
     # daemon (prted-analog) flags — internal, set by launch_hosts
     ap.add_argument("--daemon", action="store_true",
                     help=argparse.SUPPRESS)
@@ -431,8 +457,10 @@ def main(args: Optional[Sequence[str]] = None) -> int:
         hosts = (parse_hostfile(ns.hostfile) if ns.hostfile
                  else parse_host_list(ns.host))
         return launch_hosts(argv, hosts, mca, ns.timeout,
-                            agent=ns.launch_agent, bind=ns.bind)
-    return launch(argv, ns.nprocs, mca, ns.timeout)
+                            agent=ns.launch_agent, bind=ns.bind,
+                            bind_to=ns.bind_to)
+    return launch(argv, ns.nprocs, mca, ns.timeout,
+                  bind_to=ns.bind_to)
 
 
 if __name__ == "__main__":

@@ -194,3 +194,69 @@ def test_derived_datatype_transfer():
             comm.Recv(buf, source=0, tag=9)
             assert (buf == [0, 4, 8, 12]).all(), buf
     """, 2)
+
+
+def test_generalized_requests():
+    """MPI_Grequest_start/complete: app-defined ops as MPI requests
+    (reference: ompi/request/grequest.c)."""
+    run_ranks("""
+        import threading
+        from ompi_tpu import mpi as M
+
+        seen = {}
+        req = M.Grequest_start(
+            query_fn=lambda st: setattr(st, "tag", 77),
+            free_fn=lambda: seen.__setitem__("freed", True),
+            cancel_fn=lambda done: seen.__setitem__("cancel", done))
+        assert not req.test()
+        threading.Timer(0.05, req.complete).start()
+        st = req.wait(timeout=10)
+        assert st.tag == 77  # query_fn ran at completion retrieval
+        req.free()
+        assert seen.get("freed")
+
+        # cancellation of a never-completed grequest
+        req2 = M.Grequest_start(
+            cancel_fn=lambda done: seen.__setitem__("cancel", done))
+        req2.cancel()
+        assert req2.test() and req2.status.cancelled
+        assert seen["cancel"] is False
+        # waitall across native + generalized requests
+        r3 = M.Grequest_start()
+        peer = (rank + 1) % size
+        sreq = comm.Isend(np.ones(4, np.float32), dest=peer, tag=3)
+        rreq = comm.Irecv(np.zeros(4, np.float32), source=(rank - 1) % size, tag=3)
+        threading.Timer(0.05, r3.complete).start()
+        from ompi_tpu.pml import request as rq
+        rq.wait_all([sreq, rreq, r3], timeout=30)
+    """, 2)
+
+
+def test_bind_to_core():
+    """tpurun --bind-to core: each rank's affinity is pinned to one
+    CPU (the PRRTE binding analog)."""
+    import os as _os
+    import subprocess
+    import sys
+    import tempfile
+
+    code = ("import os\n"
+            "from ompi_tpu import mpi\n"
+            "comm = mpi.Init()\n"
+            "aff = os.sched_getaffinity(0)\n"
+            "assert len(aff) == 1, aff\n"
+            "print('rank', comm.rank, 'bound to', aff, flush=True)\n"
+            "mpi.Finalize()\n")
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as fh:
+        fh.write(code)
+        path = fh.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.runtime.launcher", "-n",
+             "2", "--bind-to", "core", path],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "bound to" in proc.stdout
+    finally:
+        _os.unlink(path)
