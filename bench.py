@@ -44,7 +44,9 @@ def _bench_train_step():
         # dominate (d_model/d_ff >> T per-layer attention work), bf16
         # with f32 accumulation. Probed 2026-07-30: d1024/L8 -> 39%
         # MFU, d2048/L6 -> 51%, d4096/L4 -> 60%, this -> 64% (d6144/L3
-        # gains only ~2% more while flirting with HBM limits).
+        # gains only ~2% more while flirting with HBM limits; B=8 ->
+        # 114.7 TFLOP/s, worse than B=4 — HBM pressure beats the
+        # amortization; pallas flash attention -> ~4% slower at T=1024).
         cfg = tfm.Config(vocab=32768, d_model=5120, n_layers=4,
                          n_heads=40, d_ff=20480, max_seq=1024)
         B, T, iters = 4, 1024, 10

@@ -1010,6 +1010,25 @@ def Wtime() -> float:
     return time.perf_counter()
 
 
+def Wtick() -> float:
+    """MPI_Wtick: resolution of Wtime."""
+    import time
+
+    return time.get_clock_info("perf_counter").resolution
+
+
+def Get_version():
+    """MPI_Get_version: the standard level this framework targets
+    (3.1 + the MPI-4 subset: sessions, partitioned p2p, big-count,
+    persistent collectives — mirroring the reference fork)."""
+    return (3, 1)
+
+
+def Get_library_version() -> str:
+    return ("ompi_tpu: TPU-native MPI-class framework "
+            "(Open MPI big-count fork parity build)")
+
+
 def __getattr__(name: str):
     if name == "COMM_WORLD":
         from ompi_tpu.runtime import state

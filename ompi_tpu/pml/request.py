@@ -131,11 +131,14 @@ class GeneralizedRequest(Request):
         return st
 
     def _cancel(self) -> None:
+        """MPI grequest cancel: informs the app (cancel_fn) but does
+        NOT complete the request — completion always comes from the
+        app's Grequest_complete (the in-flight operation may be
+        uncancelable and still own the buffers)."""
         if self._cancel_fn is not None:
             self._cancel_fn(self.completed)
         if not self.completed:
             self.status.cancelled = True
-            self.complete()
 
     def free(self) -> None:
         if self._free_fn is not None:
@@ -169,23 +172,32 @@ def wait_any(reqs: Sequence[Request]) -> int:
     progress.wait_until(lambda: any(r.completed for r in reqs))
     for i, r in enumerate(reqs):
         if r.completed:
+            r.retrieve_status()  # grequest query_fn before status use
             return i
     raise AssertionError
 
 
 def wait_some(reqs: Sequence[Request]) -> List[int]:
     progress.wait_until(lambda: any(r.completed for r in reqs))
-    return [i for i, r in enumerate(reqs) if r.completed]
+    done = [i for i, r in enumerate(reqs) if r.completed]
+    for i in done:
+        reqs[i].retrieve_status()
+    return done
 
 
 def test_all(reqs: Sequence[Request]) -> bool:
     progress.progress()
-    return all(r.completed for r in reqs)
+    if all(r.completed for r in reqs):
+        for r in reqs:
+            r.retrieve_status()
+        return True
+    return False
 
 
 def test_any(reqs: Sequence[Request]) -> Optional[int]:
     progress.progress()
     for i, r in enumerate(reqs):
         if r.completed:
+            r.retrieve_status()
             return i
     return None

@@ -105,6 +105,10 @@ def build_env(rank: int, size: int, store_addr, jobid: str,
     env = dict(base_env if base_env is not None else os.environ)
     if bind_core is not None:
         env["OMPI_TPU_BIND_CORE"] = str(bind_core)
+    else:
+        # never inherit a parent rank's binding (spawned children
+        # would otherwise all pin to the parent's single core)
+        env.pop("OMPI_TPU_BIND_CORE", None)
     env["OMPI_TPU_RANK"] = str(rank)
     env["OMPI_TPU_SIZE"] = str(size)
     env["OMPI_TPU_LOCAL_RANK"] = str(

@@ -1,0 +1,102 @@
+"""Randomized communication fuzz: a seeded schedule of mixed
+operations, identical on every rank (collective ordering stays
+consistent), with per-step verification.
+
+Reference analog: the mpi4py CI suite's breadth-through-volume role —
+here compressed into rank-seeded random schedules shaking out
+ordering/races across p2p, collectives, v-variants, and obj traffic
+in ONE process group.
+"""
+
+import pytest
+
+from tests.harness import run_ranks
+
+_BODY = """
+    rng = np.random.default_rng(SEED)  # SAME seed everywhere: the
+    # schedule of collective calls must match across ranks
+    for step in range(40):
+        op = rng.integers(0, 7)
+        n = int(rng.integers(1, 64))
+        root = int(rng.integers(0, size))
+        if op == 0:  # allreduce
+            x = np.full(n, float(rank + step), np.float64)
+            out = np.zeros(n)
+            comm.Allreduce(x, out)
+            exp = sum(r + step for r in range(size))
+            assert (out == exp).all(), (step, out[0], exp)
+        elif op == 1:  # bcast
+            buf = (np.arange(n, dtype=np.int64) + step if rank == root
+                   else np.zeros(n, np.int64))
+            comm.Bcast(buf, root=root)
+            assert (buf == np.arange(n) + step).all(), step
+        elif op == 2:  # ring sendrecv
+            dst, src = (rank + 1) % size, (rank - 1) % size
+            got = np.zeros(n, np.float32)
+            comm.Sendrecv(np.full(n, float(rank), np.float32),
+                          dest=dst, recvbuf=got, source=src)
+            assert (got == src).all(), step
+        elif op == 3:  # gatherv with random counts
+            counts = [int(c) for c in rng.integers(1, 5, size)]
+            mine = np.full(counts[rank], float(rank), np.float64)
+            recv = (np.zeros(sum(counts)) if rank == root else None)
+            comm.Gatherv(mine, recv, counts, root=root)
+            if rank == root:
+                exp = np.concatenate([np.full(c, float(r))
+                                      for r, c in enumerate(counts)])
+                assert (recv == exp).all(), step
+        elif op == 4:  # nonblocking pairs
+            dst, src = (rank + 1) % size, (rank - 1) % size
+            rr = comm.Irecv(np.zeros(n, np.int32), source=src, tag=step)
+            sr = comm.Isend(np.full(n, rank, np.int32), dest=dst,
+                            tag=step)
+            sr.wait(); rr.wait()
+        elif op == 5:  # object traffic
+            objs = comm.allgather({"r": rank, "s": step})
+            assert [o["r"] for o in objs] == list(range(size)), step
+        else:  # alltoall
+            sendv = np.arange(size * n, dtype=np.float64) + rank * 1000
+            recv = np.zeros_like(sendv)
+            comm.Alltoall(sendv, recv)
+            for s in range(size):
+                want = np.arange(rank * n, (rank + 1) * n) + s * 1000
+                assert (recv[s * n:(s + 1) * n] == want).all(), step
+    comm.Barrier()
+"""
+
+
+@pytest.mark.parametrize("seed", [7, 2026])
+def test_fuzz_mixed_schedule(seed):
+    run_ranks(_BODY.replace("SEED", str(seed)), 4, timeout=240)
+
+
+def test_fuzz_device_schedule():
+    """Device-plane fuzz: random compiled collectives interleaved with
+    host traffic on the same comm."""
+    run_ranks("""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(99)
+    for step in range(12):
+        op = rng.integers(0, 4)
+        n = int(rng.integers(4, 48))
+        if op == 0:
+            r = comm.Allreduce(jnp.full(n, float(rank + 1),
+                                        jnp.float32))
+            assert np.asarray(r)[0] == sum(range(1, size + 1)), step
+        elif op == 1:
+            req = comm.Iallgather(jnp.full(2, float(rank), jnp.float32))
+            req.wait()
+            assert np.asarray(req.array).shape == (size, 2), step
+        elif op == 2:  # host collective on the same comm
+            out = np.zeros(n)
+            comm.Allreduce(np.full(n, 1.0), out)
+            assert (out == size).all(), step
+        else:  # ragged device allgatherv
+            counts = [int(c) for c in rng.integers(1, 4, size)]
+            packed = comm.Allgatherv(
+                jnp.full(counts[rank], float(rank), jnp.float32),
+                None, counts)
+            assert np.asarray(packed).size == sum(counts), step
+    from ompi_tpu.core import pvar
+    assert pvar.read("coll_accelerator_staged") == 0
+    """, 4, mca={"device_plane": "on"}, timeout=240)
