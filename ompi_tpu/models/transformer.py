@@ -50,6 +50,10 @@ class Config:
     n_experts: int = 8
     capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
+    #: context-parallel schedule under sp: "ring" (KV rotation,
+    #: O(T/P) memory) or "ulysses" (head-resharding all_to_alls,
+    #: exact single-pass softmax; needs local heads % sp size == 0)
+    sp_schedule: str = "ring"
 
     @property
     def head_dim(self) -> int:
@@ -197,7 +201,16 @@ def layer_forward(lp, h, cfg: Config, ax: Axes, is_moe: bool):
     k = k.reshape(b, t, hl, cfg.head_dim)
     v = v.reshape(b, t, hl, cfg.head_dim)
     if ax.sp:
-        o = ring_attention(q, k, v, ax.sp, causal=True)
+        if cfg.sp_schedule == "ulysses":
+            from ompi_tpu.ops.ulysses import ulysses_attention
+
+            o = ulysses_attention(q, k, v, ax.sp, causal=True)
+        elif cfg.sp_schedule == "ring":
+            o = ring_attention(q, k, v, ax.sp, causal=True)
+        else:
+            raise ValueError(
+                f"sp_schedule={cfg.sp_schedule!r}: expected 'ring' "
+                "or 'ulysses'")
     else:
         # reference mha, not the pallas flash kernel: measured on the
         # v5e at T=1024 the kernel is ~4% SLOWER (XLA's fused softmax

@@ -9,6 +9,9 @@ runs over them.
 - :mod:`ompi_tpu.ops.ring_attention` — context-parallel attention: KV
   blocks rotate around the ICI ring (ppermute) while each hop's block
   feeds flash-style online-softmax accumulation.
+- :mod:`ompi_tpu.ops.ulysses` — the all-to-all context-parallel
+  schedule: one batched head-reshard, exact full-sequence attention
+  per head subset, reshard back (Config.sp_schedule selects it).
 - :mod:`ompi_tpu.ops.moe` — expert-parallel dispatch/combine over
   all_to_all (the MPI_Alltoallv MoE pattern of BASELINE.md config #5).
 - :mod:`ompi_tpu.ops.attention` — single-device attention kernels

@@ -150,3 +150,22 @@ def test_moe_tp_ep_runs():
     for _ in range(5):
         p, l1 = smapped(p, tokens, labels)
     assert np.isfinite(l0) and float(l1) < float(l0)
+
+
+def test_sp_ulysses_schedule_matches_single(rngp):
+    """The Ulysses (all-to-all) context-parallel schedule trains
+    identically to the unsharded step — same oracle rule as ring."""
+    _skip_if_small()
+    rng, params = rngp
+    tokens, labels = _data(rng, 8, 16)
+    ref_p, ref_l = _single_step(CFG, params, tokens, labels)
+
+    import dataclasses
+
+    cfg_u = dataclasses.replace(CFG, sp_schedule="ulysses")
+    mesh = make_mesh(("sp",), (8,))
+    ax = tfm.Axes(sp="sp")
+    p, l = _sharded_step(cfg_u, ax, mesh, P(None, "sp"), params,
+                         tokens, labels)
+    np.testing.assert_allclose(float(l), float(ref_l), atol=1e-4)
+    _assert_trees_close(p, ref_p, atol=5e-4)
