@@ -1,0 +1,34 @@
+"""Architecture descriptor — heterogeneous-peer support.
+
+Reference: opal/util/arch.c builds a 32-bit architecture word
+(endianness, sizes, representations) every process publishes through
+the modex; the convertor consults it to decide heterogeneous
+conversion (opal_copy_functions_heterogeneous.c). Here the descriptor
+is the byte order string; the ``arch`` cvar can force it for
+single-machine testing of the cross-endian path (the forced rank then
+also byteswaps its outgoing wire bytes so the advertisement is true).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ompi_tpu.core import cvar
+
+_arch_var = cvar.register(
+    "arch", "auto", str,
+    help="Advertised byte order: 'auto' (the machine's real order), "
+         "or force 'little'/'big' — a forced rank byteswaps its "
+         "outgoing wire data to match, which lets one machine "
+         "exercise the full heterogeneous conversion path "
+         "(opal_copy_functions_heterogeneous.c analog).",
+    choices=["auto", "little", "big"], level=6)
+
+
+def native() -> str:
+    return sys.byteorder
+
+
+def advertised() -> str:
+    a = _arch_var.get()
+    return native() if a == "auto" else a

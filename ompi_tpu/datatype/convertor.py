@@ -53,6 +53,11 @@ class Convertor:
         self.position = 0
         self.checksum = 0 if checksum else None
         self._buf = buf
+        # heterogeneous wire conversion (reference:
+        # opal_copy_functions_heterogeneous.c): see set_hetero
+        self.wire_swap = False
+        self.wire_round = False
+        self._swap_unit = 0
         if dtype.lb < 0:
             # MPI allows negative lb (bytes before the buffer pointer);
             # with array-backed buffers that memory does not exist. The
@@ -111,12 +116,53 @@ class Convertor:
                     "mid-stream (restart from 0)")
         self.position = pos
 
+    # -- heterogeneous wire conversion ------------------------------------
+    def set_hetero(self, swap: bool) -> None:
+        """Cross-architecture peer (reference:
+        opal_copy_functions_heterogeneous.c; the arch descriptor of
+        opal/util/arch.c rides the modex). The packed wire format is
+        element-dense, so conversion = per-element byte reversal on
+        the wire. ``swap=False`` still enables window ROUNDING to
+        whole elements (a swapping peer must never see a split
+        element); ``swap=True`` also reverses bytes.
+
+        Only uniform-base layouts can convert: a derived type without
+        a single base element dtype (mixed struct) has no per-element
+        reversal and raises — the documented cross-arch limit."""
+        base = self.dtype.base
+        if base is None or base.kind == "V":
+            raise ValueError(
+                f"datatype {self.dtype.name!r} has no uniform base "
+                "element dtype; cross-architecture transfer of mixed "
+                "layouts is unsupported (convert on the host first)")
+        self._swap_unit = int(base.itemsize)
+        self._swap_dtype = base
+        self.wire_round = True
+        self.wire_swap = swap and self._swap_unit > 1
+
+    def _swap_bytes(self, data: bytes) -> bytes:
+        # per-COMPONENT byteswap (complex values swap each float
+        # half; whole-element reversal would exchange re/im) — the
+        # same numpy semantics the external32 _swap_wire path uses
+        return np.frombuffer(
+            data, dtype=self._swap_dtype).byteswap().tobytes()
+
     # -- pack -------------------------------------------------------------
     def pack(self, max_bytes: Optional[int] = None) -> bytes:
         """Pack up to max_bytes from the current position; advances it."""
         start = self.position
         end = self.packed_size if max_bytes is None else \
             min(self.packed_size, start + max_bytes)
+        if self.wire_round and end < self.packed_size:
+            # whole elements per window: the swapping side reverses
+            # per element and must never see one split across frames
+            end = start + (end - start) // self._swap_unit \
+                * self._swap_unit
+            if end <= start:
+                raise ValueError(
+                    f"pack window {max_bytes} smaller than the "
+                    f"{self._swap_unit}-byte element of a "
+                    "heterogeneous transfer")
         if end <= start:
             return b""
         src = self._flat(writable=False)
@@ -129,7 +175,9 @@ class Convertor:
         else:
             out = self._gather(src, start, end)
         self.position = end
-        if self.checksum is not None:
+        if self.wire_swap:
+            out = self._swap_bytes(out)  # wire order = advertised arch
+        if self.checksum is not None:  # checksums cover WIRE bytes
             self.checksum = zlib.crc32(out, self.checksum)
         return out
 
@@ -230,7 +278,16 @@ class Convertor:
         start = self.position
         end = min(self.packed_size, start + len(data))
         n = end - start
-        src = np.frombuffer(data, dtype=np.uint8, count=n)
+        if self.wire_swap:
+            if n % self._swap_unit:
+                raise ValueError(
+                    f"heterogeneous frame of {n} bytes splits a "
+                    f"{self._swap_unit}-byte element (peer did not "
+                    "round its windows)")
+            src = np.frombuffer(self._swap_bytes(data[:n]),
+                                dtype=np.uint8)
+        else:
+            src = np.frombuffer(data, dtype=np.uint8, count=n)
         if self._windowed:
             self._scatter_win(dst, src, start, end)
         elif self._spans is None:

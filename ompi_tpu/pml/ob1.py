@@ -35,7 +35,7 @@ import numpy as np
 
 from ompi_tpu import errors
 from ompi_tpu.btl import base as btl_base
-from ompi_tpu.core import memchecker, mpool, output, pvar
+from ompi_tpu.core import arch, memchecker, mpool, output, pvar
 from ompi_tpu.datatype import BYTE, Convertor
 from ompi_tpu.datatype.convertor import dtype_of
 from ompi_tpu.pml import peruse
@@ -196,7 +196,21 @@ class Ob1:
 
     # -- lifecycle --------------------------------------------------------
     def enable(self) -> None:
+        # architecture modex (reference: opal/util/arch.c descriptor
+        # exchange) — consulted per peer for heterogeneous conversion
+        from ompi_tpu.core import arch
+
+        rte.init()
+        rte.modex_send("arch", arch.advertised())
+        self._arch_cache: Dict[int, str] = {}
         btl_base.set_recv_callback(self._on_frame)
+
+    def _peer_arch(self, world_rank: int) -> str:
+        a = self._arch_cache.get(world_rank)
+        if a is None:
+            a = self._arch_cache[world_rank] = rte.modex_recv(
+                "arch", world_rank)
+        return a
 
     def disable(self) -> None:
         btl_base.set_recv_callback(None)
@@ -251,6 +265,19 @@ class Ob1:
         if dst_world in self.failed:
             req.complete(errors.ERR_PROC_FAILED)
             return req
+        if obj is NO_OBJ:
+            # heterogeneous wire: order on the wire is MY advertised
+            # arch; materialize it (swap) whenever the advertisement
+            # differs from the machine's real order — even when the
+            # peer advertises the SAME forced order, since the peer
+            # converts based on my advertisement being true. Round
+            # pack windows to whole elements so the converting
+            # receiver never sees a split element (pickle obj traffic
+            # is arch-independent).
+            mine = arch.advertised()
+            if (self._peer_arch(dst_world) != mine
+                    or mine != arch.native()):
+                conv.set_hetero(swap=mine != arch.native())
         src_commrank = comm.rank
         seq = self._next_seq(ctx, dst)
         size = conv.packed_size
@@ -301,6 +328,12 @@ class Ob1:
         if not smsc.available():
             return None
         if self.bml.endpoint(dst_world).NAME != "sm":
+            return None
+        if (arch.advertised() != arch.native()
+                or self._peer_arch(dst_world) != arch.advertised()):
+            # cross-arch pairs stream through the convertor (raw
+            # memory pulls would skip the byte-order conversion) —
+            # the reference disqualifies single-copy the same way
             return None
         conv = req.conv
         flat = conv._flat(False)
@@ -604,6 +637,17 @@ class Ob1:
             req.conv = Convertor(req.buf, BYTE, size)
         else:
             req.conv = Convertor(req.buf, req.dtype, req.count)
+            if self._peer_arch(src_world) != arch.native():
+                # wire order is the sender's advertised arch: convert
+                # incoming elements to native on unpack. A layout the
+                # convertor cannot convert (mixed struct) errors the
+                # REQUEST — raising here would unwind the progress
+                # callback with the message half-processed and hang
+                # the (ctx, src) ordering channel
+                try:
+                    req.conv.set_hetero(swap=True)
+                except ValueError:
+                    req.status.error = errors.ERR_TYPE
             if size > req.conv.packed_size:
                 # truncation: still must drain the protocol
                 req.status.error = errors.ERR_TRUNCATE

@@ -1,0 +1,126 @@
+"""Heterogeneous-architecture conversion (r2 VERDICT missing #6).
+
+Reference: opal/util/arch.c descriptor exchange +
+opal_copy_functions_heterogeneous.c receiver-side conversion. Tested
+on one machine by FORCING one rank's advertised byte order (cvar
+``arch=big``): that rank byteswaps its outgoing wire bytes so its
+advertisement is true, and its little-endian peers must convert on
+receive — the full cross-endian path without big-endian hardware.
+"""
+
+from tests.harness import run_ranks
+
+# rank 1 pretends to be big-endian; env must be set BEFORE the
+# package imports (cvars resolve at registration)
+_PRELUDE = """
+import os
+if int(os.environ["OMPI_TPU_RANK"]) == 1:
+    os.environ["OMPI_TPU_ARCH"] = "big"
+import numpy as np
+from ompi_tpu import mpi
+comm = mpi.Init()
+rank, size = comm.rank, comm.size
+"""
+
+
+def _run(body, n=2, mca=None, timeout=120):
+    run_ranks(_PRELUDE + body + "\nmpi.Finalize()\n", n, mca=mca,
+              timeout=timeout, prelude=False, isolate=True)
+
+
+def test_eager_both_directions():
+    _run("""
+vals = np.array([1.5, -2.25, 3e18, 7e-12], np.float64)
+ints = np.arange(10, dtype=np.int32) * 1000
+if rank == 0:
+    comm.Send(vals, dest=1, tag=1)
+    got = np.zeros(10, np.int32)
+    comm.Recv(got, source=1, tag=2)
+    assert (got == ints).all(), got
+else:
+    got = np.zeros(4, np.float64)
+    comm.Recv(got, source=0, tag=1)
+    np.testing.assert_array_equal(got, vals)
+    comm.Send(ints, dest=0, tag=2)
+""")
+
+
+def test_rndv_large_and_derived():
+    """> eager limit: frag windows must round to whole elements; a
+    strided vector type converts too (uniform base)."""
+    _run("""
+from ompi_tpu.datatype import vector, FLOAT
+from ompi_tpu.core import pvar
+n = 200_000
+if rank == 0:
+    comm.Send(np.arange(n, dtype=np.float64), dest=1, tag=3)
+    mat = np.arange(16, dtype=np.float32).reshape(4, 4)
+    col = vector(4, 1, 4, FLOAT).commit()
+    comm.Send((mat, 1, col), dest=1, tag=4)
+else:
+    big = np.zeros(n, np.float64)
+    comm.Recv(big, source=0, tag=3)
+    assert (big == np.arange(n)).all()
+    colbuf = np.zeros(4, np.float32)
+    comm.Recv(colbuf, source=0, tag=4)
+    assert (colbuf == [0, 4, 8, 12]).all(), colbuf
+    # single-copy must have disqualified itself cross-arch
+    assert pvar.read("smsc_single_copies") == 0
+""")
+
+
+def test_collectives_cross_arch():
+    _run("""
+out = np.zeros(8, np.float64)
+comm.Allreduce(np.full(8, float(rank + 1)), out)
+assert (out == 3.0).all(), out
+buf = np.arange(6, dtype=np.int64) if rank == 0 else np.zeros(6, np.int64)
+comm.Bcast(buf, root=0)
+assert (buf == np.arange(6)).all(), buf
+""")
+
+
+def test_mixed_struct_cross_arch_raises():
+    """A layout without a uniform base element (MINLOC-style pair)
+    cannot convert — documented error, not silent corruption."""
+    _run("""
+from ompi_tpu.datatype import create_struct, INT32, DOUBLE
+pair = create_struct([1, 1], [0, 8], [DOUBLE, INT32]).commit()
+buf = np.zeros(16, np.uint8)
+if rank == 0:
+    try:
+        comm.Send((buf, 1, pair), dest=1, tag=5)
+    except ValueError as e:
+        assert "uniform base" in str(e), e
+        comm.send("raised", dest=1, tag=6)
+    else:
+        raise AssertionError("mixed struct cross-arch must raise")
+else:
+    assert comm.recv(source=0, tag=6) == "raised"
+""")
+
+
+def test_complex_and_both_forced():
+    """complex128 swaps per component (re/im must not exchange), and
+    BOTH ranks forced to the same non-native order still agree: the
+    sender materializes its advertisement even when peer == mine."""
+    run_ranks(
+        """
+import os
+os.environ["OMPI_TPU_ARCH"] = "big"  # EVERY rank forced
+import numpy as np
+from ompi_tpu import mpi
+comm = mpi.Init()
+rank = comm.rank
+z = np.array([1 + 2j, -3.5 + 0.25j], np.complex128)
+if rank == 0:
+    comm.Send(z, dest=1, tag=1)
+else:
+    got = np.zeros(2, np.complex128)
+    comm.Recv(got, source=0, tag=1)
+    np.testing.assert_array_equal(got, z)
+out = np.zeros(4, np.float64)
+comm.Allreduce(np.full(4, float(rank + 1)), out)
+assert (out == 3.0).all(), out
+mpi.Finalize()
+""", 2, prelude=False, isolate=True)
