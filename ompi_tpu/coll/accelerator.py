@@ -164,6 +164,22 @@ def alltoallv_dev(comm, sendbuf, scounts, rcounts, max_count=None):
     return _stage_out(recv, sendbuf)
 
 
+def reduce_scatter_dev(comm, sendbuf, counts, op=op_mod.SUM,
+                       deterministic=None):
+    pvar.record("coll_accelerator_staged")
+    host = _stage_in(sendbuf)
+    counts = [int(c) for c in counts]
+    if sum(counts) != host.shape[0]:
+        raise ValueError(
+            f"reduce_scatter: counts sum to {sum(counts)} but sendbuf "
+            f"dim0 is {host.shape[0]}")
+    recv = np.empty((counts[comm.rank],) + host.shape[1:], host.dtype)
+    row = int(np.prod(host.shape[1:], dtype=np.int64)) or 1
+    comm.coll.reduce_scatter(comm, host.reshape(-1), recv.reshape(-1),
+                             [c * row for c in counts], None, op)
+    return _stage_out(recv, sendbuf)
+
+
 def scatterv_dev(comm, sendbuf, counts, root=0, like=None):
     """Same obj-channel design as scatter_dev: ragged chunks ride the
     object channel with their shapes, no metadata round."""
@@ -279,6 +295,8 @@ class CollAccelerator(CollModule):
             "gatherv_dev": gatherv_dev,
             "alltoallv_dev": alltoallv_dev,
             "scatterv_dev": scatterv_dev,
+            "reduce_scatter_dev": reduce_scatter_dev,
+            "ireduce_scatter_dev": _istaged(reduce_scatter_dev),
             "ibarrier_dev": ibarrier_dev,
             "iallreduce_dev": _istaged(allreduce_dev),
             "ibcast_dev": _istaged(bcast_dev),

@@ -617,6 +617,28 @@ def alltoallv_dev(comm, sendbuf, scounts, rcounts, max_count=None):
         [cells[r, :rcounts[r]] for r in range(comm.size)], axis=0)
 
 
+def reduce_scatter_dev(comm, sendbuf, counts, op=op_mod.SUM,
+                       deterministic: Optional[str] = None):
+    """Ragged MPI_Reduce_scatter on device: full on-device reduction
+    (shares allreduce's compiled program and cache entry), then each
+    rank slices its counts[rank] rows locally — ragged outputs never
+    enter the uniform-shape collective program."""
+    if not _op_ok(op):
+        return staging.reduce_scatter_dev(comm, sendbuf, counts, op)
+    counts = [int(c) for c in counts]
+    if len(counts) != comm.size:
+        raise ValueError(f"reduce_scatter: {len(counts)} counts for "
+                         f"{comm.size} ranks")
+    if sum(counts) != sendbuf.shape[0]:
+        raise ValueError(
+            f"reduce_scatter: counts sum to {sum(counts)} but sendbuf "
+            f"dim0 is {sendbuf.shape[0]} (jax slicing would clamp "
+            "silently)")
+    full = allreduce_dev(comm, sendbuf, op, deterministic)
+    off = sum(counts[:comm.rank])
+    return full[off:off + counts[comm.rank]]
+
+
 def scan_dev(comm, sendbuf, op=op_mod.SUM,
              deterministic: Optional[str] = None):
     """Inclusive prefix over comm ranks (lax.associative_scan under
@@ -769,6 +791,7 @@ iallgatherv_dev = _irequest(allgatherv_dev)
 igatherv_dev = _irequest(gatherv_dev)
 ialltoallv_dev = _irequest(alltoallv_dev)
 iscatterv_dev = _irequest(scatterv_dev)
+ireduce_scatter_dev = _irequest(reduce_scatter_dev)
 
 
 @framework.register
@@ -806,6 +829,7 @@ class CollXla(CollModule):
             "gatherv_dev": gatherv_dev,
             "alltoallv_dev": alltoallv_dev,
             "scatterv_dev": scatterv_dev,
+            "reduce_scatter_dev": reduce_scatter_dev,
             # nonblocking device collectives (r2 VERDICT missing #3)
             "ibarrier_dev": ibarrier_dev,
             "iallreduce_dev": iallreduce_dev,
@@ -822,4 +846,5 @@ class CollXla(CollModule):
             "igatherv_dev": igatherv_dev,
             "ialltoallv_dev": ialltoallv_dev,
             "iscatterv_dev": iscatterv_dev,
+            "ireduce_scatter_dev": ireduce_scatter_dev,
         }

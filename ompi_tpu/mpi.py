@@ -568,9 +568,13 @@ def _Reduce_scatter_block(self, sendbuf, recvbuf=None, op=op_mod.SUM,
     self.coll.reduce_scatter_block(self, sarr, rarr, count, dt, op)
 
 
-def _Reduce_scatter(self, sendbuf, recvbuf, counts, op=op_mod.SUM) -> None:
+def _Reduce_scatter(self, sendbuf, recvbuf, counts, op=op_mod.SUM,
+                    deterministic=None):
     self.check_revoked()
     self.check_failed()
+    if _is_dev(sendbuf):
+        return self.coll.reduce_scatter_dev(
+            self, sendbuf, counts, op, deterministic=deterministic)
     rarr = _parse_buf(recvbuf)[0]
     sarr = _parse_buf(sendbuf)[0]
     self.coll.reduce_scatter(self, sarr, rarr, counts,
@@ -764,6 +768,8 @@ def _Ireduce_scatter_block(self, sendbuf, recvbuf=None,
 
 def _Ireduce_scatter(self, sendbuf, recvbuf, counts,
                      op=op_mod.SUM) -> rq.Request:
+    if _is_dev(sendbuf):
+        return self.coll.ireduce_scatter_dev(self, sendbuf, counts, op)
     rarr = _parse_buf(recvbuf)[0]
     return self.coll.ireduce_scatter(self, _parse_buf(sendbuf)[0],
                                      rarr, counts, dtype_of(rarr), op)

@@ -592,6 +592,53 @@ class Window:
         self.comm.free()
 
 
+class DynamicWindow(Window):
+    """MPI_Win_create_dynamic (reference: osc/rdma dynamic windows):
+    a window with NO initial buffer; memory regions attach and detach
+    at runtime, and origins address them by the target-side
+    "address" ``Attach`` returned (the MPI pattern: the target
+    obtains addresses and ships them to origins itself)."""
+
+    def __init__(self, comm) -> None:
+        self._regions: List[Tuple[int, np.ndarray]] = []
+        self._next_disp = 16  # 0 stays invalid, like NULL
+        super().__init__(comm, None, disp_unit=1)
+
+    def Attach(self, arr: np.ndarray) -> int:
+        """Expose ``arr`` (a writable contiguous ndarray — RMA lands
+        in it directly); returns its address in this window."""
+        if not (isinstance(arr, np.ndarray)
+                and arr.flags["C_CONTIGUOUS"]):
+            raise ValueError(
+                "Win_attach needs a C-contiguous ndarray (RMA writes "
+                "land in the attached memory itself)")
+        with self._local_mutex:
+            disp = self._next_disp
+            self._regions.append((disp, arr))
+            # pad between regions so an out-of-range disp faults
+            # instead of silently touching a neighbor
+            self._next_disp = disp + arr.nbytes + 64
+        return disp
+
+    def Detach(self, arr: np.ndarray) -> None:
+        with self._local_mutex:
+            self._regions = [(d, a) for d, a in self._regions
+                             if a is not arr]
+
+    def _target_view(self, disp: int, count: int, dtstr: str,
+                     stride: int = 1):
+        dt = np.dtype(dtstr)
+        span = ((count - 1) * stride + 1) * dt.itemsize if count else 0
+        for start, arr in self._regions:
+            if start <= disp and disp + span <= start + arr.nbytes:
+                off = disp - start
+                flat = arr.view(np.uint8).reshape(-1)[off:off + span]
+                return flat.view(dt)[::stride]
+        raise ValueError(
+            f"dynamic window {self.name}: [{disp}, {disp + span}) "
+            "is not within any attached region")
+
+
 class SharedWindow(Window):
     """MPI_Win_allocate_shared (reference: osc/sm): the window's local
     region lives in a /dev/shm segment, and :meth:`Shared_query`
@@ -673,6 +720,11 @@ def win_allocate_shared(comm, nbytes: int,
                         disp_unit: int = 1) -> SharedWindow:
     """MPI_Win_allocate_shared."""
     return SharedWindow(comm, nbytes, disp_unit)
+
+
+def win_create_dynamic(comm) -> DynamicWindow:
+    """MPI_Win_create_dynamic."""
+    return DynamicWindow(comm)
 
 
 def win_allocate(comm, shape, dtype=np.uint8,

@@ -198,8 +198,6 @@ class Ob1:
     def enable(self) -> None:
         # architecture modex (reference: opal/util/arch.c descriptor
         # exchange) — consulted per peer for heterogeneous conversion
-        from ompi_tpu.core import arch
-
         rte.init()
         rte.modex_send("arch", arch.advertised())
         self._arch_cache: Dict[int, str] = {}
@@ -681,6 +679,11 @@ class Ob1:
         from ompi_tpu import smsc
 
         if not smsc.available():
+            return False
+        if req.conv.wire_round or req.conv.wire_swap:
+            # heterogeneous peer: a raw memory pull would skip the
+            # byte-order conversion on the contiguous fast path
+            # (unpack() converts; smsc.read does not) — stream instead
             return False
         pid, addr = _SC.unpack_from(payload, 0)
         take = min(size, req.conv.packed_size)

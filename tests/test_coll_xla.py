@@ -442,3 +442,24 @@ def test_device_barrier():
     assert pvar.read("coll_xla_device") >= 1
     assert pvar.read("coll_accelerator_staged") == 0
     """, 4, mca=MCA)
+
+
+def test_reduce_scatter_v_device():
+    """Ragged MPI_Reduce_scatter on device: on-device reduction +
+    local ragged slice, zero staging; nonblocking form too."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    counts = list(range(1, size + 1))
+    total = sum(counts)
+    x = jnp.arange(total, dtype=jnp.float32) + rank
+    seg = comm.Reduce_scatter(x, None, counts)
+    off = sum(counts[:rank])
+    exp = (size * np.arange(total, dtype=np.float32)
+           + sum(range(size)))[off:off + counts[rank]]
+    np.testing.assert_array_equal(np.asarray(seg), exp)
+    req = comm.Ireduce_scatter(x, None, counts)
+    req.wait()
+    np.testing.assert_array_equal(np.asarray(req.array), exp)
+    assert pvar.read("coll_accelerator_staged") == 0
+    """, 4, mca=MCA)

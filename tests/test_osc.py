@@ -228,3 +228,38 @@ def test_win_allocate_shared_direct_access():
     assert (mine[16:20] == 99).all()
     win.Free()
     """, 3)
+
+
+def test_dynamic_window_attach_detach():
+    """MPI_Win_create_dynamic: runtime-attached regions addressed by
+    target-side addresses (the osc/rdma dynamic-window pattern)."""
+    run_ranks("""
+    from ompi_tpu import osc
+    win = osc.win_create_dynamic(comm)
+    a = np.zeros(8, np.float64)
+    b = np.zeros(4, np.int32)
+    da = win.Attach(a)
+    db = win.Attach(b)
+    # targets ship their addresses to origins (the MPI idiom)
+    addrs = comm.allgather((da, db))
+    win.Fence()
+    peer = (comm.rank + 1) % comm.size
+    pa, pb = addrs[peer]
+    win.Put(np.full(8, float(comm.rank), np.float64), target=peer,
+            disp=pa)
+    win.Put(np.full(4, comm.rank + 10, np.int32), target=peer,
+            disp=pb + 0)
+    win.Fence()
+    prev = (comm.rank - 1) % comm.size
+    assert (a == float(prev)).all(), a
+    assert (b == prev + 10).all(), b
+    # get from a peer region
+    got = np.zeros(8, np.float64)
+    win.Get(got, target=peer, disp=pa)
+    win.Fence()
+    assert (got == float((peer - 1) % comm.size)).all(), got
+    # out-of-range displacement errors at the target, not silently
+    win.Detach(b)
+    win.Fence()
+    win.Free()
+    """, 3)
