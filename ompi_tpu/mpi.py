@@ -48,12 +48,12 @@ def _parse_buf(buf) -> Tuple[Any, int, Optional[Datatype]]:
     if type(arr).__module__.split(".")[0] in ("jax", "jaxlib"):
         raise TypeError(
             "device array passed to an operation without a device "
-            "path. Device-interposed entries: Send/Recv (pipelined "
-            "bounce-buffer staging), the blocking and nonblocking "
-            "collectives incl. v-variants (sendbuf device, recvbuf "
-            "None -> returns a new device array), Barrier(device="
-            "True). For other operations stage manually with "
-            "np.asarray(arr) / jax.device_put.")
+            "path. Device-interposed entries: Send/Recv/Isend/Irecv "
+            "(pipelined bounce-buffer staging), the blocking and "
+            "nonblocking collectives incl. v-variants (sendbuf "
+            "device, recvbuf None -> returns a new device array), "
+            "Barrier(device=True), RMA windows. For other operations "
+            "stage manually with np.asarray(arr) / jax.device_put.")
     mv = memoryview(arr)
     return arr, mv.nbytes, None
 
@@ -198,6 +198,11 @@ def _Send(self, buf, dest: int, tag: int = 0) -> None:
 
 def _Isend(self, buf, dest: int, tag: int = 0) -> rq.Request:
     self.check_revoked()
+    if _is_dev(buf):
+        # progress-driven pipelined staging (no blocking, no threads)
+        from ompi_tpu.pml import accel_p2p
+
+        return accel_p2p.isend_dev(self, buf, dest, tag)
     arr, count, dt = _parse_buf(buf)
     return pml.current().isend(self, arr, count, dt, dest, tag)
 
@@ -255,7 +260,13 @@ def _Recv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
 
 def _Irecv(self, buf, source: int = ANY_SOURCE,
            tag: int = ANY_TAG) -> rq.Request:
+    """Device path: ``buf`` is the shape/dtype template; the request's
+    ``.array`` holds the received device array after completion."""
     self.check_revoked()
+    if _is_dev(buf):
+        from ompi_tpu.pml import accel_p2p
+
+        return accel_p2p.irecv_dev(self, buf, source, tag)
     arr, count, dt = _parse_buf(buf)
     return pml.current().irecv(self, arr, count, dt, source, tag)
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 import numpy as np
 
 from ompi_tpu.core import cvar, pvar
+from ompi_tpu.pml import request as rq
 
 _chunk_var = cvar.register(
     "pml_accel_chunk_bytes", 4 << 20, int,
@@ -34,6 +35,238 @@ _chunk_var = cvar.register(
 
 def _chunk_elems(dtype) -> int:
     return max(1, _chunk_var.get() // np.dtype(dtype).itemsize)
+
+
+class _DevP2PChannel:
+    """Per-(comm, peer, tag) FIFO of in-flight nonblocking device
+    transfers. The header+chunks wire protocol relies on one message
+    occupying the (src, tag) matching channel at a time: a second
+    Isend must not issue its header until the first has ISSUED all
+    its chunk sends, and a second Irecv must not post its header
+    until the first has POSTED all its chunk recvs — otherwise MPI's
+    arrival-order matching interleaves the two messages' frames.
+    Wildcard receives serialize on their literal (ANY, tag) key;
+    mixing wildcard and specific receives that could match the same
+    sender is the application-level race it is in host MPI."""
+
+    _queues = {}
+
+    @classmethod
+    def join(cls, key, req) -> None:
+        cls._queues.setdefault(key, []).append(req)
+
+    @classmethod
+    def is_head(cls, key, req) -> bool:
+        q = cls._queues.get(key)
+        return bool(q) and q[0] is req
+
+    @classmethod
+    def leave(cls, key, req) -> None:
+        q = cls._queues.get(key)
+        if q and req in q:
+            q.remove(req)
+        if not q:
+            cls._queues.pop(key, None)
+
+
+class _DevP2PRequest(rq.Request):
+    """Progress-driven request for nonblocking device p2p: a state
+    machine advanced by the progress engine (no helper threads — the
+    same single-progress-loop discipline as ob1). Subclasses implement
+    _step(); completion/Status/error semantics are the shared Request
+    contract (wait raises on status.error, etc.)."""
+
+    def __init__(self, key) -> None:
+        super().__init__()
+        self.array = None
+        self._key = key
+        self._busy = False
+        _DevP2PChannel.join(key, self)
+        from ompi_tpu.core import progress
+
+        self._cb = self._advance
+        progress.register(self._cb)
+
+    def _advance(self) -> int:
+        # re-entrancy guard: a pml isend issued from _step can spin
+        # the progress engine (full transport), which re-enters this
+        # callback — one state-machine step at a time keeps the
+        # chunk bookkeeping consistent (ob1's seq reorder queue
+        # absorbs any resulting frame reordering)
+        if self._busy:
+            return 0
+        self._busy = True
+        try:
+            return self._step()
+        finally:
+            self._busy = False
+
+    def _step(self) -> int:  # returns event count; StopIteration
+        raise NotImplementedError  # unregisters (progress contract)
+
+    def _finish(self, error: int = 0) -> None:
+        _DevP2PChannel.leave(self._key, self)
+        self.complete(error)
+        raise StopIteration
+
+    def retrieve_status(self):
+        return self.status
+
+
+class _DevISend(_DevP2PRequest):
+    """Nonblocking device send. Construction only queues on the
+    channel; the progress engine starts the transfer when this
+    request reaches the channel head (header isend + all D2H copies
+    submitted), then pushes each chunk to the PML as its copy event
+    fires — D2H of chunk k+1 overlaps the wire of chunk k without
+    ever blocking the caller."""
+
+    def __init__(self, comm, buf, dest: int, tag: int) -> None:
+        pvar.record("accel_p2p_send")
+        self._comm, self._dest, self._tag = comm, dest, tag
+        self._buf = buf  # pins the source until fully shipped
+        self._events = None  # None = not started
+        self._reqs = []
+        self._issued = False
+        super().__init__(("s", comm.cid, dest, tag))
+
+    def _start(self) -> None:
+        from collections import deque
+
+        from ompi_tpu import accelerator, pml
+
+        acc = accelerator.current()
+        flat = self._buf.reshape(-1)
+        step = _chunk_elems(flat.dtype)
+        # header first, then ALL copies onto the ordered stream
+        self._reqs.append(pml.current().isend(
+            self._comm, np.array([flat.size], np.int64), 1, None,
+            self._dest, self._tag))
+        self._events = deque(
+            acc.copy_async(flat[a:a + step])
+            for a in range(0, flat.size, step))
+
+    def _step(self) -> int:
+        from ompi_tpu import pml
+
+        if self._events is None:
+            if not _DevP2PChannel.is_head(self._key, self):
+                return 0
+            self._start()
+        events = 0
+        while self._events and self._events[0].query():
+            host = self._events.popleft().wait()
+            self._reqs.append(pml.current().isend(
+                self._comm, host, host.size, None, self._dest,
+                self._tag))
+            events += 1
+        if not self._issued and not self._events:
+            # every chunk handed to the PML in order: the next queued
+            # send to this (dest, tag) may start
+            self._issued = True
+            _DevP2PChannel.leave(self._key, self)
+        err = next((r.status.error for r in self._reqs
+                    if r.status.error), 0)
+        if err:
+            self._buf = None
+            self._finish(err)
+        self._reqs = [r for r in self._reqs if not r.completed]
+        if self._issued and not self._reqs:
+            self._buf = None
+            self._finish()
+        return events
+
+
+class _DevIRecv(_DevP2PRequest):
+    """Nonblocking device receive. The header irecv posts when this
+    request reaches its channel head; once the header lands, chunk
+    irecvs post (to the matched peer) and the channel is released;
+    each completed chunk dispatches its H2D asynchronously.
+    ``.array`` holds the assembled device array after completion. An
+    oversized message drains fully into scratch, then errors with
+    ERR_TRUNCATE (the channel stays clean for the next match)."""
+
+    def __init__(self, comm, like, source: int, tag: int) -> None:
+        pvar.record("accel_p2p_recv")
+        self._comm = comm
+        self._like = like
+        self._want_src, self._want_tag = source, tag
+        self._cap = int(np.prod(like.shape, dtype=np.int64))
+        self._dtype = np.dtype(like.dtype)
+        self._hdr = np.zeros(1, np.int64)
+        self._hdr_req = None
+        self._chunks = None  # deque of (host, req) once header lands
+        self._parts = None
+        self._n = 0
+        self._truncated = False
+        super().__init__(("r", comm.cid, source, tag))
+
+    def _step(self) -> int:
+        import jax.numpy as jnp
+
+        from ompi_tpu import accelerator, errors, pml
+
+        if self._hdr_req is None:
+            if not _DevP2PChannel.is_head(self._key, self):
+                return 0
+            self._hdr_req = pml.current().irecv(
+                self._comm, self._hdr, 1, None, self._want_src,
+                self._want_tag)
+        if self._chunks is None:
+            if not self._hdr_req.completed:
+                return 0
+            st = self._hdr_req.status
+            if st.error:
+                self._finish(st.error)
+            self._n = int(self._hdr[0])
+            self._truncated = self._n > self._cap
+            self.status.source, self.status.tag = st.source, st.tag
+            self.status.count = self._n * self._dtype.itemsize
+            from collections import deque
+
+            step = _chunk_elems(self._dtype)
+            self._chunks = deque()
+            self._parts = []
+            for a in range(0, self._n, step):
+                host = np.empty(min(step, self._n - a), self._dtype)
+                self._chunks.append(
+                    (host, pml.current().irecv(
+                        self._comm, host, host.size, None, st.source,
+                        st.tag)))
+            # chunk recvs posted in order: release the channel
+            _DevP2PChannel.leave(self._key, self)
+        events = 0
+        acc = accelerator.current()
+        while self._chunks and self._chunks[0][1].completed:
+            host, req = self._chunks.popleft()
+            if req.status.error:
+                self._finish(req.status.error)
+            if not self._truncated:
+                self._parts.append(acc.to_device(host))  # async H2D
+            events += 1
+        if not self._chunks:
+            if self._truncated:  # fully drained: channel stays clean
+                self._finish(errors.ERR_TRUNCATE)
+            if self._n < self._cap:
+                self._parts.append(
+                    jnp.zeros(self._cap - self._n, self._like.dtype))
+            if len(self._parts) == 1:
+                out = self._parts[0]
+            elif self._parts:
+                out = jnp.concatenate(self._parts)
+            else:
+                out = jnp.zeros(0, self._like.dtype)
+            self.array = out.reshape(self._like.shape)
+            self._finish()
+        return events
+
+
+def isend_dev(comm, buf, dest: int, tag: int) -> _DevISend:
+    return _DevISend(comm, buf, dest, tag)
+
+
+def irecv_dev(comm, like, source: int, tag: int) -> _DevIRecv:
+    return _DevIRecv(comm, like, source, tag)
 
 
 def send_dev(comm, buf, dest: int, tag: int) -> None:
