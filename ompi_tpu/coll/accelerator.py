@@ -263,6 +263,15 @@ def _istaged(fn):
     return islot
 
 
+def _pstaged(fn):
+    """Persistent-init over the staged path: every start() re-runs
+    the staged collective (coll/xla's request machinery drives the
+    cycle — ONE construction helper, not a copy)."""
+    from ompi_tpu.coll import xla as _xla
+
+    return _xla._pinit(fn)
+
+
 def ibarrier_dev(comm):
     from ompi_tpu.coll.xla import DeviceRequest
 
@@ -313,4 +322,10 @@ class CollAccelerator(CollModule):
             "igatherv_dev": _istaged(gatherv_dev),
             "ialltoallv_dev": _istaged(alltoallv_dev),
             "iscatterv_dev": _istaged(scatterv_dev),
+            "allreduce_init_dev": _pstaged(allreduce_dev),
+            "bcast_init_dev": _pstaged(bcast_dev),
+            "allgather_init_dev": _pstaged(allgather_dev),
+            "alltoall_init_dev": _pstaged(alltoall_dev),
+            "reduce_scatter_block_init_dev":
+                _pstaged(reduce_scatter_block_dev),
         }

@@ -765,6 +765,66 @@ def ibarrier_dev(comm):
     return DeviceRequest(ctx.my_shard(fn(ctx.to_global(token))))
 
 
+class PersistentDeviceRequest:
+    """MPI-4 persistent device collective (reference: the coll.h
+    *_init slot table): the operation binds its operands at init;
+    every ``start()`` re-dispatches the cached compiled program on
+    them (the compile cache makes restarts free — exactly what
+    persistence buys on the host side). jax arrays are immutable, so
+    each cycle's result is a fresh array in ``.array``."""
+
+    def __init__(self, fn, args, kwargs) -> None:
+        from ompi_tpu.pml import request as rq
+
+        self.id = next(rq._req_ids)
+        self.status = rq.Status()
+        self.persistent = True
+        self._fn, self._args, self._kwargs = fn, args, kwargs
+        self._inner: Optional[DeviceRequest] = None
+
+    def start(self) -> None:
+        self._inner = DeviceRequest(self._fn(*self._args,
+                                             **self._kwargs))
+
+    @property
+    def completed(self) -> bool:
+        """Live view over the in-flight cycle, so the plural wait/test
+        helpers (which poll .completed) see device completion; an
+        INACTIVE persistent request is complete with an empty status,
+        per MPI — matching the host _PersistentRequest."""
+        return True if self._inner is None else self._inner.test()
+
+    @property
+    def array(self):
+        return None if self._inner is None else self._inner.array
+
+    def test(self) -> bool:
+        return self.completed
+
+    def wait(self, timeout=None):
+        if self._inner is None:
+            return self.status  # inactive: immediately complete (MPI)
+        return self._inner.wait(timeout)
+
+    def retrieve_status(self):
+        return self.status
+
+    def cancel(self) -> None:
+        pass
+
+    def free(self) -> None:
+        pass
+
+
+def _pinit(fn):
+    """persistent-init variant of a device slot: bind now, dispatch
+    at every start()."""
+    def pslot(*args, **kwargs):
+        return PersistentDeviceRequest(fn, args, kwargs)
+    pslot.__name__ = fn.__name__ + "_init"
+    return pslot
+
+
 def _irequest(fn):
     """i-variant of a device slot: same dispatch, no block — the
     blocking slots already return un-awaited futures, so the i-form
@@ -847,4 +907,11 @@ class CollXla(CollModule):
             "ialltoallv_dev": ialltoallv_dev,
             "iscatterv_dev": iscatterv_dev,
             "ireduce_scatter_dev": ireduce_scatter_dev,
+            # MPI-4 persistent device collectives (coll.h *_init)
+            "allreduce_init_dev": _pinit(allreduce_dev),
+            "bcast_init_dev": _pinit(bcast_dev),
+            "allgather_init_dev": _pinit(allgather_dev),
+            "alltoall_init_dev": _pinit(alltoall_dev),
+            "reduce_scatter_block_init_dev":
+                _pinit(reduce_scatter_block_dev),
         }

@@ -793,11 +793,19 @@ def _Barrier_init(self) -> rq.Request:
 
 
 def _Bcast_init(self, buf, root: int = 0) -> rq.Request:
+    if _is_dev(buf):
+        return self.coll.bcast_init_dev(self, buf, root)
     arr, count, dt = _parse_buf(buf)
     return self.coll.bcast_init(self, arr, count, dt, root)
 
 
-def _Allreduce_init(self, sendbuf, recvbuf, op=op_mod.SUM) -> rq.Request:
+def _Allreduce_init(self, sendbuf, recvbuf=None,
+                    op=op_mod.SUM) -> rq.Request:
+    if _is_dev(sendbuf):
+        # persistent device collective: operands bind now, every
+        # start() re-dispatches the cached compiled program;
+        # req.array holds each cycle's result
+        return self.coll.allreduce_init_dev(self, sendbuf, op)
     sarr, count, dt = _parse_buf(sendbuf)
     return self.coll.allreduce_init(self, sarr, _parse_buf(recvbuf)[0],
                                     count, dt, op)
@@ -822,13 +830,30 @@ def _Scatter_init(self, sendbuf, recvbuf, root: int = 0) -> rq.Request:
     return self.coll.scatter_init(self, sarr, rarr, count, dt, root)
 
 
-def _Allgather_init(self, sendbuf, recvbuf) -> rq.Request:
+def _Allgather_init(self, sendbuf, recvbuf=None) -> rq.Request:
+    if _is_dev(sendbuf):
+        return self.coll.allgather_init_dev(self, sendbuf)
     sarr, count, dt = _parse_buf(sendbuf)
     return self.coll.allgather_init(self, sarr, _parse_buf(recvbuf)[0],
                                     count, dt)
 
 
-def _Alltoall_init(self, sendbuf, recvbuf) -> rq.Request:
+def _Reduce_scatter_block_init(self, sendbuf, recvbuf=None,
+                               op=op_mod.SUM) -> rq.Request:
+    """Device persistent form only (the host libnbc table has no
+    reduce_scatter_block_init schedule yet; stage with np.asarray
+    for host buffers)."""
+    if _is_dev(sendbuf):
+        return self.coll.reduce_scatter_block_init_dev(self, sendbuf,
+                                                       op)
+    raise TypeError(
+        "Reduce_scatter_block_init: device buffers only (host "
+        "persistent form not implemented; use Ireduce_scatter_block)")
+
+
+def _Alltoall_init(self, sendbuf, recvbuf=None) -> rq.Request:
+    if _is_dev(sendbuf):
+        return self.coll.alltoall_init_dev(self, sendbuf)
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     count = np.asarray(sarr).size // self.size
@@ -927,6 +952,7 @@ _API = {
     "Allreduce_init": _Allreduce_init, "Reduce_init": _Reduce_init,
     "Gather_init": _Gather_init, "Scatter_init": _Scatter_init,
     "Allgather_init": _Allgather_init, "Alltoall_init": _Alltoall_init,
+    "Reduce_scatter_block_init": _Reduce_scatter_block_init,
 }
 
 for _name, _fn in _API.items():
