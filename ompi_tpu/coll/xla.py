@@ -52,10 +52,12 @@ _scatter_cache_var = cvar.register(
     "coll_xla_scatter_meta_cache", 1, int,
     help="Cache the scatter/scatterv metadata host round per (comm, "
          "root) [1, default]. The cached contract requires a stable "
-         "root buffer signature — a root-side change raises (peers "
-         "would otherwise reuse stale shapes and hang in the "
-         "compiled collective). Set 0 to restore a per-call metadata "
-         "round for shape-varying scatters without like= templates.",
+         "root buffer signature — a root-side change raises ON THE "
+         "ROOT ONLY; non-root peers reuse the cached shape and enter "
+         "the compiled collective, where they HANG uninterruptibly "
+         "until the job is killed (they run no host round the root "
+         "could poison). Set 0 to restore a per-call metadata round "
+         "for shape-varying scatters without like= templates.",
     level=6)
 
 _hier_var = cvar.register(
@@ -393,9 +395,11 @@ def _scatter_meta(comm, key, root: int, root_meta):
             raise ValueError(
                 f"{key}: buffer signature changed {cached} -> "
                 f"{root_meta} after the metadata round was cached. "
-                "Non-root peers reuse the cached shape, so continuing "
-                "would diverge — abort this job, then either pass "
-                "like= on every rank (zero-round dynamic path) or set "
+                "Non-root peers reuse the cached shape and are "
+                "entering (or already inside) the compiled "
+                "collective, where they hang uninterruptibly — KILL "
+                "THIS JOB externally, then either pass like= on "
+                "every rank (zero-round dynamic path) or set "
                 "--mca coll_xla_scatter_meta_cache 0 (per-call "
                 "metadata round)")
         return root_meta
@@ -711,27 +715,37 @@ class DeviceRequest:
         self.status = rq.Status()
         self.persistent = False
         self.array = array
-        self.completed = array is None
+        self._done = array is None
+
+    @property
+    def completed(self) -> bool:
+        """Live readiness view. The plural helpers (rq.wait_all/
+        test_all/...) poll ``.completed`` and spin the host progress
+        engine, which never advances a device program — so this MUST
+        probe the array, not cache a flag only test()/wait() flip."""
+        if not self._done:
+            try:
+                if bool(self.array.is_ready()):
+                    self._done = True
+            except AttributeError:  # backend without is_ready:
+                # readiness polling degrades to blocking (the same
+                # guarantee the pre-property test() gave) — never
+                # report completion that has not happened
+                import jax
+
+                jax.block_until_ready(self.array)
+                self._done = True
+        return self._done
 
     def test(self) -> bool:
-        if not self.completed:
-            try:
-                ready = bool(self.array.is_ready())
-            except AttributeError:  # backend without is_ready: the
-                # dispatch already happened; only readiness polling
-                # degrades to blocking
-                self.wait()
-                return True
-            if ready:
-                self.completed = True
         return self.completed
 
     def wait(self, timeout=None):
-        if not self.completed:
+        if not self._done:
             import jax
 
             jax.block_until_ready(self.array)
-            self.completed = True
+            self._done = True
         return self.status
 
     def cancel(self) -> None:  # dispatched programs are not cancelable

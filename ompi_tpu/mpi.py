@@ -400,6 +400,18 @@ def _require_packed_displs(counts, displs, what: str) -> None:
             "host (np.asarray) for custom send layouts")
 
 
+def _require_recvbuf(recvbuf, what: str):
+    """Host-path collectives need a caller recvbuf; only device
+    arrays legitimately omit it (they return a new array). Raising
+    here beats the obscure TypeError _parse_buf(None) produces."""
+    if recvbuf is None:
+        raise TypeError(
+            f"{what}: recvbuf required for host buffers (recvbuf="
+            "None is the device-array form, which returns a new "
+            "array)")
+    return recvbuf
+
+
 def _Barrier(self, device: bool = False) -> None:
     """device=True rendezvouses on the device plane (a compiled
     1-element psum over ICI) instead of the host transports."""
@@ -640,6 +652,7 @@ def _Iallreduce(self, sendbuf, recvbuf=None, op=op_mod.SUM,
     if _is_dev(sendbuf):
         return self.coll.iallreduce_dev(self, sendbuf, op,
                                         deterministic=deterministic)
+    _require_recvbuf(recvbuf, "Iallreduce")
     if sendbuf is IN_PLACE:
         rarr, count, dt = _parse_buf(recvbuf)
         return self.coll.iallreduce(self, IN_PLACE, rarr, count, dt, op)
@@ -670,7 +683,7 @@ def _Iscatter(self, sendbuf, recvbuf=None, root: int = 0,
     if _is_dev(sendbuf) or device:
         return self.coll.iscatter_dev(self, sendbuf, root,
                                       like=recvbuf)
-    rarr, count, dt = _parse_buf(recvbuf)
+    rarr, count, dt = _parse_buf(_require_recvbuf(recvbuf, "Iscatter"))
     sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
     return self.coll.iscatter(self, sarr, rarr, count, dt, root)
 
@@ -679,15 +692,15 @@ def _Iallgather(self, sendbuf, recvbuf=None) -> rq.Request:
     if _is_dev(sendbuf):
         return self.coll.iallgather_dev(self, sendbuf)
     sarr, count, dt = _parse_buf(sendbuf)
-    return self.coll.iallgather(self, sarr, _parse_buf(recvbuf)[0],
-                                count, dt)
+    rarr = _parse_buf(_require_recvbuf(recvbuf, "Iallgather"))[0]
+    return self.coll.iallgather(self, sarr, rarr, count, dt)
 
 
 def _Ialltoall(self, sendbuf, recvbuf=None) -> rq.Request:
     if _is_dev(sendbuf):
         return self.coll.ialltoall_dev(self, sendbuf)
     sarr = _parse_buf(sendbuf)[0]
-    rarr = _parse_buf(recvbuf)[0]
+    rarr = _parse_buf(_require_recvbuf(recvbuf, "Ialltoall"))[0]
     count = np.asarray(sarr).size // self.size
     return self.coll.ialltoall(self, sarr, rarr, count, dtype_of(sarr))
 
@@ -751,6 +764,7 @@ def _Ialltoallv(self, sendbuf, recvbuf, scounts, rcounts,
 def _Iscan(self, sendbuf, recvbuf=None, op=op_mod.SUM) -> rq.Request:
     if _is_dev(sendbuf):
         return self.coll.iscan_dev(self, sendbuf, op)
+    _require_recvbuf(recvbuf, "Iscan")
     rarr, rcount, rdt = _parse_buf(recvbuf)
     if sendbuf is IN_PLACE:
         return self.coll.iscan(self, IN_PLACE, rarr, rcount, rdt, op)
@@ -761,6 +775,7 @@ def _Iscan(self, sendbuf, recvbuf=None, op=op_mod.SUM) -> rq.Request:
 def _Iexscan(self, sendbuf, recvbuf=None, op=op_mod.SUM) -> rq.Request:
     if _is_dev(sendbuf):
         return self.coll.iexscan_dev(self, sendbuf, op)
+    _require_recvbuf(recvbuf, "Iexscan")
     rarr, rcount, rdt = _parse_buf(recvbuf)
     if sendbuf is IN_PLACE:
         return self.coll.iexscan(self, IN_PLACE, rarr, rcount, rdt, op)
@@ -772,7 +787,8 @@ def _Ireduce_scatter_block(self, sendbuf, recvbuf=None,
                            op=op_mod.SUM) -> rq.Request:
     if _is_dev(sendbuf):
         return self.coll.ireduce_scatter_block_dev(self, sendbuf, op)
-    rarr, count, dt = _parse_buf(recvbuf)
+    rarr, count, dt = _parse_buf(
+        _require_recvbuf(recvbuf, "Ireduce_scatter_block"))
     return self.coll.ireduce_scatter_block(
         self, _parse_buf(sendbuf)[0], rarr, count, dt, op)
 
@@ -834,8 +850,8 @@ def _Allgather_init(self, sendbuf, recvbuf=None) -> rq.Request:
     if _is_dev(sendbuf):
         return self.coll.allgather_init_dev(self, sendbuf)
     sarr, count, dt = _parse_buf(sendbuf)
-    return self.coll.allgather_init(self, sarr, _parse_buf(recvbuf)[0],
-                                    count, dt)
+    rarr = _parse_buf(_require_recvbuf(recvbuf, "Allgather_init"))[0]
+    return self.coll.allgather_init(self, sarr, rarr, count, dt)
 
 
 def _Reduce_scatter_block_init(self, sendbuf, recvbuf=None,
@@ -855,7 +871,7 @@ def _Alltoall_init(self, sendbuf, recvbuf=None) -> rq.Request:
     if _is_dev(sendbuf):
         return self.coll.alltoall_init_dev(self, sendbuf)
     sarr = _parse_buf(sendbuf)[0]
-    rarr = _parse_buf(recvbuf)[0]
+    rarr = _parse_buf(_require_recvbuf(recvbuf, "Alltoall_init"))[0]
     count = np.asarray(sarr).size // self.size
     return self.coll.alltoall_init(self, sarr, rarr, count,
                                    dtype_of(sarr))

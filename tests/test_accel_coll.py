@@ -215,3 +215,34 @@ def test_device_p2p_nonblocking_truncation_drains():
         ok = comm.Recv(jnp.zeros(100, jnp.float32), source=0, tag=6)
         assert (np.asarray(ok) == 9.0).all()
     """, 2, mca={"pml_accel_chunk_bytes": "512"})
+
+
+def test_device_icollective_through_plural_helpers():
+    """Device i-collective requests driven through rq.wait_all /
+    test_all / wait_any (ADVICE r3 high): the plural helpers poll
+    ``.completed`` and spin the HOST progress engine, which never
+    advances a device program — so DeviceRequest.completed must be a
+    live readiness probe, not a flag only its own test()/wait() set."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import mpi
+    from ompi_tpu.pml import request as rq
+    r1 = comm.Iallreduce(jnp.full((64,), float(rank + 1)))
+    r2 = comm.Ibcast(jnp.full((8,), float(rank)), root=0)
+    mpi.wait_all([r1, r2], timeout=60)
+    tot = float(sum(range(1, size + 1)))
+    assert bool((np.asarray(r1.array) == tot).all())
+    assert bool((np.asarray(r2.array) == 0.0).all())
+
+    r3 = comm.Iallgather(jnp.array([rank], jnp.int32))
+    import time
+    deadline = time.time() + 60
+    while not rq.test_all([r3]):
+        assert time.time() < deadline, "test_all never observed done"
+    got = list(np.asarray(r3.array).reshape(-1))
+    assert got == list(range(size)), got
+
+    r4 = comm.Iallreduce(jnp.ones((4,), jnp.float32))
+    i = rq.wait_any([r4])
+    assert i == 0 and bool((np.asarray(r4.array) == size).all())
+    """, n=2)
