@@ -250,6 +250,37 @@ def exscan_dev(comm, sendbuf, op=op_mod.SUM, deterministic=None):
     return _stage_out(recv, sendbuf)
 
 
+def neighbor_allgather_dev(comm, sendbuf):
+    """Device-form contract (same as coll/xla_neighbor): EVERY rank
+    passes a same-shaped sendbuf — a receive-only rank's buffer is a
+    pure shape template (its data goes nowhere), so the per-edge
+    count is the buffer size on every rank."""
+    pvar.record("coll_accelerator_staged")
+    host = _stage_in(sendbuf)
+    ins = comm.topo.in_neighbors(comm.rank)
+    recv = np.zeros((len(ins),) + host.shape, host.dtype)
+    comm.coll.neighbor_allgather(comm, host, recv, host.size, None)
+    return _stage_out(recv, sendbuf)
+
+
+def neighbor_alltoall_dev(comm, sendbuf):
+    """sendbuf rows are per-out-neighbor blocks (row j to out-neighbor
+    j); result rows are per-in-neighbor (PROC_NULL rows zero).
+    Zero-size blocks are a legal no-op exchange (count 0)."""
+    pvar.record("coll_accelerator_staged")
+    host = _stage_in(sendbuf)
+    ins = comm.topo.in_neighbors(comm.rank)
+    outs = comm.topo.out_neighbors(comm.rank)
+    if host.shape[0] != len(outs):
+        raise ValueError(
+            f"neighbor_alltoall: sendbuf dim0 {host.shape[0]} != "
+            f"out-degree {len(outs)}")
+    recv = np.zeros((len(ins),) + host.shape[1:], host.dtype)
+    count = int(np.prod(host.shape[1:], dtype=np.int64))
+    comm.coll.neighbor_alltoall(comm, host, recv, count, None)
+    return _stage_out(recv, sendbuf)
+
+
 def _istaged(fn):
     """Staged i-variant: the host collective runs synchronously (the
     staging path has no async substrate), then the result is wrapped in
@@ -288,7 +319,12 @@ class CollAccelerator(CollModule):
         return self.PRIORITY
 
     def slots(self, comm):
+        nbr = {} if getattr(comm, "topo", None) is None else {
+            "neighbor_allgather_dev": neighbor_allgather_dev,
+            "neighbor_alltoall_dev": neighbor_alltoall_dev,
+        }
         return {
+            **nbr,
             "allreduce_dev": allreduce_dev,
             "bcast_dev": bcast_dev,
             "reduce_dev": reduce_dev,

@@ -928,4 +928,12 @@ class CollXla(CollModule):
             "alltoall_init_dev": _pinit(alltoall_dev),
             "reduce_scatter_block_init_dev":
                 _pinit(reduce_scatter_block_dev),
+            # neighborhood slots (topology comms only — coll.h:600-618)
+            **_neighbor_slots(comm),
         }
+
+
+def _neighbor_slots(comm):
+    from ompi_tpu.coll import xla_neighbor
+
+    return xla_neighbor.slots(comm)

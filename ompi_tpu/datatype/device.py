@@ -1,0 +1,126 @@
+"""Device-side convertor route — derived datatypes over jax arrays.
+
+Reference: the convertor is accelerator-aware
+(opal/datatype/opal_datatype_copy.h — CONVERTOR_ACCELERATOR memcpy
+selection, consumed at ompi/mca/pml/ob1/pml_ob1_sendreq.h:399): a
+device buffer with a non-contiguous datatype packs THROUGH the device,
+never via a host bounce of the whole extent.
+
+TPU-first redesign: instead of a byte-walking pack VM, the span table
+(datatype.py) compiles to an **element-index vector**; pack is one
+on-device gather (``jnp.take``), unpack one on-device scatter
+(``.at[idx].set``). XLA fuses these with the surrounding program.
+The packed ELEMENT layout equals the host convertor's pack output;
+note the device p2p framing differs (accel_p2p's header+chunks
+protocol), so both endpoints of a transfer stay on one plane.
+
+Constraints: spans must align to the array's element size (true for
+contiguous/vector/hvector/indexed/subarray families over a uniform
+base — mixed structs stay on the host route, stage with np.asarray).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.core import mpool as _mpool
+
+#: element-index vectors per (datatype, count, itemsize) — same rcache
+#: discipline as the span-table cache (datatype._span_cache)
+_idx_cache = _mpool.Rcache()
+
+
+def supports(dt, arr) -> bool:
+    """True when `dt` has a device route over `arr` (element-aligned
+    spans of arr's dtype)."""
+    if dt is None or dt.is_contiguous:
+        return True
+    k = np.dtype(arr.dtype).itemsize
+    spans = dt.spans
+    return not ((spans[:, 0] % k).any() or (spans[:, 1] % k).any())
+
+
+def element_indices(dt, count: int, itemsize: int) -> np.ndarray:
+    """Flat element indices covering `count` elements of `dt` laid
+    over an array of `itemsize`-byte elements, in typemap order —
+    the compiled form of the datatype for the device convertor."""
+    spans = dt.spans_for_count(count)
+    if len(spans) == 0:
+        return np.empty(0, np.int64)
+    if (spans[:, 0] % itemsize).any() or (spans[:, 1] % itemsize).any():
+        raise TypeError(
+            f"datatype {dt.name}: spans are not aligned to the device "
+            f"array's {itemsize}-byte elements — no device route; "
+            "stage with np.asarray for byte-granular layouts")
+    offs = spans[:, 0] // itemsize
+    lens = spans[:, 1] // itemsize
+    total = int(lens.sum())
+    # vectorized [arange(o, o+l) for o, l in spans] concatenation
+    starts = np.repeat(offs, lens)
+    prefix = np.concatenate([[0], np.cumsum(lens[:-1])])
+    inc = np.arange(total, dtype=np.int64) - np.repeat(prefix, lens)
+    return starts + inc
+
+
+def _indices(dt, count: int, itemsize: int) -> np.ndarray:
+    key = _mpool.buffer_key(dt, _idx_cache)
+    if key is None:
+        return element_indices(dt, count, itemsize)
+    per = _idx_cache.lookup(key) or {}
+    got = per.get((count, itemsize))
+    if got is None:
+        got = per[(count, itemsize)] = element_indices(dt, count,
+                                                       itemsize)
+        _idx_cache.insert(key, per,
+                          sum(v.nbytes for v in per.values()))
+    return got
+
+
+def pack(arr, dt, count: int):
+    """Device pack: gather `count` elements of `dt` out of the device
+    array into a packed 1-D device array (the wire layout). Runs as
+    one XLA gather — data never leaves the device."""
+    import jax.numpy as jnp
+
+    flat = arr.reshape(-1)
+    k = np.dtype(arr.dtype).itemsize
+    if dt is None:
+        return flat if count is None else flat[:count]
+    if dt.is_contiguous:
+        return flat[:(dt.size * count) // k]
+    idx = _indices(dt, count, k)
+    if len(idx) and int(idx[-1]) >= flat.size:
+        raise ValueError(
+            f"datatype {dt.name} x {count} spans element "
+            f"{int(idx[-1])} but the device array has {flat.size}")
+    return jnp.take(flat, jnp.asarray(idx), axis=0)
+
+
+def unpack(packed, dt, count: int, template):
+    """Device unpack: scatter a packed 1-D device array into a NEW
+    array shaped like `template`, with the datatype's gaps holding
+    `template`'s values (jax arrays are immutable — the host path's
+    'gaps untouched' becomes 'gaps from the template')."""
+    if dt is None or dt.is_contiguous:
+        if packed.size == template.size:
+            return packed.reshape(template.shape)
+        flat = template.reshape(-1)
+        return flat.at[:packed.size].set(
+            packed.reshape(-1)).reshape(template.shape)
+    import jax.numpy as jnp
+
+    idx = _indices(dt, count, np.dtype(template.dtype).itemsize)
+    flat = template.reshape(-1)
+    if len(idx) and int(idx[-1]) >= flat.size:
+        raise ValueError(
+            f"datatype {dt.name} x {count} spans element "
+            f"{int(idx[-1])} but the template has {flat.size}")
+    return flat.at[jnp.asarray(idx)].set(
+        packed.reshape(-1)).reshape(template.shape)
+
+
+def packed_elems(dt, count, itemsize: int) -> int:
+    """Number of wire elements a (dt, count) pack produces."""
+    if dt is None:
+        return int(count)
+    return (dt.size * int(count)) // itemsize

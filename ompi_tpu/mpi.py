@@ -37,6 +37,14 @@ MINLOC, MAXLOC = op_mod.MINLOC, op_mod.MAXLOC
 def _parse_buf(buf) -> Tuple[Any, int, Optional[Datatype]]:
     """(array|bytearray, count, dtype) from a buffer spec."""
     if isinstance(buf, tuple):
+        if _is_dev(buf[0]):
+            raise TypeError(
+                "(device array, count[, datatype]) tuples are "
+                "supported on Send/Recv/Isend/Irecv/Sendrecv and "
+                "Bcast/Allreduce/Ibcast/Iallreduce (on-device "
+                "pack/unpack); this operation has no device "
+                "derived-datatype route — stage with np.asarray for "
+                "host-side layouts")
         if len(buf) == 2:
             arr, count = buf
             return arr, count, dtype_of(arr)
@@ -181,16 +189,64 @@ def _sendrecv(self, obj, dest: int, source: int = ANY_SOURCE,
 
 # -- buffer p2p --
 
+def _parse_dev(buf):
+    """(arr, count, dt) when ``buf`` routes to the device plane: a
+    bare device array, or a (device array, count[, datatype]) tuple —
+    the derived-datatype form, packed/unpacked ON DEVICE by the
+    convertor's gather/scatter route (datatype.device; reference:
+    the accelerator-aware convertor, opal_datatype_copy.h consumed at
+    pml_ob1_sendreq.h:399). Returns None for host buffers.
+
+    Built by hand rather than via _parse_buf: dtype inference there
+    calls np.asarray, which would silently stage the device array to
+    the host."""
+    if _is_dev(buf):
+        return buf, None, None
+    if (isinstance(buf, tuple) and len(buf) in (2, 3)
+            and _is_dev(buf[0])):
+        return buf[0], buf[1], (buf[2] if len(buf) == 3 else None)
+    return None
+
+
+def _dev_pack(arr, count, dt):
+    """Send-side device convertor: pack (one XLA gather) when a
+    count/datatype rode the tuple form; identity for bare arrays."""
+    if dt is None and count is None:
+        return arr
+    from ompi_tpu.datatype import device as dtdev
+
+    return dtdev.pack(arr, dt, count)
+
+
+def _dev_recv_plan(arr, count, dt):
+    """(like, transform) for the device receive side: bare templates
+    receive as-shaped; tuple forms receive the packed wire form into
+    a flat template, then scatter into ``arr`` (one XLA scatter)."""
+    if dt is None and count is None:
+        return arr, None
+    import jax.numpy as jnp
+
+    from ompi_tpu.datatype import device as dtdev
+
+    n = dtdev.packed_elems(dt, count, np.dtype(arr.dtype).itemsize)
+    return (jnp.zeros(n, arr.dtype),
+            lambda p: dtdev.unpack(p, dt, count, arr))
+
+
 def _Send(self, buf, dest: int, tag: int = 0) -> None:
     self.check_revoked()
     _check_rank(self, dest)
-    if _is_dev(buf):
+    d = _parse_dev(buf)
+    if d is not None:
         # pipelined bounce-buffer staging (ob1 accelerator analog):
-        # D2H of chunk k+1 overlaps the wire send of chunk k
+        # D2H of chunk k+1 overlaps the wire send of chunk k; derived
+        # datatypes pack on device first (one XLA gather)
         from ompi_tpu.pml import accel_p2p
 
+        arr, count, dt = d
         pvar.record("send")
-        return accel_p2p.send_dev(self, buf, dest, tag)
+        return accel_p2p.send_dev(self, _dev_pack(arr, count, dt),
+                                  dest, tag)
     arr, count, dt = _parse_buf(buf)
     pvar.record("send")
     pml.current().send(self, arr, count, dt, dest, tag)
@@ -198,11 +254,14 @@ def _Send(self, buf, dest: int, tag: int = 0) -> None:
 
 def _Isend(self, buf, dest: int, tag: int = 0) -> rq.Request:
     self.check_revoked()
-    if _is_dev(buf):
+    d = _parse_dev(buf)
+    if d is not None:
         # progress-driven pipelined staging (no blocking, no threads)
         from ompi_tpu.pml import accel_p2p
 
-        return accel_p2p.isend_dev(self, buf, dest, tag)
+        arr, count, dt = d
+        return accel_p2p.isend_dev(self, _dev_pack(arr, count, dt),
+                                   dest, tag)
     arr, count, dt = _parse_buf(buf)
     return pml.current().isend(self, arr, count, dt, dest, tag)
 
@@ -242,10 +301,15 @@ def _Recv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
     buffers are immutable); the host path fills ``buf`` in place and
     returns the Status."""
     self.check_revoked()
-    if _is_dev(buf):
+    d = _parse_dev(buf)
+    if d is not None:
         from ompi_tpu.pml import accel_p2p
 
-        out, st = accel_p2p.recv_dev(self, buf, source, tag)
+        arr, count, dt = d
+        like, tr = _dev_recv_plan(arr, count, dt)
+        out, st = accel_p2p.recv_dev(self, like, source, tag)
+        if tr is not None:
+            out = tr(out)
         if status is not None:
             status.source, status.tag = st.source, st.tag
             status.count, status.error = st.count, st.error
@@ -263,10 +327,14 @@ def _Irecv(self, buf, source: int = ANY_SOURCE,
     """Device path: ``buf`` is the shape/dtype template; the request's
     ``.array`` holds the received device array after completion."""
     self.check_revoked()
-    if _is_dev(buf):
+    d = _parse_dev(buf)
+    if d is not None:
         from ompi_tpu.pml import accel_p2p
 
-        return accel_p2p.irecv_dev(self, buf, source, tag)
+        arr, count, dt = d
+        like, tr = _dev_recv_plan(arr, count, dt)
+        return accel_p2p.irecv_dev(self, like, source, tag,
+                                   transform=tr)
     arr, count, dt = _parse_buf(buf)
     return pml.current().irecv(self, arr, count, dt, source, tag)
 
@@ -425,8 +493,23 @@ def _Barrier(self, device: bool = False) -> None:
 def _Bcast(self, buf, root: int = 0):
     self.check_revoked()
     self.check_failed()
-    if _is_dev(buf):
-        return self.coll.bcast_dev(self, buf, root)
+    d = _parse_dev(buf)
+    if d is not None:
+        arr, count, dt = d
+        if dt is None and count is None:
+            return self.coll.bcast_dev(self, arr, root)
+        # derived datatype: device pack -> collective -> scatter back
+        # into the caller's template (gaps keep the template's
+        # values). Non-roots only need a SHAPE operand — a zeros
+        # template, not a wasted gather of data the bcast overwrites.
+        from ompi_tpu.datatype import device as dtdev
+
+        if self.rank == root:
+            packed = dtdev.pack(arr, dt, count)
+        else:
+            packed = _dev_recv_plan(arr, count, dt)[0]
+        out = self.coll.bcast_dev(self, packed, root)
+        return dtdev.unpack(out, dt, count, arr)
     arr, count, dt = _parse_buf(buf)
     self.coll.bcast(self, arr, count, dt, root)
 
@@ -453,9 +536,16 @@ def _Allreduce(self, sendbuf, recvbuf=None, op=op_mod.SUM,
     'linear' is bit-identical to the host linear fold."""
     self.check_revoked()
     self.check_failed()
-    if _is_dev(sendbuf):
-        return self.coll.allreduce_dev(self, sendbuf, op,
-                                       deterministic=deterministic)
+    d = _parse_dev(sendbuf)
+    if d is not None:
+        arr, count, dt = d
+        out = self.coll.allreduce_dev(self, _dev_pack(arr, count, dt),
+                                      op, deterministic=deterministic)
+        if dt is None and count is None:
+            return out
+        from ompi_tpu.datatype import device as dtdev
+
+        return dtdev.unpack(out, dt, count, arr)
     if sendbuf is IN_PLACE:
         rarr, count, dt = _parse_buf(recvbuf)
         self.coll.allreduce(self, IN_PLACE, rarr, count, dt, op)
@@ -641,17 +731,36 @@ def _Ibarrier(self, device: bool = False) -> rq.Request:
 
 
 def _Ibcast(self, buf, root: int = 0) -> rq.Request:
-    if _is_dev(buf):
-        return self.coll.ibcast_dev(self, buf, root)
+    d = _parse_dev(buf)
+    if d is not None:
+        arr, count, dt = d
+        if dt is None and count is None:
+            return self.coll.ibcast_dev(self, arr, root)
+        from ompi_tpu.datatype import device as dtdev
+
+        packed = (dtdev.pack(arr, dt, count) if self.rank == root
+                  else _dev_recv_plan(arr, count, dt)[0])
+        req = self.coll.ibcast_dev(self, packed, root)
+        # unpack is itself async device work: rebinding .array keeps
+        # the request's readiness probe watching the FINAL result
+        req.array = dtdev.unpack(req.array, dt, count, arr)
+        return req
     arr, count, dt = _parse_buf(buf)
     return self.coll.ibcast(self, arr, count, dt, root)
 
 
 def _Iallreduce(self, sendbuf, recvbuf=None, op=op_mod.SUM,
                 deterministic=None) -> rq.Request:
-    if _is_dev(sendbuf):
-        return self.coll.iallreduce_dev(self, sendbuf, op,
-                                        deterministic=deterministic)
+    d = _parse_dev(sendbuf)
+    if d is not None:
+        arr, count, dt = d
+        req = self.coll.iallreduce_dev(self, _dev_pack(arr, count, dt),
+                                       op, deterministic=deterministic)
+        if dt is not None or count is not None:
+            from ompi_tpu.datatype import device as dtdev
+
+            req.array = dtdev.unpack(req.array, dt, count, arr)
+        return req
     _require_recvbuf(recvbuf, "Iallreduce")
     if sendbuf is IN_PLACE:
         rarr, count, dt = _parse_buf(recvbuf)

@@ -194,10 +194,13 @@ class _DevIRecv(_DevP2PRequest):
     oversized message drains fully into scratch, then errors with
     ERR_TRUNCATE (the channel stays clean for the next match)."""
 
-    def __init__(self, comm, like, source: int, tag: int) -> None:
+    def __init__(self, comm, like, source: int, tag: int,
+                 transform=None) -> None:
         pvar.record("accel_p2p_recv")
         self._comm = comm
         self._like = like
+        self._transform = transform  # e.g. the device convertor's
+        # unpack (datatype scatter) applied to the assembled array
         self._want_src, self._want_tag = source, tag
         self._cap = int(np.prod(like.shape, dtype=np.int64))
         self._dtype = np.dtype(like.dtype)
@@ -264,7 +267,9 @@ class _DevIRecv(_DevP2PRequest):
                 out = jnp.concatenate(self._parts)
             else:
                 out = jnp.zeros(0, self._like.dtype)
-            self.array = out.reshape(self._like.shape)
+            out = out.reshape(self._like.shape)
+            self.array = out if self._transform is None \
+                else self._transform(out)
             self._finish()
         return events
 
@@ -273,8 +278,9 @@ def isend_dev(comm, buf, dest: int, tag: int) -> _DevISend:
     return _DevISend(comm, buf, dest, tag)
 
 
-def irecv_dev(comm, like, source: int, tag: int) -> _DevIRecv:
-    return _DevIRecv(comm, like, source, tag)
+def irecv_dev(comm, like, source: int, tag: int,
+              transform=None) -> _DevIRecv:
+    return _DevIRecv(comm, like, source, tag, transform)
 
 
 def send_dev(comm, buf, dest: int, tag: int) -> None:

@@ -336,10 +336,16 @@ def _Dist_graph_neighbors(self):
 
 # -- neighborhood collectives (dispatch into the coll table) --------------
 
-def _Neighbor_allgather(self, sendbuf, recvbuf):
+def _Neighbor_allgather(self, sendbuf, recvbuf=None):
+    """Device path (jax sendbuf, recvbuf omitted): compiled ppermute
+    schedule on the device plane, returns a NEW (n_in, *shape) array
+    (coll/xla_neighbor; staging fallback when the plane is off)."""
     self.check_revoked()
-    from ompi_tpu.mpi import _parse_buf
+    from ompi_tpu.mpi import _is_dev, _parse_buf, _require_recvbuf
 
+    if _is_dev(sendbuf):
+        return self.coll.neighbor_allgather_dev(self, sendbuf)
+    _require_recvbuf(recvbuf, "Neighbor_allgather")
     sarr, count, dt = _parse_buf(sendbuf)
     rarr, _, rdt = _parse_buf(recvbuf)
     # a receive-only rank's sendbuf is empty: take the per-edge count
@@ -351,10 +357,15 @@ def _Neighbor_allgather(self, sendbuf, recvbuf):
     self.coll.neighbor_allgather(self, sarr, rarr, count, dt)
 
 
-def _Neighbor_alltoall(self, sendbuf, recvbuf):
+def _Neighbor_alltoall(self, sendbuf, recvbuf=None):
+    """Device path (jax sendbuf of shape (n_out, *blk), recvbuf
+    omitted): returns a NEW (n_in, *blk) device array."""
     self.check_revoked()
-    from ompi_tpu.mpi import _parse_buf
+    from ompi_tpu.mpi import _is_dev, _parse_buf, _require_recvbuf
 
+    if _is_dev(sendbuf):
+        return self.coll.neighbor_alltoall_dev(self, sendbuf)
+    _require_recvbuf(recvbuf, "Neighbor_alltoall")
     sarr, _, dt = _parse_buf(sendbuf)
     rarr = _parse_buf(recvbuf)[0]
     # per-edge count: derive from whichever side has edges (a

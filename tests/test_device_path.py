@@ -1,0 +1,274 @@
+"""Round-4 device-path completeness: neighborhood collectives and
+derived datatypes on device (r3 VERDICT missing #5).
+
+Reference parity targets: the neighborhood coll slots
+(ompi/mca/coll/coll.h:600-618) and the accelerator-aware convertor
+(opal/datatype/opal_datatype_copy.h consumed at
+ompi/mca/pml/ob1/pml_ob1_sendreq.h:399). The point proven here: a jax
+array on a topology comm, or with a vector/subarray datatype, never
+stages through the host (coll_accelerator_staged == 0 with the device
+plane up).
+"""
+
+import numpy as np
+
+from tests.harness import run_ranks
+
+MCA = {"device_plane": "on"}
+
+
+def test_cart_neighbor_allgather_device_no_staging():
+    """2x2 periodic cart: device neighbor_allgather matches the host
+    path bit-for-bit and never stages."""
+    run_ranks("""
+    import jax
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    cart = comm.Create_cart([2, 2], periods=[True, True])
+    x = jnp.arange(3, dtype=jnp.float32) + 10 * cart.rank
+    out = cart.Neighbor_allgather(x)
+    assert isinstance(out, jax.Array), type(out)
+    nbrs = cart.topo.in_neighbors(cart.rank)
+    assert out.shape == (len(nbrs), 3)
+    exp = np.stack([np.arange(3, dtype=np.float32) + 10 * s
+                    for s in nbrs])
+    np.testing.assert_array_equal(np.asarray(out), exp)
+    # host-path cross-check (same exchange over the p2p plane)
+    hrecv = np.zeros((len(nbrs), 3), np.float32)
+    cart.Neighbor_allgather(np.asarray(x), hrecv)
+    np.testing.assert_array_equal(np.asarray(out), hrecv)
+    assert pvar.read("coll_accelerator_staged") == 0
+    assert pvar.read("coll_xla_device") >= 1
+    assert cart.coll.providers["neighbor_allgather_dev"] == "xla"
+    """, 4, mca=MCA)
+
+
+def test_cart_neighbor_alltoall_device_degenerate_dim():
+    """Periodic size-2 dims are the degenerate case: both directions
+    of a dim hit the same rank — the device schedule must pair
+    conjugate slots exactly like basic's conjugate tags."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    cart = comm.Create_cart([2, 2], periods=[True, True])
+    nbrs = cart.topo.neighbors(cart.rank)
+    sb = (jnp.arange(len(nbrs) * 2, dtype=jnp.float32)
+          .reshape(len(nbrs), 2) + 100 * cart.rank)
+    out = cart.Neighbor_alltoall(sb)
+    assert out.shape == (len(nbrs), 2)
+    # host-path cross-check
+    hrecv = np.zeros((len(nbrs), 2), np.float32)
+    cart.Neighbor_alltoall(np.asarray(sb), hrecv)
+    np.testing.assert_array_equal(np.asarray(out), hrecv)
+    assert pvar.read("coll_accelerator_staged") == 0
+    """, 4, mca=MCA)
+
+
+def test_cart_neighbor_open_boundary_null_rows():
+    """Open (non-periodic) boundaries produce PROC_NULL neighbor
+    slots: those rows are zeros on the device path (a fresh array
+    cannot be 'left untouched')."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.pml.request import PROC_NULL
+    cart = comm.Create_cart([4], periods=[False])
+    x = jnp.full((2,), float(cart.rank + 1), jnp.float32)
+    out = cart.Neighbor_allgather(x)
+    nbrs = cart.topo.in_neighbors(cart.rank)
+    assert out.shape == (2, 2)
+    for k, s in enumerate(nbrs):
+        row = np.asarray(out[k])
+        if s == PROC_NULL:
+            np.testing.assert_array_equal(row, np.zeros(2, np.float32))
+        else:
+            np.testing.assert_array_equal(
+                row, np.full(2, s + 1, np.float32))
+    """, 4, mca=MCA)
+
+
+def test_dist_graph_neighbor_device_ragged():
+    """General dist-graph with ragged degrees: the schedule pads to
+    the max degree inside the compiled program and slices per rank."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    # ring + an extra chord 0->2 (rank 0 out-degree 2, rank 2 in 2)
+    outs = {0: [1, 2], 1: [2], 2: [3], 3: [0]}[rank]
+    ins = {0: [3], 1: [0], 2: [1, 0], 3: [2]}[rank]
+    g = comm.Create_dist_graph_adjacent(ins, outs)
+    x = jnp.full((2,), float(g.rank), jnp.float32)
+    out = g.Neighbor_allgather(x)
+    assert out.shape == (len(ins), 2)
+    exp = np.stack([np.full(2, s, np.float32) for s in ins])
+    np.testing.assert_array_equal(np.asarray(out), exp)
+
+    sb = (jnp.arange(len(outs) * 2, dtype=jnp.float32)
+          .reshape(len(outs), 2) + 100 * g.rank)
+    t = g.Neighbor_alltoall(sb)
+    hrecv = np.zeros((len(ins), 2), np.float32)
+    g.Neighbor_alltoall(np.asarray(sb), hrecv)
+    np.testing.assert_array_equal(np.asarray(t), hrecv)
+    assert pvar.read("coll_accelerator_staged") == 0
+    """, 4, mca=MCA)
+
+
+def test_neighbor_device_staging_fallback():
+    """Without the device plane, jax arrays on topo comms still work
+    via the coll/accelerator staging fallback."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    cart = comm.Create_cart([2, 2], periods=[True, True])
+    x = jnp.arange(3, dtype=jnp.float32) + 10 * cart.rank
+    out = cart.Neighbor_allgather(x)
+    nbrs = cart.topo.in_neighbors(cart.rank)
+    exp = np.stack([np.arange(3, dtype=np.float32) + 10 * s
+                    for s in nbrs])
+    np.testing.assert_array_equal(np.asarray(out), exp)
+    assert pvar.read("coll_accelerator_staged") >= 1
+    assert cart.coll.providers["neighbor_allgather_dev"] == "accelerator"
+    """, 4)
+
+
+def test_device_send_recv_vector_datatype():
+    """Strided (vector) datatype over a device array round-trips
+    through Send/Recv with on-device pack/unpack; a packed flat
+    device recv sees exactly the packed elements (the host
+    convertor's wire layout)."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import mpi
+    from ompi_tpu.datatype import datatype as D
+    # 3 blocks of 2 elements, stride 4: elements 0,1,4,5,8,9
+    vec = D.vector(3, 2, 4, D.FLOAT)
+    if rank == 0:
+        x = jnp.arange(12, dtype=jnp.float32)
+        comm.Send((x, 1, vec), dest=1, tag=3)       # device pack
+        comm.Send((x, 1, vec), dest=1, tag=4)       # packed-recv peer
+    else:
+        st = mpi.Status()
+        tpl = jnp.full((12,), -1.0, jnp.float32)
+        out = comm.Recv((tpl, 1, vec), source=0, tag=3, status=st)
+        assert st.count == 6 * 4, st.count  # packed wire bytes
+        h = np.asarray(out)
+        exp = np.full(12, -1.0, np.float32)  # gaps keep template
+        exp[[0, 1, 4, 5, 8, 9]] = [0, 1, 4, 5, 8, 9]
+        np.testing.assert_array_equal(h, exp)
+        # a flat device recv of the same message observes the packed
+        # element layout (convertor wire-format contract)
+        flat = comm.Recv(jnp.zeros(6, jnp.float32), source=0, tag=4)
+        np.testing.assert_array_equal(
+            np.asarray(flat), np.array([0, 1, 4, 5, 8, 9], np.float32))
+    """, 2)
+
+
+def test_device_isend_irecv_subarray_datatype():
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import mpi
+    from ompi_tpu.datatype import datatype as D
+    sub = D.subarray([4, 4], [2, 2], [1, 1], D.FLOAT)
+    if rank == 0:
+        x = jnp.arange(16, dtype=jnp.float32).reshape(4, 4)
+        r = comm.Isend((x, 1, sub), dest=1, tag=8)
+        r.wait(timeout=60)
+    else:
+        tpl = jnp.zeros((4, 4), jnp.float32)
+        r = comm.Irecv((tpl, 1, sub), source=0, tag=8)
+        mpi.wait_all([r], timeout=60)
+        h = np.asarray(r.array)
+        exp = np.zeros((4, 4), np.float32)
+        exp[1:3, 1:3] = np.arange(16, dtype=np.float32
+                                  ).reshape(4, 4)[1:3, 1:3]
+        np.testing.assert_array_equal(h, exp)
+    """, 2)
+
+
+def test_device_allreduce_with_datatype_no_staging():
+    """Derived-datatype device collective: pack -> compiled allreduce
+    -> unpack, all on device."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    from ompi_tpu.datatype import datatype as D
+    vec = D.vector(2, 1, 3, D.FLOAT)  # elements 0 and 3
+    x = jnp.arange(6, dtype=jnp.float32) + rank
+    out = comm.Allreduce((x, 1, vec))
+    h = np.asarray(out)
+    base = np.arange(6, dtype=np.float32) + rank
+    exp = base.copy()  # gaps keep MY template values
+    for i in (0, 3):
+        exp[i] = sum(i + r for r in range(size))
+    np.testing.assert_array_equal(h, exp)
+    assert pvar.read("coll_accelerator_staged") == 0
+    """, 4, mca=MCA)
+
+
+def test_device_datatype_pack_unpack_unit():
+    """Single-process unit coverage for the device convertor route."""
+    import jax.numpy as jnp
+
+    from ompi_tpu.datatype import datatype as D
+    from ompi_tpu.datatype import device as dtdev
+
+    vec = D.vector(3, 2, 4, D.FLOAT)
+    idx = dtdev.element_indices(vec, 1, 4)
+    np.testing.assert_array_equal(idx, [0, 1, 4, 5, 8, 9])
+    idx2 = dtdev.element_indices(vec, 2, 4)  # second element tiles
+    # at the extent (vector extent = (3-1)*4+2 = 10 elements)
+    np.testing.assert_array_equal(
+        idx2, [0, 1, 4, 5, 8, 9, 10, 11, 14, 15, 18, 19])
+
+    x = jnp.arange(24, dtype=jnp.float32)
+    packed = dtdev.pack(x, vec, 2)
+    np.testing.assert_array_equal(np.asarray(packed),
+                                  np.asarray(x)[np.asarray(idx2)])
+    tpl = jnp.full((24,), -1.0, jnp.float32)
+    back = dtdev.unpack(packed, vec, 2, tpl)
+    exp = np.full(24, -1.0, np.float32)
+    exp[np.asarray(idx2)] = np.asarray(x)[np.asarray(idx2)]
+    np.testing.assert_array_equal(np.asarray(back), exp)
+
+    # contiguous tuple form: (array, count) slices the leading count
+    p = dtdev.pack(x, None, 5)
+    assert p.shape == (5,)
+    # struct (byte-granular mixed) types have no device route
+    s = D.create_struct([1, 1], [0, 4],
+                        [D.INT8, D.FLOAT])
+    assert not dtdev.supports(s, x)
+
+
+def test_device_icollective_with_datatype():
+    """Nonblocking Iallreduce/Ibcast accept the (device array, count,
+    datatype) tuple form symmetrically with the blocking paths; the
+    request's .array is the UNPACKED final result."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import mpi
+    from ompi_tpu.datatype import datatype as D
+    vec = D.vector(2, 1, 3, D.FLOAT)  # elements 0 and 3
+    x = jnp.arange(6, dtype=jnp.float32) + rank
+    r = comm.Iallreduce((x, 1, vec))
+    mpi.wait_all([r], timeout=60)
+    h = np.asarray(r.array)
+    exp = (np.arange(6, dtype=np.float32) + rank)
+    for i in (0, 3):
+        exp[i] = sum(i + rr for rr in range(size))
+    np.testing.assert_array_equal(h, exp)
+
+    b = comm.Ibcast((x, 1, vec), root=1)
+    b.wait(timeout=60)
+    h = np.asarray(b.array)
+    exp = (np.arange(6, dtype=np.float32) + rank)
+    for i in (0, 3):
+        exp[i] = i + 1  # root 1's packed elements
+    np.testing.assert_array_equal(h, exp)
+
+    # operations without a device derived-datatype route say so
+    try:
+        comm.Igather((x, 1, vec), root=0)
+    except TypeError as e:
+        assert "no device derived-datatype route" in str(e), e
+    else:
+        raise AssertionError("expected TypeError")
+    """, 2, mca=MCA)
