@@ -38,6 +38,15 @@ class NbcRequest(rq.Request):
         super().__init__()
         self._gen = gen
         self._round: Optional[List[rq.Request]] = None
+        self._rounds_run = 0
+        # MPI_T event metadata, harvested from the unstarted
+        # generator's bound args (no call-site churn): the schedule
+        # kind from its name, the comm from its locals
+        self._kind = getattr(gen, "__name__", "?").replace("_sched_",
+                                                           "")
+        frame = getattr(gen, "gi_frame", None)
+        c = frame.f_locals.get("comm") if frame is not None else None
+        self._comm_cid = getattr(c, "cid", -1)
         global _registered
         if not _registered:
             progress.register(_nbc_progress)
@@ -56,11 +65,19 @@ class NbcRequest(rq.Request):
             while True:
                 self._round = self._gen.send(None)
                 events += 1
+                self._rounds_run += 1
                 if self._round and \
                         not all(r.completed for r in self._round):
                     return events
         except StopIteration:
             _active.remove(self)
+            from ompi_tpu.core import events as mpit_events
+
+            if mpit_events.active("coll_schedule_complete"):
+                mpit_events.emit("coll_schedule_complete",
+                                 kind=self._kind,
+                                 comm_cid=self._comm_cid,
+                                 rounds=self._rounds_run)
             self.complete()
             return events + 1
 

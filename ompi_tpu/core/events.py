@@ -1,0 +1,214 @@
+"""MPI_T events — typed event sources with callback registration.
+
+Reference: the MPI-4 event interface in ompi/mpi/tool/ — 15 event_*.c
+files over a source/callback registration plane
+(event_register_callback.c:22-24, event_copy.c, event_get_info.c,
+event_read.c, event_set_dropped_handler.c). The reference registers
+event TYPES from subsystems (sources), tools allocate handles bound to
+a type and either receive synchronous callbacks or drain a bounded
+per-handle buffer; overflow increments a drop count surfaced through
+the dropped handler.
+
+TPU-first shape: same single-branch hot path as peruse — emitters
+guard on ``active(name)`` so no payload is built while no tool
+listens. Timestamps come from the source's clock
+(time.monotonic_ns — the MPI_T_source_get_timestamp analog), strictly
+ordered per process by a sequence number (MPI_T guarantees
+per-source ordering).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_lock = threading.Lock()
+_seq = itertools.count()
+
+#: source descriptor (MPI_T_source_get_info/source_get_num: one
+#: process-local source whose clock is monotonic_ns)
+SOURCES = [{
+    "name": "ompi_tpu",
+    "desc": "process-local event source (monotonic_ns clock)",
+    "ordering": "ordered",
+    "ticks_per_second": 1_000_000_000,
+}]
+
+
+def source_timestamp() -> int:
+    """MPI_T_source_get_timestamp."""
+    return time.monotonic_ns()
+
+
+class EventType:
+    """A registered event type (MPI_T_event_get_info row)."""
+
+    def __init__(self, index: int, name: str, desc: str,
+                 fields: Tuple[str, ...]) -> None:
+        self.index = index
+        self.name = name
+        self.desc = desc
+        self.fields = fields
+        self.handles: List["EventHandle"] = []
+
+
+#: append-only registry: MPI_T indices stay stable for process life
+_types: Dict[str, EventType] = {}
+_order: List[EventType] = []
+
+
+def register_type(name: str, desc: str = "",
+                  fields: Tuple[str, ...] = ()) -> EventType:
+    """Register an event type (subsystems call at import; idempotent)."""
+    with _lock:
+        t = _types.get(name)
+        if t is None:
+            t = EventType(len(_order), name, desc, tuple(fields))
+            _types[name] = t
+            _order.append(t)
+        return t
+
+
+def active(name: str) -> bool:
+    """Hot-path guard: True only when some handle listens on `name`."""
+    t = _types.get(name)
+    return bool(t is not None and t.handles)
+
+
+class EventInstance:
+    """MPI_T_event_instance: timestamp + element data. `copy()`
+    detaches the payload (event_copy.c — instances are only valid
+    inside the callback in the reference; a copy survives)."""
+
+    __slots__ = ("type_name", "timestamp", "seq", "data")
+
+    def __init__(self, type_name: str, timestamp: int, seq: int,
+                 data: Dict[str, Any]) -> None:
+        self.type_name = type_name
+        self.timestamp = timestamp
+        self.seq = seq
+        self.data = data
+
+    def read(self, field: str):
+        """MPI_T_event_read: one element."""
+        return self.data[field]
+
+    def copy(self) -> "EventInstance":
+        return EventInstance(self.type_name, self.timestamp, self.seq,
+                             dict(self.data))
+
+    def __repr__(self) -> str:
+        return (f"EventInstance({self.type_name}, ts={self.timestamp}, "
+                f"seq={self.seq}, {self.data})")
+
+
+class EventHandle:
+    """MPI_T_event_handle: binds a tool to an event type. Either a
+    synchronous callback (event_register_callback) or a bounded
+    buffer drained with :meth:`read` — overflow drops the newest
+    instance and fires the dropped handler with the running count
+    (event_set_dropped_handler semantics)."""
+
+    def __init__(self, etype: EventType,
+                 callback: Optional[Callable] = None,
+                 buffer_size: int = 256) -> None:
+        self._type = etype
+        self._cb = callback
+        self._buf: List[EventInstance] = []
+        self._cap = int(buffer_size)
+        self.dropped = 0
+        self._dropped_cb: Optional[Callable[[int], None]] = None
+        with _lock:
+            etype.handles.append(self)
+
+    def register_callback(self, cb: Callable) -> None:
+        self._cb = cb
+
+    def set_dropped_handler(self, cb: Callable[[int], None]) -> None:
+        self._dropped_cb = cb
+
+    def _deliver(self, inst: EventInstance) -> None:
+        if self._cb is not None:
+            self._cb(inst)
+            return
+        if len(self._buf) >= self._cap:
+            self.dropped += 1
+            if self._dropped_cb is not None:
+                self._dropped_cb(self.dropped)
+            return
+        self._buf.append(inst)
+
+    def read(self) -> Optional[EventInstance]:
+        """Drain the oldest buffered instance (buffered mode)."""
+        return self._buf.pop(0) if self._buf else None
+
+    def free(self) -> None:
+        with _lock:
+            if self in self._type.handles:
+                self._type.handles.remove(self)
+        self._buf.clear()
+
+
+def emit(name: str, **data) -> None:
+    """Raise an event instance to every handle on `name`. Emitters
+    should guard with ``if events.active(name):`` so payload dicts
+    are never built on the silent path."""
+    t = _types.get(name)
+    if t is None or not t.handles:
+        return
+    inst = EventInstance(name, source_timestamp(), next(_seq), data)
+    for h in tuple(t.handles):
+        h._deliver(inst)
+
+
+# -- introspection (mpit.py face) ----------------------------------------
+
+def get_num() -> int:
+    return len(_order)
+
+
+def get_info(index: int) -> Dict[str, Any]:
+    t = _order[index]
+    return {"name": t.name, "desc": t.desc, "fields": list(t.fields),
+            "index": t.index, "source": 0}
+
+
+def index_of(name: str) -> int:
+    return _types[name].index
+
+
+def handle_alloc(name_or_index, callback=None,
+                 buffer_size: int = 256) -> EventHandle:
+    t = (_order[name_or_index] if isinstance(name_or_index, int)
+         else _types[name_or_index])
+    return EventHandle(t, callback, buffer_size)
+
+
+def reset_for_testing() -> None:
+    with _lock:
+        for t in _order:
+            t.handles.clear()
+
+
+# -- built-in event types (the reference registers its sources at
+# framework open; ours register at import so indices are stable) ------
+
+PML_MATCH = register_type(
+    "pml_message_matched",
+    "a receive matched an incoming message (ob1 matching engine)",
+    ("ctx", "src", "tag", "size", "from_unexpected"))
+PML_UNEXPECTED = register_type(
+    "pml_unexpected_queued",
+    "an incoming message was appended to the unexpected queue "
+    "(no posted receive matched)",
+    ("ctx", "src", "tag", "size", "depth"))
+COLL_COMPLETE = register_type(
+    "coll_schedule_complete",
+    "a nonblocking collective schedule finished (coll/libnbc)",
+    ("kind", "comm_cid", "rounds"))
+FT_FAILURE = register_type(
+    "ft_process_failure",
+    "the failure detector declared a peer dead",
+    ("rank", "reason"))

@@ -160,6 +160,12 @@ class Detector:
             self._applied_dead.update(new_dead)
             _out.verbose(1, "rank %d: failures detected: %s",
                          rte.rank, new_dead)
+            from ompi_tpu.core import events as mpit_events
+
+            for r, why in new_dead.items():
+                if mpit_events.active("ft_process_failure"):
+                    mpit_events.emit("ft_process_failure", rank=r,
+                                     reason=why)
             events += self._apply_faults(set(new_dead))
         new_rev = self.revoked_cids - self._applied_revokes
         if new_rev:

@@ -35,7 +35,7 @@ import numpy as np
 
 from ompi_tpu import errors
 from ompi_tpu.btl import base as btl_base
-from ompi_tpu.core import arch, memchecker, mpool, output, pvar
+from ompi_tpu.core import arch, events, memchecker, mpool, output, pvar
 from ompi_tpu.datatype import BYTE, Convertor
 from ompi_tpu.datatype.convertor import dtype_of
 from ompi_tpu.pml import peruse
@@ -446,6 +446,10 @@ class Ob1:
                     peruse.fire(peruse.REQ_MATCH_UNEX, ctx=req.ctx,
                                 src=ux.hdr[2], tag=ux.hdr[3],
                                 size=ux.hdr[5], msgid=ux.hdr[7])
+                if events.active("pml_message_matched"):
+                    events.emit("pml_message_matched", ctx=req.ctx,
+                                src=ux.hdr[2], tag=ux.hdr[3],
+                                size=ux.hdr[5], from_unexpected=True)
                 self._match(req, ux.hdr, ux.payload, ux.src_world)
                 return
         self.posted.setdefault(req.ctx, deque()).append(req)
@@ -596,14 +600,21 @@ class Ob1:
                     peruse.fire(peruse.REQ_REMOVE_FROM_POSTED_Q,
                                 ctx=ctx, src=src, tag=tag, size=size,
                                 msgid=msgid)
+                if events.active("pml_message_matched"):
+                    events.emit("pml_message_matched", ctx=ctx,
+                                src=src, tag=tag, size=size,
+                                from_unexpected=False)
                 self._match(req, hdr, payload, self._src_world(ctx, src))
                 return
         pvar.record("unexpected")
-        self.unexpected.setdefault(ctx, deque()).append(
-            _Unexpected(hdr, payload, self._src_world(ctx, src)))
+        uq = self.unexpected.setdefault(ctx, deque())
+        uq.append(_Unexpected(hdr, payload, self._src_world(ctx, src)))
         if peruse.active:
             peruse.fire(peruse.MSG_INSERT_IN_UNEX_Q, ctx=ctx, src=src,
                         tag=tag, size=size, msgid=msgid)
+        if events.active("pml_unexpected_queued"):
+            events.emit("pml_unexpected_queued", ctx=ctx, src=src,
+                        tag=tag, size=size, depth=len(uq))
 
     @staticmethod
     def _src_world(ctx: int, src_commrank: int) -> int:

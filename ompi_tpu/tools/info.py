@@ -91,6 +91,10 @@ def collect(level: int = 3,
             out["cvars"][name]["choices"] = list(var.choices)
     if include_pvars:
         out["pvars"] = pvar.snapshot()
+    from ompi_tpu.core import events
+
+    out["events"] = [events.get_info(i)
+                     for i in range(events.get_num())]
     return out
 
 
@@ -116,6 +120,12 @@ def render(info: Dict, verbose_help: bool = False) -> List[str]:
         lines.append(f"Performance variables ({len(info['pvars'])}):")
         for name, val in sorted(info["pvars"].items()):
             lines.append(f"  {name:<34} {val}")
+    if info.get("events"):
+        lines.append("")
+        lines.append(f"Event types ({len(info['events'])}):")
+        for ev in info["events"]:
+            lines.append(f"  {ev['name']:<34} "
+                         f"({', '.join(ev['fields'])})")
     return lines
 
 
