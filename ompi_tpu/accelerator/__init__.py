@@ -163,6 +163,19 @@ class Accelerator(registry.Component):
         keys, ompi/info/info_memkind.*)."""
         return [{"name": "host", "kind": "system"}]
 
+    def memkinds(self) -> list:
+        """MPI-4.1 ``mpi_memory_alloc_kinds`` strings this component
+        contributes (info_memkind.c): the component name as the kind
+        plus one ``name:region`` restrictor per device memkind row —
+        the cuda/cuda:device pattern; tpu yields ['tpu', 'tpu:hbm']."""
+        out = []
+        for row in self.memkind_info():
+            if row.get("kind") == "device":
+                if self.NAME not in out:
+                    out.append(self.NAME)
+                out.append(f"{self.NAME}:{row['name']}")
+        return out
+
     # -- host registration (reference: host_register/unregister) ---------
     def host_register(self, arr) -> int:
         """Record a host region as transfer-hot. PJRT manages pinning

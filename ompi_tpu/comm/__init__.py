@@ -102,8 +102,10 @@ class Communicator:
         self.group = group
         self.cid = cid
         self.errhandler = errhandler
+        from ompi_tpu.info import Info
+
         self.attrs: Dict[object, object] = {}  # MPI_Comm_set_attr
-        self.info: Dict[str, str] = {}
+        self.info = Info()  # MPI_Comm_set_info plane
         self.name = f"comm#{cid}"
         self.revoked = False  # ULFM state
         self.coll = None  # installed by coll.comm_select
@@ -145,10 +147,13 @@ class Communicator:
 
     # -- construction (collective over self) ------------------------------
     def dup(self) -> "Communicator":
-        """MPI_Comm_dup."""
+        """MPI_Comm_dup (errhandler AND info hints propagate — MPI-4
+        §7.4.1 dups the info to the new communicator)."""
         cid = self._agree_cid(f"dup:{self.cid}")
-        return Communicator(Group(self.group.ranks), cid,
-                            self.errhandler)
+        c = Communicator(Group(self.group.ranks), cid,
+                         self.errhandler)
+        c.info = self.info.dup()
+        return c
 
     def split(self, color: int, key: int = 0) -> Optional["Communicator"]:
         """MPI_Comm_split — gather (color,key) at root, compute groups,
@@ -177,7 +182,9 @@ class Communicator:
         if mine is None:
             return None
         ranks, cid = mine
-        return Communicator(Group(ranks), cid, self.errhandler)
+        c = Communicator(Group(ranks), cid, self.errhandler)
+        c.info = self.info.dup()  # hints propagate like errhandler
+        return c
 
     def split_type(self, split_type: str = "shared",
                    key: int = 0) -> Optional["Communicator"]:

@@ -56,12 +56,23 @@ def _child_env(world_rank: int, i: int, maxprocs: int, offset: int,
 
 def comm_spawn(command: str, args: Sequence[str] = (),
                maxprocs: int = 1, comm=None, root: int = 0,
-               mca: Optional[Dict[str, str]] = None):
+               mca: Optional[Dict[str, str]] = None, info=None):
     """MPI_Comm_spawn: start maxprocs copies of ``command`` (a python
     script; append ``args``) and return the parent↔children
-    intercommunicator. Collective over ``comm``."""
+    intercommunicator. Collective over ``comm``. ``info`` accepts an
+    MPI_Info/dict; recognized keys: ``mca_<name>`` entries merge into
+    ``mca`` (the reference forwards spawn info keys to PRRTE the same
+    way, ompi/dpm/dpm.c)."""
     from ompi_tpu.comm.intercomm import comm_accept, open_port
     from ompi_tpu.runtime import state
+
+    if info is not None:
+        from ompi_tpu.info import as_info
+
+        mca = dict(mca or {})
+        for k, v in as_info(info).items():
+            if k.startswith("mca_"):
+                mca.setdefault(k[4:], v)
 
     if comm is None:
         comm = state.world()

@@ -92,14 +92,61 @@ _CLASS_MAP = {
 }
 
 
-def raise_mpi_error(error_class: int, msg: str = "") -> None:
+def make_mpi_error(error_class: int, msg: str = "") -> MPIError:
     cls = _CLASS_MAP.get(error_class)
     if cls is not None:
-        raise cls() if not msg else cls(msg)
-    raise MPIError(error_class, msg)
+        return cls() if not msg else cls(msg)
+    return MPIError(error_class, msg)
+
+
+def raise_mpi_error(error_class: int, msg: str = "") -> None:
+    raise make_mpi_error(error_class, msg)
 
 
 # errhandlers (reference: MPI_ERRORS_ARE_FATAL default on comms)
 ERRORS_ARE_FATAL = "errors_are_fatal"
 ERRORS_RETURN = "errors_return"
 ERRORS_ABORT = "errors_abort"
+
+
+class Errhandler:
+    """A user-callback error handler (reference: ompi_errhandler_create,
+    ompi/errhandler/errhandler.h:401; installed via
+    MPI_Comm/Win/File_create_errhandler + set_errhandler).
+
+    The callback receives ``(obj, exc)`` — the comm/win/file the error
+    was raised on and the MPIError. If it RETURNS normally the error
+    is considered handled and the failing operation recovers (returns
+    None — the Python rendering of 'the MPI call returns after the
+    handler'); the callback may also raise (re-raise exc, or a
+    transformed error) to propagate.
+
+    Note on the string modes: ERRORS_RETURN raises the Python
+    exception to the caller; ERRORS_ARE_FATAL is the same raise — an
+    uncaught Python exception kills the rank and the launcher tears
+    the job down, which IS the reference's fatal behavior."""
+
+    def __init__(self, fn) -> None:
+        if not callable(fn):
+            raise TypeError("errhandler callback must be callable")
+        self.fn = fn
+
+    def __call__(self, obj, exc: MPIError):
+        return self.fn(obj, exc)
+
+
+def create_errhandler(fn) -> Errhandler:
+    """MPI_{Comm,Win,File}_create_errhandler."""
+    return Errhandler(fn)
+
+
+def dispatch(obj, exc: MPIError) -> bool:
+    """Route `exc` through obj's errhandler (the reference's
+    OMPI_ERRHANDLER_INVOKE at every binding's error exit). Returns
+    True when a user callback handled it (caller recovers); raises
+    otherwise (string modes — see Errhandler docstring)."""
+    eh = getattr(obj, "errhandler", None)
+    if isinstance(eh, Errhandler):
+        eh(obj, exc)  # may itself raise to propagate
+        return True
+    raise exc

@@ -1,0 +1,172 @@
+"""MPI_Info objects + memory-allocation-kind negotiation.
+
+Reference: ompi/info/info.c (the MPI_Info object over opal key/value
+lists: set/get/delete/dup, ordered nth-key access, MPI_INFO_ENV) and
+ompi/info/info_memkind.c (the MPI-4.1 ``mpi_memory_alloc_kinds``
+negotiation — the launcher/user REQUESTS kinds, the implementation
+answers with the subset it actually supports; the accelerator
+framework contributes its device kinds,
+opal/mca/accelerator/accelerator.h:84).
+
+TPU-first mapping: the device kinds come from the selected
+accelerator component — ``tpu`` / ``tpu:hbm`` when the TPU component
+is live (the reference's ``cuda``/``cuda:device`` analog), nothing
+from accelerator/null.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, Iterator, List, Optional, Tuple
+
+MAX_INFO_KEY = 255
+MAX_INFO_VAL = 1024
+
+#: MPI-4.1 memory allocation kinds key (info_memkind.c)
+MEMORY_ALLOC_KINDS = "mpi_memory_alloc_kinds"
+
+
+class Info:
+    """MPI_Info: an ordered string->string map with MPI length
+    limits. Keys keep insertion order (MPI_Info_get_nthkey contract:
+    the nth key is stable across reads)."""
+
+    def __init__(self, items=None) -> None:
+        self._d: Dict[str, str] = {}
+        if items:
+            pairs = items.items() if hasattr(items, "items") else items
+            for k, v in pairs:
+                self.set(k, v)
+
+    # -- MPI surface ------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        key, value = str(key), str(value)
+        if len(key) > MAX_INFO_KEY:
+            raise ValueError(f"info key exceeds {MAX_INFO_KEY} chars")
+        if len(value) > MAX_INFO_VAL:
+            raise ValueError(f"info value exceeds {MAX_INFO_VAL} chars")
+        self._d[key] = value
+
+    def get(self, key: str, default: Optional[str] = None):
+        return self._d.get(key, default)
+
+    def delete(self, key: str) -> None:
+        if key not in self._d:
+            raise KeyError(key)
+        del self._d[key]
+
+    def get_nkeys(self) -> int:
+        return len(self._d)
+
+    def get_nthkey(self, n: int) -> str:
+        return list(self._d)[n]
+
+    def dup(self) -> "Info":
+        return Info(self._d)
+
+    def free(self) -> None:  # handles are GC'd; API parity
+        self._d.clear()
+
+    # -- pythonic face ----------------------------------------------------
+    def items(self) -> List[Tuple[str, str]]:
+        return list(self._d.items())
+
+    def keys(self) -> List[str]:
+        return list(self._d)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._d
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._d)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __getitem__(self, key: str) -> str:
+        return self._d[key]
+
+    def __setitem__(self, key: str, value) -> None:
+        self.set(key, value)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Info) and self._d == other._d
+
+    def __repr__(self) -> str:
+        return f"Info({self._d})"
+
+
+def as_info(obj) -> Info:
+    """Coerce None/dict/Info to a NEW Info. Always copies — MPI
+    semantics: info is captured at object creation (info.c dups on
+    every set), so later caller mutations must not leak in, and
+    apply_memkinds' granted-subset rewrite must not clobber the
+    caller's original request string."""
+    if obj is None:
+        return Info()
+    if isinstance(obj, Info):
+        return obj.dup()
+    return Info(obj)
+
+
+def env_info() -> Info:
+    """MPI_INFO_ENV (reference: ompi_mpi_info_env, info.c)."""
+    import os
+
+    from ompi_tpu.runtime import rte
+
+    inf = Info()
+    inf.set("command", sys.argv[0] if sys.argv else "")
+    inf.set("argv", " ".join(sys.argv[1:]))
+    inf.set("maxprocs", str(rte.size if rte.is_launched() else 1))
+    inf.set("soft", "")
+    inf.set("host", rte.hostname() if rte.is_launched()
+            else os.uname().nodename)
+    inf.set("arch", os.uname().machine)
+    inf.set("wdir", os.getcwd())
+    inf.set("thread_level", "MPI_THREAD_MULTIPLE")
+    return inf
+
+
+# -- memory allocation kinds (info_memkind.c) ----------------------------
+
+def supported_memkinds() -> List[str]:
+    """Kinds this build can actually allocate/operate on: the MPI-4.1
+    base kinds plus whatever the selected accelerator contributes
+    (the reference asks each accelerator component the same way,
+    accelerator.h:84)."""
+    kinds = ["system", "mpi", "mpi:alloc_mem", "mpi:win_allocate"]
+    try:
+        from ompi_tpu import accelerator
+
+        kinds.extend(accelerator.current().memkinds())
+    except Exception:
+        pass
+    return kinds
+
+
+def memkind_grant(requested: str) -> str:
+    """Negotiate ``mpi_memory_alloc_kinds``: the returned value is the
+    comma-list subset of `requested` the implementation supports —
+    restrictors (``kind:restrictor``) are granted only if the exact
+    pair is supported; a bare kind matches itself. Unknown kinds are
+    dropped (the standard's behavior: the answer is authoritative)."""
+    have = set(supported_memkinds())
+    granted = []
+    for k in (s.strip() for s in requested.split(",")):
+        if not k:
+            continue
+        if k in have and k not in granted:
+            granted.append(k)
+    return ",".join(granted)
+
+
+def apply_memkinds(info: Info) -> Info:
+    """Rewrite the memkind request in `info` (if any) to the granted
+    subset — called by every object-creation acceptance point
+    (session/win/file/comm), mirroring info_memkind.c's assert at
+    object creation."""
+    req = info.get(MEMORY_ALLOC_KINDS)
+    if req is not None:
+        info.set(MEMORY_ALLOC_KINDS, memkind_grant(req))
+    return info

@@ -81,10 +81,18 @@ class File:
     """MPI_File: per-comm file handle with views + individual,
     collective, shared and nonblocking I/O."""
 
-    def __init__(self, comm, filename: str, amode: int) -> None:
+    def __init__(self, comm, filename: str, amode: int,
+                 info=None) -> None:
+        from ompi_tpu.info import apply_memkinds, as_info
+
         self.comm = comm
         self.filename = filename
         self.amode = amode
+        # MPI_File_set/get_info + the reference's default file
+        # errhandler ERRORS_RETURN (errhandler.h: files default to
+        # return, comms/wins to fatal)
+        self.info = apply_memkinds(as_info(info))
+        self.errhandler = errors.ERRORS_RETURN
         self.view = FileView()
         self._pos = 0          # individual pointer, visible bytes
         self._lock = threading.Lock()
@@ -148,23 +156,54 @@ class File:
     def Get_view(self) -> Tuple[int, dt_mod.Datatype, dt_mod.Datatype]:
         return self.view.disp, self.view.etype, self.view.filetype
 
+    # -- errhandler plane (MPI_File_set_errhandler) -----------------------
+    def Set_errhandler(self, eh) -> None:
+        self.errhandler = eh
+
+    def Get_errhandler(self):
+        return self.errhandler
+
+    def Set_info(self, info) -> None:
+        from ompi_tpu.info import apply_memkinds, as_info
+
+        self.info = apply_memkinds(as_info(info))
+
+    def Get_info(self):
+        return self.info.dup()  # MPI: get_info returns a new object
+
     # -- raw span I/O (fbtl equivalent) -----------------------------------
+    # OS failures route through the file's errhandler (the
+    # OMPI_ERRHANDLER_INVOKE pattern at every io binding's error
+    # exit); a user callback that returns makes the op a recovered
+    # no-op (0 bytes / empty read).
     def _pwritev(self, extents: List[Tuple[int, int]],
                  data: bytes) -> int:
         done = 0
-        for off, length in extents:
-            os.pwrite(self.fd, data[done:done + length], off)
-            done += length
+        try:
+            for off, length in extents:
+                os.pwrite(self.fd, data[done:done + length], off)
+                done += length
+        except (OSError, TypeError) as exc:
+            errors.dispatch(self, errors.MPIError(
+                errors.ERR_FILE, f"{self.filename}: {exc}"))
+            # recovered by a callback: fall through so the bytes that
+            # DID land on disk are still counted
         pvar.record("file_write_bytes", done)
         return done
 
     def _preadv(self, extents: List[Tuple[int, int]]) -> bytes:
         parts = []
-        for off, length in extents:
-            chunk = os.pread(self.fd, length, off)
-            if len(chunk) < length:  # short read past EOF: zero-fill
-                chunk += b"\0" * (length - len(chunk))
-            parts.append(chunk)
+        try:
+            for off, length in extents:
+                chunk = os.pread(self.fd, length, off)
+                if len(chunk) < length:  # short read past EOF:
+                    chunk += b"\0" * (length - len(chunk))  # zero-fill
+                parts.append(chunk)
+        except (OSError, TypeError) as exc:
+            if errors.dispatch(self, errors.MPIError(
+                    errors.ERR_FILE, f"{self.filename}: {exc}")):
+                # recovered: zero-fill what the caller expected
+                parts = [b"\0" * length for _, length in extents]
         out = b"".join(parts)
         pvar.record("file_read_bytes", len(out))
         return out
@@ -301,9 +340,9 @@ class File:
 # -- module-level API ------------------------------------------------------
 
 def File_open(comm, filename: str,
-              amode: int = MODE_RDONLY) -> File:
+              amode: int = MODE_RDONLY, info=None) -> File:
     """MPI_File_open (collective over comm)."""
-    f = File(comm, filename, amode)
+    f = File(comm, filename, amode, info=info)
     comm.Barrier()  # open is collective; surface create races together
     return f
 

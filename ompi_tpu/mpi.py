@@ -260,10 +260,14 @@ def _Isend(self, buf, dest: int, tag: int = 0) -> rq.Request:
         from ompi_tpu.pml import accel_p2p
 
         arr, count, dt = d
-        return accel_p2p.isend_dev(self, _dev_pack(arr, count, dt),
-                                   dest, tag)
+        req = accel_p2p.isend_dev(self, _dev_pack(arr, count, dt),
+                                  dest, tag)
+        req.comm = self  # errhandler dispatch at wait (request.py)
+        return req
     arr, count, dt = _parse_buf(buf)
-    return pml.current().isend(self, arr, count, dt, dest, tag)
+    req = pml.current().isend(self, arr, count, dt, dest, tag)
+    req.comm = self
+    return req
 
 
 def _Ssend(self, buf, dest: int, tag: int = 0) -> None:
@@ -333,10 +337,14 @@ def _Irecv(self, buf, source: int = ANY_SOURCE,
 
         arr, count, dt = d
         like, tr = _dev_recv_plan(arr, count, dt)
-        return accel_p2p.irecv_dev(self, like, source, tag,
-                                   transform=tr)
+        req = accel_p2p.irecv_dev(self, like, source, tag,
+                                  transform=tr)
+        req.comm = self  # errhandler dispatch at wait (request.py)
+        return req
     arr, count, dt = _parse_buf(buf)
-    return pml.current().irecv(self, arr, count, dt, source, tag)
+    req = pml.current().irecv(self, arr, count, dt, source, tag)
+    req.comm = self
+    return req
 
 
 def _Sendrecv(self, sendbuf, dest: int, recvbuf, source: int = ANY_SOURCE,
@@ -1030,6 +1038,50 @@ def _reduce(self, obj, op=None, root: int = 0):
     return acc
 
 
+# -- errhandler + info planes (ompi/errhandler, ompi/info) ---------------
+
+def _Set_errhandler(self, eh) -> None:
+    """MPI_Comm_set_errhandler: a string mode (mpi.ERRORS_RETURN /
+    ERRORS_ARE_FATAL) or an errors.Errhandler callback
+    (Comm_create_errhandler). Inherited by dup/split."""
+    self.errhandler = eh
+
+
+def _Get_errhandler(self):
+    return self.errhandler
+
+
+def _Set_info(self, info) -> None:
+    """MPI_Comm_set_info; a mpi_memory_alloc_kinds request is
+    answered with the granted subset (info_memkind.c)."""
+    from ompi_tpu.info import apply_memkinds, as_info
+
+    self.info = apply_memkinds(as_info(info))
+
+
+def _Get_info(self):
+    from ompi_tpu.info import as_info
+
+    return as_info(self.info)
+
+
+def _with_errhandler(fn):
+    """Route MPIErrors escaping an API binding through the comm's
+    errhandler (the reference's OMPI_ERRHANDLER_INVOKE at every
+    binding's error exit, e.g. allreduce.c). String modes re-raise;
+    a user-callback handler that returns makes the operation recover
+    (the call returns None)."""
+    def wrapped(self, *a, **kw):
+        try:
+            return fn(self, *a, **kw)
+        except errors.MPIError as exc:
+            errors.dispatch(self, exc)  # raises unless a callback
+            return None                 # handled it
+    wrapped.__name__ = fn.__name__
+    wrapped.__doc__ = fn.__doc__
+    return wrapped
+
+
 _pending_bsends: List[rq.Request] = []
 
 
@@ -1038,6 +1090,19 @@ def _flush_bsends() -> None:
         r.wait()
     _pending_bsends.clear()
 
+
+#: capitalized buffer ops whose errors route through the comm's
+#: errhandler (the OMPI_ERRHANDLER_INVOKE set). i-variants surface
+#: errors at wait: Isend/Irecv stamp ``.comm`` on their requests and
+#: Request.wait dispatches on it (the reference likewise invokes on
+#: the request's comm at completion).
+_ERRHANDLED = (
+    "Send", "Recv", "Ssend", "Rsend", "Bsend", "Sendrecv",
+    "Sendrecv_replace", "Mrecv", "Probe", "Barrier", "Bcast",
+    "Reduce", "Allreduce", "Gather", "Gatherv", "Scatter", "Scatterv",
+    "Allgather", "Allgatherv", "Alltoall", "Alltoallv",
+    "Reduce_scatter", "Reduce_scatter_block", "Scan", "Exscan",
+)
 
 _API = {
     "send": _send, "isend": _isend, "recv": _recv, "irecv": _irecv,
@@ -1064,6 +1129,9 @@ _API = {
     "Reduce_scatter": _Reduce_scatter,
     "Reduce_scatter_block": _Reduce_scatter_block,
     "Scan": _Scan, "Exscan": _Exscan,
+    "Set_errhandler": _Set_errhandler,
+    "Get_errhandler": _Get_errhandler,
+    "Set_info": _Set_info, "Get_info": _Get_info,
     "Ibarrier": _Ibarrier, "Ibcast": _Ibcast,
     "Iallreduce": _Iallreduce, "Ireduce": _Ireduce,
     "Igather": _Igather, "Iscatter": _Iscatter,
@@ -1081,7 +1149,8 @@ _API = {
 }
 
 for _name, _fn in _API.items():
-    setattr(Communicator, _name, _fn)
+    setattr(Communicator, _name,
+            _with_errhandler(_fn) if _name in _ERRHANDLED else _fn)
 
 # topology API (Create_cart/Cart_sub/Neighbor_*) attaches its own
 # Communicator methods at import (ompi/mca/topo equivalent)
@@ -1113,6 +1182,20 @@ from ompi_tpu.dpm import (  # noqa: E402,F401
 from ompi_tpu.datatype.convertor import (  # noqa: E402,F401
     pack as Pack, pack_external as Pack_external, unpack as Unpack,
     unpack_external as Unpack_external,
+)
+
+# MPI_Info objects (ompi/info/info.c) + memkind plane (info_memkind.c)
+from ompi_tpu.info import (  # noqa: E402,F401
+    Info, MEMORY_ALLOC_KINDS, env_info as Info_env,
+)
+
+# errhandler factories (ompi/errhandler/errhandler.h:401) — one
+# factory serves all three object classes, as in the reference
+from ompi_tpu.errors import (  # noqa: E402,F401
+    ERRORS_ABORT, ERRORS_ARE_FATAL, ERRORS_RETURN, Errhandler,
+    create_errhandler as Comm_create_errhandler,
+    create_errhandler as Win_create_errhandler,
+    create_errhandler as File_create_errhandler,
 )
 
 

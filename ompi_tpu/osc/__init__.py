@@ -83,7 +83,14 @@ class Window:
     it when all ranks move data together."""
 
     def __init__(self, comm, base: Optional[np.ndarray],
-                 disp_unit: int = 1) -> None:
+                 disp_unit: int = 1, info=None) -> None:
+        from ompi_tpu import errors as _errs
+        from ompi_tpu.info import apply_memkinds, as_info
+
+        # MPI_Win_set/get_info plane; a mpi_memory_alloc_kinds request
+        # is answered with the granted subset (info_memkind.c)
+        self.info = apply_memkinds(as_info(info))
+        self.errhandler = _errs.ERRORS_ARE_FATAL  # reference default
         self.comm = comm.dup()  # private comm: tag isolation
         self._dev_like = None
         self._dev_cache = None
@@ -345,8 +352,39 @@ class Window:
         else:
             self._send(target, msg)
 
+    # -- errhandler plane (MPI_Win_set_errhandler; reference default
+    # ERRORS_ARE_FATAL, errhandler.h) --------------------------------
+    def Set_errhandler(self, eh) -> None:
+        self.errhandler = eh
+
+    def Get_errhandler(self):
+        return self.errhandler
+
+    def Set_info(self, info) -> None:
+        from ompi_tpu.info import apply_memkinds, as_info
+
+        self.info = apply_memkinds(as_info(info))
+
+    def Get_info(self):
+        return self.info.dup()  # MPI: get_info returns a new object
+
+    def _check_target(self, target: int) -> bool:
+        """Validate a target rank, routing failures through the
+        window's errhandler (the OMPI_ERRHANDLER_INVOKE pattern at
+        every osc binding's error exit). Returns False when a user
+        callback handled the error (caller recovers as a no-op)."""
+        if 0 <= target < self.size:
+            return True
+        from ompi_tpu import errors as _errs
+
+        return not _errs.dispatch(self, _errs.RankError(
+            f"RMA target rank {target} out of range for {self.name} "
+            f"(size {self.size})"))
+
     def Put(self, buf, target: int, disp: int = 0) -> None:
         pvar.record("osc_put")
+        if not self._check_target(target):
+            return
         data = np.ascontiguousarray(self._stage_origin(buf))
         self._count_op(target, ackable=True)
         self._local_or_send(target, ("put", disp, data))
@@ -356,6 +394,8 @@ class Window:
         dtype template and a NEW device array is returned (PJRT
         buffers are immutable — documented staging semantics)."""
         pvar.record("osc_get")
+        if not self._check_target(target):
+            return None
         if _is_dev(buf):
             from ompi_tpu import accelerator
 
@@ -427,6 +467,8 @@ class Window:
         stride in buf's dtype units) — the shmem_iput transport; one
         AM message regardless of element count."""
         pvar.record("osc_put")
+        if not self._check_target(target):
+            return
         data = np.ascontiguousarray(self._stage_origin(buf))
         self._count_op(target, ackable=True)
         self._local_or_send(target, ("puts", disp, int(stride), data))
@@ -436,6 +478,8 @@ class Window:
         """Fills buf with target elements at disp, disp+stride, ...
         (the shmem_iget transport)."""
         pvar.record("osc_get")
+        if not self._check_target(target):
+            return
         req = _WinRequest(self)
         req_id = self._alloc_id()
         self._pending[req_id] = ("get", (buf, req))
@@ -447,6 +491,10 @@ class Window:
         req.wait()
 
     def Rget(self, buf, target: int, disp: int = 0) -> Request:
+        if not self._check_target(target):
+            req = _WinRequest(self)
+            req.complete()  # recovered no-op: immediately complete
+            return req
         req = _WinRequest(self)
         req_id = self._alloc_id()
         self._pending[req_id] = ("get", (buf, req))
@@ -459,12 +507,16 @@ class Window:
     def Accumulate(self, buf, target: int, disp: int = 0,
                    op: op_mod.Op = op_mod.SUM) -> None:
         pvar.record("osc_acc")
+        if not self._check_target(target):
+            return
         data = np.ascontiguousarray(self._stage_origin(buf))
         self._count_op(target, ackable=True)
         self._local_or_send(target, ("acc", disp, op.name, data))
 
     def Get_accumulate(self, origin, result, target: int, disp: int = 0,
                        op: op_mod.Op = op_mod.SUM) -> None:
+        if not self._check_target(target):
+            return
         req = _WinRequest(self)
         req_id = self._alloc_id()
         self._pending[req_id] = ("get_acc", (result, req))
@@ -476,6 +528,8 @@ class Window:
 
     def Fetch_and_op(self, value, result, target: int, disp: int = 0,
                      op: op_mod.Op = op_mod.SUM) -> None:
+        if not self._check_target(target):
+            return
         req = _WinRequest(self)
         req_id = self._alloc_id()
         self._pending[req_id] = ("fetch_op", (result, req))
@@ -487,6 +541,8 @@ class Window:
 
     def Compare_and_swap(self, value, compare, result, target: int,
                          disp: int = 0) -> None:
+        if not self._check_target(target):
+            return
         req = _WinRequest(self)
         req_id = self._alloc_id()
         self._pending[req_id] = ("cas", (result, req))
@@ -711,9 +767,10 @@ class SharedWindow(Window):
             pass
 
 
-def win_create(comm, base: np.ndarray, disp_unit: int = 1) -> Window:
+def win_create(comm, base: np.ndarray, disp_unit: int = 1,
+               info=None) -> Window:
     """MPI_Win_create."""
-    return Window(comm, base, disp_unit)
+    return Window(comm, base, disp_unit, info=info)
 
 
 def win_allocate_shared(comm, nbytes: int,
@@ -728,8 +785,9 @@ def win_create_dynamic(comm) -> DynamicWindow:
 
 
 def win_allocate(comm, shape, dtype=np.uint8,
-                 disp_unit: Optional[int] = None) -> Window:
+                 disp_unit: Optional[int] = None,
+                 info=None) -> Window:
     """MPI_Win_allocate."""
     arr = np.zeros(shape, dtype)
     du = disp_unit if disp_unit is not None else arr.dtype.itemsize
-    return Window(comm, arr, du)
+    return Window(comm, arr, du, info=info)

@@ -70,9 +70,22 @@ class Request:
         if not self.completed:
             raise TimeoutError(f"request {self.id} did not complete")
         if self.status.error:
-            from ompi_tpu.errors import raise_mpi_error
+            # nonblocking errors surface HERE, so the errhandler
+            # dispatch happens here too (the reference invokes the
+            # request's comm errhandler at completion). The API layer
+            # stamps .comm on requests it hands out; a user callback
+            # that returns makes wait() a recovery (status returned,
+            # error field still set for inspection).
+            from ompi_tpu import errors
 
-            raise_mpi_error(self.status.error)
+            comm = getattr(self, "comm", None)
+            if comm is not None and isinstance(
+                    getattr(comm, "errhandler", None),
+                    errors.Errhandler):
+                errors.dispatch(comm, errors.make_mpi_error(
+                    self.status.error))
+                return self.status
+            errors.raise_mpi_error(self.status.error)
         return self.status
 
     def cancel(self) -> None:

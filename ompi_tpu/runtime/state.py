@@ -236,10 +236,20 @@ class Session:
     PSET_HOST = "ompi_tpu://HOST"
 
     def __init__(self, info: Optional[dict] = None) -> None:
-        self.info = dict(info or {})
+        from ompi_tpu.info import apply_memkinds, as_info
+
+        # MPI_Session_init accepts an Info; a mpi_memory_alloc_kinds
+        # request is answered with the granted subset (the MPI-4.1
+        # memkind negotiation happens at session init in the
+        # reference, ompi/info/info_memkind.c)
+        self.info = apply_memkinds(as_info(info))
         _acquire()
         self._open = True
         _open_sessions.add(self)
+
+    def get_info(self):
+        """MPI_Session_get_info (returns a new Info, per MPI)."""
+        return self.info.dup()
 
     # -- process sets (MPI_Session_get_num_psets / get_nth_pset) --------
     def num_psets(self) -> int:

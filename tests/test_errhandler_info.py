@@ -1,0 +1,255 @@
+"""Errhandler callbacks + MPI_Info plane (r3 VERDICT missing #2+#3).
+
+Reference parity: ompi_errhandler_create
+(ompi/errhandler/errhandler.h:401) invoked at every binding's error
+exit; ompi/info/info.c object semantics; info_memkind.c
+mpi_memory_alloc_kinds negotiation at session/win/file creation.
+"""
+
+import numpy as np
+import pytest
+
+from tests.harness import run_ranks
+
+
+# -- Info object (single process) -----------------------------------------
+
+def test_info_object_semantics():
+    from ompi_tpu.info import Info
+
+    inf = Info()
+    inf.set("a", "1")
+    inf.set("b", "2")
+    inf["c"] = 3  # values stringify
+    assert inf.get("a") == "1" and inf["c"] == "3"
+    assert inf.get("zz") is None and inf.get("zz", "d") == "d"
+    assert inf.get_nkeys() == 3
+    assert [inf.get_nthkey(i) for i in range(3)] == ["a", "b", "c"]
+    d = inf.dup()
+    d.set("a", "9")
+    assert inf.get("a") == "1"  # dup detaches
+    inf.delete("b")
+    assert "b" not in inf and inf.get_nkeys() == 2
+    with pytest.raises(KeyError):
+        inf.delete("b")
+    with pytest.raises(ValueError):
+        inf.set("k" * 300, "v")  # MPI_MAX_INFO_KEY
+    assert Info({"x": "1"}) == Info([("x", "1")])
+
+
+def test_info_env():
+    from ompi_tpu.info import env_info
+
+    env = env_info()
+    for key in ("command", "maxprocs", "host", "arch", "wdir",
+                "thread_level"):
+        assert env.get(key) is not None, key
+
+
+def test_memkind_negotiation():
+    from ompi_tpu.info import (Info, MEMORY_ALLOC_KINDS,
+                               apply_memkinds, memkind_grant,
+                               supported_memkinds)
+
+    have = supported_memkinds()
+    assert "system" in have and "mpi" in have
+    granted = memkind_grant("system,foo:bar,mpi:alloc_mem")
+    assert granted.split(",")[0] == "system"
+    assert "foo:bar" not in granted  # unknown kinds dropped
+    assert "mpi:alloc_mem" in granted
+    inf = Info({MEMORY_ALLOC_KINDS: "system,nonsense"})
+    assert apply_memkinds(inf).get(MEMORY_ALLOC_KINDS) == "system"
+
+
+# -- errhandler callbacks --------------------------------------------------
+
+def test_errhandler_truncate_recovery():
+    """The VERDICT done-when: a callback rewrites ERR_TRUNCATE into a
+    recovery — the operation returns instead of raising."""
+    run_ranks("""
+    from ompi_tpu import errors, mpi
+    if rank == 0:
+        comm.Send(np.arange(100, dtype=np.float32), dest=1, tag=7)
+        comm.Send(np.arange(5, dtype=np.float32), dest=1, tag=8)
+    else:
+        seen = []
+        def on_error(obj, exc):
+            assert obj is comm
+            assert exc.error_class == errors.ERR_TRUNCATE
+            seen.append(exc)  # returning = handled -> recover
+        comm.Set_errhandler(mpi.Comm_create_errhandler(on_error))
+        small = np.zeros(10, np.float32)
+        out = comm.Recv(small, source=0, tag=7)  # 100 > 10: truncates
+        assert out is None and len(seen) == 1  # recovered, no raise
+        # the comm keeps working after recovery
+        ok = np.zeros(5, np.float32)
+        comm.Recv(ok, source=0, tag=8)
+        np.testing.assert_array_equal(ok, np.arange(5,
+                                                    dtype=np.float32))
+        # restoring the string mode restores raising
+        comm.Set_errhandler(errors.ERRORS_RETURN)
+        assert comm.Get_errhandler() == errors.ERRORS_RETURN
+    """, 2)
+
+
+def test_errhandler_inherited_on_dup_split():
+    run_ranks("""
+    from ompi_tpu import errors, mpi
+    calls = []
+    eh = mpi.Comm_create_errhandler(lambda o, e: calls.append(e))
+    comm.Set_errhandler(eh)
+    d = comm.dup()
+    assert d.Get_errhandler() is eh
+    s = comm.split(0, key=rank)
+    assert s.Get_errhandler() is eh
+    # a callback may re-raise to propagate
+    bad = mpi.Comm_create_errhandler(
+        lambda o, e: (_ for _ in ()).throw(e))
+    d.Set_errhandler(bad)
+    try:
+        d.Send(np.zeros(1, np.float32), dest=999)
+    except errors.MPIError:
+        pass
+    else:
+        raise AssertionError("re-raising callback must propagate")
+    # and the handling callback recovers the same bad call
+    s.Send(np.zeros(1, np.float32), dest=999)
+    assert len(calls) == 1 and calls[0].error_class == errors.ERR_RANK
+    """, 2)
+
+
+def test_win_errhandler_and_memkind_info():
+    """Window errhandler + the memkind done-when: creation with a
+    memkind hint round-trips through Get_info as the granted set."""
+    run_ranks("""
+    from ompi_tpu import errors, mpi, osc
+    from ompi_tpu.info import MEMORY_ALLOC_KINDS
+    base = np.zeros(8, np.float32)
+    win = osc.win_create(
+        comm, base, 4,
+        info={MEMORY_ALLOC_KINDS: "system,bogus:kind,mpi"})
+    granted = win.Get_info().get(MEMORY_ALLOC_KINDS)
+    ks = granted.split(",")
+    assert "system" in ks and "mpi" in ks and "bogus:kind" not in ks
+    # default errhandler raises on a bad target
+    win.Fence()
+    try:
+        win.Put(np.ones(2, np.float32), target=99)
+    except errors.RankError:
+        pass
+    else:
+        raise AssertionError("bad target must raise by default")
+    # a callback turns it into a recovered no-op
+    handled = []
+    win.Set_errhandler(
+        mpi.Win_create_errhandler(lambda o, e: handled.append(e)))
+    win.Put(np.ones(2, np.float32), target=99)
+    assert len(handled) == 1
+    assert handled[0].error_class == errors.ERR_RANK
+    win.Fence()
+    win.Free()
+    """, 2)
+
+
+def test_file_errhandler_and_info():
+    run_ranks("""
+    import os, tempfile
+    from ompi_tpu import errors, mpi
+    from ompi_tpu.info import MEMORY_ALLOC_KINDS
+    path = os.path.join(tempfile.gettempdir(),
+                        f"ompitpu_eh_{os.environ['OMPI_TPU_JOBID']}")
+    f = mpi.File_open(comm, path,
+                      mpi.MODE_CREATE | mpi.MODE_RDWR,
+                      info={MEMORY_ALLOC_KINDS: "system,junk"})
+    assert f.Get_info().get(MEMORY_ALLOC_KINDS) == "system"
+    assert f.Get_errhandler() == errors.ERRORS_RETURN  # file default
+    if rank == 0:
+        f.Write_at(0, np.arange(4, dtype=np.int32))
+    comm.Barrier()
+    # force an io error: closed fd
+    handled = []
+    f.Set_errhandler(mpi.File_create_errhandler(
+        lambda o, e: handled.append(e)))
+    fd, f.fd = f.fd, None
+    buf = np.zeros(4, np.int32)
+    n = f.Read_at(0, buf)  # recovered: zero-fill
+    assert handled and handled[0].error_class == errors.ERR_FILE
+    f.fd = fd
+    f.Read_at(0, buf)
+    if rank == 0:
+        np.testing.assert_array_equal(buf, np.arange(4, dtype=np.int32))
+    comm.Barrier()
+    f.Close()
+    if rank == 0:
+        try: os.unlink(path)
+        except OSError: pass
+    """, 2)
+
+
+def test_session_info_memkinds():
+    run_ranks("""
+    from ompi_tpu import mpi
+    from ompi_tpu.info import MEMORY_ALLOC_KINDS
+    s = mpi.Session_init(info={MEMORY_ALLOC_KINDS:
+                               "system,mpi,made:up"})
+    granted = s.get_info().get(MEMORY_ALLOC_KINDS).split(",")
+    assert "system" in granted and "mpi" in granted
+    assert "made:up" not in granted
+    s.finalize()
+    """, 2)
+
+
+def test_errhandler_nonblocking_at_wait():
+    """i-variant errors surface at wait and route through the comm's
+    errhandler there (requests carry .comm)."""
+    run_ranks("""
+    from ompi_tpu import errors, mpi
+    if rank == 0:
+        comm.Send(np.arange(40, dtype=np.float32), dest=1, tag=3)
+    else:
+        seen = []
+        comm.Set_errhandler(mpi.Comm_create_errhandler(
+            lambda o, e: seen.append(e.error_class)))
+        r = comm.Irecv(np.zeros(4, np.float32), source=0, tag=3)
+        st = r.wait(timeout=60)  # truncation recovered, not raised
+        assert seen == [errors.ERR_TRUNCATE], seen
+        assert st.error == errors.ERR_TRUNCATE  # inspectable
+    comm.Barrier()
+    """, 2)
+
+
+def test_win_rma_ops_all_route_errhandler():
+    run_ranks("""
+    from ompi_tpu import errors, mpi, osc
+    win = osc.win_create(comm, np.zeros(4, np.int64), 8)
+    handled = []
+    win.Set_errhandler(mpi.Win_create_errhandler(
+        lambda o, e: handled.append(e.error_class)))
+    win.Fence()
+    res = np.zeros(1, np.int64)
+    win.Accumulate(np.ones(1, np.int64), target=50)
+    win.Fetch_and_op(np.ones(1, np.int64), res, target=50)
+    win.Compare_and_swap(np.ones(1, np.int64), np.zeros(1, np.int64),
+                         res, target=50)
+    win.Get_accumulate(np.ones(1, np.int64), res, target=50)
+    r = win.Rget(np.zeros(1, np.int64), target=50)
+    r.wait()  # recovered no-op completes immediately
+    assert len(handled) == 5 and set(handled) == {errors.ERR_RANK}
+    win.Fence()
+    win.Free()
+    """, 2)
+
+
+def test_info_inherited_and_env_in_launched_job():
+    run_ranks("""
+    from ompi_tpu import mpi
+    from ompi_tpu.info import env_info
+    comm.Set_info({"k": "v"})
+    d = comm.dup()
+    assert d.Get_info().get("k") == "v"  # MPI-4 7.4.1: dup copies info
+    s = comm.split(0, key=rank)
+    assert s.Get_info().get("k") == "v"
+    env = env_info()
+    assert env.get("maxprocs") == str(size)
+    assert env.get("host")
+    """, 2)
