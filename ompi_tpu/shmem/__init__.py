@@ -470,6 +470,122 @@ def wait_until(sym: SymArray, cmp: str, value, index: int = 0) -> None:
     progress.wait_until(lambda: bool(fn(loc[index], value)))
 
 
+def test(sym: SymArray, cmp: str, value, index: int = 0) -> bool:
+    """shmem_test (oshmem/shmem/c/shmem_wait_ivars.c family): one
+    progress sweep, then a nonblocking check of the local location."""
+    progress.progress()
+    fn = _CMPS[cmp]
+    return bool(fn(sym.local.reshape(-1)[index], value))
+
+
+def test_all(sym: SymArray, cmp: str, value,
+             indices=None) -> bool:
+    """shmem_test_all over a vector of symmetric locations."""
+    progress.progress()
+    fn = _CMPS[cmp]
+    loc = sym.local.reshape(-1)
+    idxs = range(loc.size) if indices is None else indices
+    return all(bool(fn(loc[i], value)) for i in idxs)
+
+
+def test_any(sym: SymArray, cmp: str, value, indices=None):
+    """shmem_test_any: index of SOME satisfied location, else None."""
+    progress.progress()
+    fn = _CMPS[cmp]
+    loc = sym.local.reshape(-1)
+    idxs = range(loc.size) if indices is None else indices
+    for i in idxs:
+        if fn(loc[i], value):
+            return i
+    return None
+
+
+def test_some(sym: SymArray, cmp: str, value, indices=None) -> list:
+    """shmem_test_some: every currently-satisfied index."""
+    progress.progress()
+    fn = _CMPS[cmp]
+    loc = sym.local.reshape(-1)
+    idxs = range(loc.size) if indices is None else indices
+    return [i for i in idxs if fn(loc[i], value)]
+
+
+def wait_until_any(sym: SymArray, cmp: str, value, indices=None):
+    """shmem_wait_until_any."""
+    # materialize once: the polls re-iterate, so a one-shot iterable
+    # (generator) would be exhausted after the first sweep
+    indices = None if indices is None else list(indices)
+    out: list = []
+
+    def check() -> bool:
+        got = test_any(sym, cmp, value, indices)
+        if got is not None:
+            out.append(got)
+            return True
+        return False
+
+    progress.wait_until(check)
+    return out[0]
+
+
+def wait_until_all(sym: SymArray, cmp: str, value,
+                   indices=None) -> None:
+    """shmem_wait_until_all."""
+    indices = None if indices is None else list(indices)
+    progress.wait_until(lambda: test_all(sym, cmp, value, indices))
+
+
+# -- signaled put (spml_put_signal, spml.h:280,1037;
+#    oshmem/shmem/c/shmem_put_signal.c) ------------------------------------
+
+SIGNAL_SET = "set"
+SIGNAL_ADD = "add"
+
+
+def _post_signal(st: "_Shmem", sig_addr: SymArray, signal, sig_op: str,
+                 pe: int) -> None:
+    op = op_mod.SUM if sig_op == SIGNAL_ADD else op_mod.REPLACE
+    st.win.Accumulate(np.asarray([signal], dtype=sig_addr.dtype), pe,
+                      disp=sig_addr.byte_disp(0), op=op)
+    pvar.record("shmem_atomic")
+
+
+def put_signal(dest: SymArray, value, sig_addr: SymArray, signal,
+               sig_op: str = SIGNAL_SET, pe: int = 0,
+               index: int = 0) -> None:
+    """shmem_put_signal: data put + signal update as one ordered pair
+    — the osc AM channel to one PE preserves delivery order, so the
+    target's signal word updates only AFTER the data is visible (the
+    consumer needs no barrier: signal_wait_until then read)."""
+    st = _require()
+    _win_put(st.win, dest, value, pe, index)
+    _post_signal(st, sig_addr, signal, sig_op, pe)
+
+
+def put_signal_nbi(dest: SymArray, value, sig_addr: SymArray, signal,
+                   sig_op: str = SIGNAL_SET, pe: int = 0,
+                   index: int = 0):
+    """shmem_put_signal_nbi: nonblocking form; quiet() completes it.
+    The data/signal pair still posts in order on the AM channel."""
+    st = _require()
+    data = np.ascontiguousarray(value, dtype=dest.dtype)
+    req = st.win.Rput(data, pe, disp=dest.byte_disp(index))
+    pvar.record("shmem_put")
+    _post_signal(st, sig_addr, signal, sig_op, pe)
+    return req
+
+
+def signal_fetch(sig_addr: SymArray) -> int:
+    """shmem_signal_fetch: read the LOCAL signal word."""
+    progress.progress()
+    return sig_addr.local.reshape(-1)[0]
+
+
+def signal_wait_until(sig_addr: SymArray, cmp: str, value):
+    """shmem_signal_wait_until: returns the satisfying signal value."""
+    wait_until(sig_addr, cmp, value, index=0)
+    return sig_addr.local.reshape(-1)[0]
+
+
 # -- atomics (shmem_atomic_* over osc accumulate) --------------------------
 
 def atomic_fetch_add(dest: SymArray, value, pe: int, index: int = 0):

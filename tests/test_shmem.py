@@ -275,3 +275,76 @@ def test_teams_split_and_collectives():
     shmem.barrier_all()
     shmem.finalize()
     """, 4)
+
+
+# -- signaled put + test family (r3 VERDICT missing #4) --------------------
+# Reference: oshmem/mca/spml/spml.h:1037 spml_put_signal,
+# oshmem/shmem/c/shmem_put_signal.c, shmem_wait_ivars.c.
+
+def test_put_signal_producer_consumer_no_barrier():
+    """Data + signal in ONE op, no barrier: the consumer waits on the
+    signal word alone; ordering guarantees the data is visible."""
+    run_ranks("""
+    from ompi_tpu import shmem
+    shmem.init(1 << 16)
+    data = shmem.zeros(8, np.float64)
+    sig = shmem.zeros(1, np.int64)
+    if rank == 0:
+        payload = np.arange(8, dtype=np.float64) + 1
+        shmem.put_signal(data, payload, sig, 7,
+                         shmem.SIGNAL_SET, pe=1)
+    elif rank == 1:
+        got = shmem.signal_wait_until(sig, shmem.CMP_EQ, 7)
+        assert got == 7
+        np.testing.assert_array_equal(
+            data.local, np.arange(8, dtype=np.float64) + 1)
+    shmem.barrier_all()  # teardown alignment only
+    shmem.finalize()
+    """, 2, isolate=True)
+
+
+def test_put_signal_add_and_nbi():
+    run_ranks("""
+    from ompi_tpu import shmem
+    shmem.init(1 << 16)
+    data = shmem.zeros(4, np.int32)
+    sig = shmem.zeros(1, np.int64)
+    if rank == 0:
+        r1 = shmem.put_signal_nbi(data, np.full(4, 5, np.int32), sig,
+                                  1, shmem.SIGNAL_ADD, pe=1)
+        r2 = shmem.put_signal_nbi(data, np.full(4, 9, np.int32), sig,
+                                  1, shmem.SIGNAL_ADD, pe=1)
+        shmem.quiet()
+    elif rank == 1:
+        shmem.signal_wait_until(sig, shmem.CMP_EQ, 2)  # both landed
+        assert (data.local == 9).all()  # second put ordered after first
+        assert shmem.signal_fetch(sig) == 2
+    shmem.barrier_all()
+    shmem.finalize()
+    """, 2, isolate=True)
+
+
+def test_shmem_test_family():
+    run_ranks("""
+    from ompi_tpu import shmem
+    shmem.init(1 << 16)
+    flags = shmem.zeros(4, np.int64)
+    if rank == 0:
+        assert shmem.test(flags, shmem.CMP_EQ, 1) is False
+        # set peer flags one by one; wait_until_any/all observe them
+        shmem.p(flags, 1, pe=1, index=2)
+        shmem.p(flags, 1, pe=1, index=0)
+        shmem.barrier_all()
+    else:
+        i = shmem.wait_until_any(flags, shmem.CMP_EQ, 1)
+        assert i in (0, 2)
+        shmem.wait_until_all(flags, shmem.CMP_EQ, 1, indices=[0, 2])
+        some = shmem.test_some(flags, shmem.CMP_EQ, 1)
+        assert sorted(some) == [0, 2], some
+        assert shmem.test_all(flags, shmem.CMP_EQ, 1,
+                              indices=[0, 2])
+        assert not shmem.test_all(flags, shmem.CMP_EQ, 1)
+        assert shmem.test_any(flags, shmem.CMP_EQ, 0) in (1, 3)
+        shmem.barrier_all()
+    shmem.finalize()
+    """, 2, isolate=True)
