@@ -60,6 +60,30 @@ _scatter_cache_var = cvar.register(
          "for shape-varying scatters without like= templates.",
     level=6)
 
+_rooted_var = cvar.register(
+    "coll_xla_rooted_threshold_bytes", 1 << 20, int,
+    help="Rooted (reduce/gather) device collectives switch to a "
+         "root-collecting schedule when the would-be-replicated "
+         "result reaches this size: below it, every rank computes "
+         "the full allreduce/allgather (one compiled program, free "
+         "for small buffers); at/above it, reduce runs "
+         "reduce_scatter + chunk-to-root rounds and gather runs "
+         "per-source ppermute-to-root rounds, so non-roots "
+         "materialize O(bytes), not O(n*bytes) "
+         "(coll_base_reduce.c binomial-semantics analog). 0 forces "
+         "rooted always; -1 disables it.", level=5)
+
+_a2av_pad_var = cvar.register(
+    "coll_xla_alltoallv_pad_factor", 4, int,
+    help="alltoallv pads every cell to the GLOBAL max count; skewed "
+         "counts (one hot expert) inflate that to n*max cells. When "
+         "the padded volume exceeds this factor x the true payload, "
+         "the call falls through to the staging path instead of "
+         "allocating the blowup (only on the max_count=None path — "
+         "an explicit max_count is the capacity-bounded MoE fast "
+         "path and is never second-guessed). 0 disables the bound.",
+    level=6)
+
 _hier_var = cvar.register(
     "coll_xla_hier", "auto", str,
     help="hierarchical ICI x DCN execution for comms spanning slices "
@@ -248,15 +272,94 @@ def allreduce_dev(comm, sendbuf, op=op_mod.SUM,
     return ctx.my_shard(fn(to_g(sendbuf)))
 
 
+#: test/diagnostic hook: the last rooted schedule's per-round,
+#: per-rank output element count (proves non-roots moved O(bytes))
+_last_rooted_plan: Optional[dict] = None
+
+
+def _rooted(nbytes_result: int) -> bool:
+    thr = _rooted_var.get()
+    return thr >= 0 and nbytes_result >= thr
+
+
+def _gather_rooted(ctx, comm, x, root: int):
+    """Collect every rank's ``x`` on the root: one single-pair
+    ppermute program per source (src -> root), each moving and
+    allocating only ONE x-sized block per rank — non-roots never
+    materialize the n-fold result (coll_base_gather.c linear
+    semantics, on device). Root stacks the blocks locally (its own
+    device, outside the collective programs). Returns (n, *x.shape)
+    on root, None elsewhere."""
+    global _last_rooted_plan
+    import jax.numpy as jnp
+    from jax import lax
+
+    n, me = ctx.n, comm.rank
+    _last_rooted_plan = {"kind": "gather_rooted", "rounds": n - 1,
+                        "round_out_elems": int(x.size)}
+    parts = [None] * n
+    if me == root:
+        parts[root] = x
+    for src in range(n):
+        if src == root:
+            continue
+
+        def build(src=src):
+            return ctx.smap(
+                lambda a: lax.ppermute(a[0], AXIS,
+                                       perm=[(src, root)]),
+                out_varying=True)
+
+        fn = ctx.compiled(_key(x, "gather_rooted", src, root), build)
+        got = ctx.my_shard(fn(ctx.to_global(x)))
+        if me == root:
+            parts[src] = got
+    if me != root:
+        return None
+    return jnp.stack(parts)
+
+
 def reduce_dev(comm, sendbuf, op=op_mod.SUM, root: int = 0,
                deterministic: Optional[str] = None):
     if not _op_ok(op):
         return staging.reduce_dev(comm, sendbuf, op, root)
-    # SPMD: every device computes the full reduction (free on-device;
-    # avoids a divergent program) — shares allreduce's compiled program
-    # and cache entry; only the root returns the result
-    out = allreduce_dev(comm, sendbuf, op, deterministic)
-    return out if comm.rank == root else None
+    det = _det(deterministic)
+    n = comm.size
+    nbytes = int(sendbuf.size) * np.dtype(sendbuf.dtype).itemsize
+    # small buffers / deterministic modes: every device computes the
+    # full reduction (one compiled program; the rank-order fold
+    # contract requires the flat schedule anyway)
+    if n == 1 or det is not None or not _rooted(nbytes * n):
+        out = allreduce_dev(comm, sendbuf, op, deterministic)
+        return out if comm.rank == root else None
+    # rooted schedule: reduce_scatter leaves each rank ONE 1/n chunk
+    # (O(bytes/n) output), then the chunks ride single-pair ppermutes
+    # to the root — non-roots do O(bytes) HBM/ICI total, never the
+    # n-fold allreduce result (coll_base_reduce.c binomial role)
+    pvar.record("coll_xla_device")
+    import jax.numpy as jnp
+
+    from ompi_tpu.parallel import collectives as C
+
+    ctx = _ctx(comm)
+    opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
+    flat = sendbuf.reshape(-1)
+    pad = (-flat.size) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+
+    def build():
+        return ctx.smap(
+            lambda a: C.reduce_scatter(a[0], AXIS, opn,
+                                       scatter_dim=0, tiled=True),
+            out_varying=True)
+
+    fn = ctx.compiled(_key(flat, "reduce_rooted_rs", opn.name), build)
+    chunk = ctx.my_shard(fn(ctx.to_global(flat)))
+    stacked = _gather_rooted(ctx, comm, chunk, root)
+    if comm.rank != root:
+        return None
+    return stacked.reshape(-1)[:sendbuf.size].reshape(sendbuf.shape)
 
 
 def bcast_dev(comm, buf, root: int = 0):
@@ -306,8 +409,15 @@ def allgather_dev(comm, sendbuf):
 
 
 def gather_dev(comm, sendbuf, root: int = 0):
-    out = allgather_dev(comm, sendbuf)
-    return out if comm.rank == root else None
+    n = comm.size
+    nbytes = int(sendbuf.size) * np.dtype(sendbuf.dtype).itemsize
+    if n == 1 or not _rooted(nbytes * n):
+        out = allgather_dev(comm, sendbuf)
+        return out if comm.rank == root else None
+    # rooted: per-source ppermute-to-root rounds; non-roots allocate
+    # one sendbuf-sized block per round, never the (n, ...) result
+    pvar.record("coll_xla_device")
+    return _gather_rooted(_ctx(comm), comm, sendbuf, root)
 
 
 def alltoall_dev(comm, sendbuf):
@@ -587,11 +697,22 @@ def alltoallv_dev(comm, sendbuf, scounts, rcounts, max_count=None):
 
     ctx = _ctx(comm)
     if max_count is None:
-        local = np.array([max(max(scounts), max(rcounts))],
-                         dtype=np.int64)
-        glob = np.zeros(1, dtype=np.int64)
-        comm.coll.allreduce(comm, local, glob, 1, None, op_mod.MAX)
-        m = int(glob[0])
+        # the one host metadata round carries (max cell, payload) —
+        # the global max sizes the padding, the global total bounds
+        # the blowup UNIFORMLY across ranks (a per-rank decision
+        # would diverge into different collectives)
+        pairs = comm.coll.allgather_obj(
+            comm, (max(max(scounts), max(rcounts)), sum(scounts)))
+        m = max(p[0] for p in pairs)
+        factor = _a2av_pad_var.get()
+        padded_cells = comm.size * comm.size * m
+        true_cells = max(sum(p[1] for p in pairs), 1)
+        if factor > 0 and padded_cells > factor * true_cells:
+            # pathological skew (one hot expert): the staged path
+            # moves the ragged counts without padding
+            pvar.record("coll_xla_alltoallv_fallback")
+            return staging.alltoallv_dev(comm, sendbuf, scounts,
+                                         rcounts)
     else:
         m = int(max_count)
         if max(max(scounts), max(rcounts)) > m:
