@@ -320,9 +320,7 @@ class File:
 
         conv, nbytes = _conv(buf, count, datatype)
         extents = self.view.map(self._off_bytes(offset), nbytes)
-        data = fcoll.two_phase_read(self, extents)
-        conv.unpack(data)
-        return len(data)
+        return fcoll.two_phase_read(self, extents, conv)
 
     def Write_all(self, buf, count: int = None,
                   datatype: dt_mod.Datatype = None) -> int:
@@ -335,6 +333,129 @@ class File:
         n = self.Read_at_all(self.Get_position(), buf, count, datatype)
         self._pos += n
         return n
+
+    # -- nonblocking + split collective I/O (r3 VERDICT missing #6) -------
+    # Reference: ompi/mpi/c/file_read_all_begin.c (+_end, write
+    # variants, iread_all/iwrite_all) over ompio's nonblocking
+    # collective path. The two-phase exchange runs as a libnbc-style
+    # schedule on the progress engine (io/fcoll.sched_*): compute
+    # between begin/end — or before wait — overlaps the collective.
+
+    def _coll_tags(self):
+        # three collective-context tags per op (extents round,
+        # shuffle/reply round, completion barrier), allocated in call
+        # order — identical across ranks because collective calls are
+        # ordered (MPI semantics)
+        t = self.comm.coll.next_tag
+        return (t(), t(), t())
+
+    def Iwrite_at_all(self, offset: int, buf, count: int = None,
+                      datatype: dt_mod.Datatype = None):
+        """MPI_File_iwrite_at_all: request completes when every
+        rank's file domain is on disk."""
+        from ompi_tpu.coll import libnbc
+        from ompi_tpu.io import fcoll
+
+        data, nbytes = _pack(buf, count, datatype)
+        extents = self.view.map(self._off_bytes(offset), nbytes)
+        out: dict = {}
+        req = libnbc.NbcRequest(fcoll.sched_write(
+            self, extents, data, self._coll_tags(), out))
+        req.result = out
+        return req
+
+    def Iread_at_all(self, offset: int, buf, count: int = None,
+                     datatype: dt_mod.Datatype = None):
+        """MPI_File_iread_at_all: ``buf`` fills at completion."""
+        from ompi_tpu.coll import libnbc
+        from ompi_tpu.io import fcoll
+
+        conv, nbytes = _conv(buf, count, datatype)
+        extents = self.view.map(self._off_bytes(offset), nbytes)
+        out: dict = {}
+        req = libnbc.NbcRequest(fcoll.sched_read(
+            self, extents, conv, self._coll_tags(), out))
+        req.result = out
+        return req
+
+    def Iwrite_all(self, buf, count: int = None,
+                   datatype: dt_mod.Datatype = None):
+        """MPI_File_iwrite_all (individual pointer advances NOW — the
+        range is claimed at call time, per the split/nonblocking
+        pointer rules)."""
+        # _conv sizes the transfer without materializing the packed
+        # bytes (Iwrite_at_all packs once, below)
+        _, nbytes = _conv(buf, count, datatype)
+        req = self.Iwrite_at_all(self.Get_position(), buf, count,
+                                 datatype)
+        self._pos += nbytes
+        return req
+
+    def Iread_all(self, buf, count: int = None,
+                  datatype: dt_mod.Datatype = None):
+        """MPI_File_iread_all."""
+        _, nbytes = _conv(buf, count, datatype)
+        req = self.Iread_at_all(self.Get_position(), buf, count,
+                                datatype)
+        self._pos += nbytes
+        return req
+
+    # split collectives: begin starts the schedule, end completes it;
+    # at most ONE split collective may be active per file handle
+    # (MPI-3.1 §13.4.5), enforced.
+    def _split_check(self) -> None:
+        """MUST run before the schedule starts: a second begin that
+        had already posted its rounds would corrupt both the file and
+        the tag sequence before the error surfaced."""
+        if getattr(self, "_split_req", None) is not None:
+            raise errors.MPIError(
+                errors.ERR_OTHER,
+                "a split collective is already active on this file "
+                "handle (MPI allows one at a time)")
+
+    def _split_end(self) -> int:
+        req = getattr(self, "_split_req", None)
+        if req is None:
+            raise errors.MPIError(
+                errors.ERR_OTHER,
+                "no split collective active (call *_begin first)")
+        self._split_req = None
+        req.wait()
+        return req.result.get("n", 0)
+
+    def Write_at_all_begin(self, offset: int, buf, count: int = None,
+                           datatype: dt_mod.Datatype = None) -> None:
+        self._split_check()
+        self._split_req = self.Iwrite_at_all(offset, buf, count,
+                                             datatype)
+
+    def Write_at_all_end(self) -> int:
+        return self._split_end()
+
+    def Read_at_all_begin(self, offset: int, buf, count: int = None,
+                          datatype: dt_mod.Datatype = None) -> None:
+        self._split_check()
+        self._split_req = self.Iread_at_all(offset, buf, count,
+                                            datatype)
+
+    def Read_at_all_end(self) -> int:
+        return self._split_end()
+
+    def Write_all_begin(self, buf, count: int = None,
+                        datatype: dt_mod.Datatype = None) -> None:
+        self._split_check()
+        self._split_req = self.Iwrite_all(buf, count, datatype)
+
+    def Write_all_end(self) -> int:
+        return self._split_end()
+
+    def Read_all_begin(self, buf, count: int = None,
+                       datatype: dt_mod.Datatype = None) -> None:
+        self._split_check()
+        self._split_req = self.Iread_all(buf, count, datatype)
+
+    def Read_all_end(self) -> int:
+        return self._split_end()
 
 
 # -- module-level API ------------------------------------------------------

@@ -19,9 +19,6 @@ from typing import List, Tuple
 
 Extent = Tuple[int, int]  # (absolute file offset, byte length)
 
-_TAG_SHUFFLE = 77001
-_TAG_REPLY = 77002
-
 
 def _domains(all_extents: List[List[Extent]],
              nprocs: int) -> List[Tuple[int, int]]:
@@ -62,34 +59,70 @@ def _intersect_spans(extents: List[Extent],
     return out
 
 
-def two_phase_write(f, extents: List[Extent], data: bytes) -> int:
-    """Collective write: shuffle pieces to file-domain owners, each
-    owner merges and issues coalesced pwrites."""
+# -- nonblocking two-phase schedules (r3 VERDICT missing #6) ---------------
+# Reference: ompi/mpi/c/file_read_all_begin.c (+ _end / write / iread_all
+# variants) over ompio's nonblocking collective path. Here the SAME
+# two-phase exchange compiles to a libnbc-style generator of request
+# rounds, progressed by the engine — compute between begin/end (or
+# before wait) overlaps the extent exchange, the shuffle and the
+# completion barrier.
+
+def _sched_barrier_obj(comm, p, tag):
+    """Dissemination barrier over the object channel (libnbc
+    ibarrier's rounds, on collective-context tags)."""
+    rank, size = comm.rank, comm.size
+    dist = 1
+    while dist < size:
+        to = (rank + dist) % size
+        frm = (rank - dist + size) % size
+        yield [p.irecv_obj(comm, frm, tag, collective=True),
+               p.isend_obj(comm, None, to, tag, collective=True)]
+        dist <<= 1
+
+
+def sched_write(f, extents: List[Extent], data: bytes, tags,
+                out: dict):
+    """Generator form of :func:`two_phase_write`; ``out['n']`` holds
+    the byte count at completion."""
     comm = f.comm
-    nprocs = comm.size
-    if nprocs == 1:
-        return f._pwritev(extents, data)
-    all_extents = comm.allgather(extents)
-    doms = _domains(all_extents, nprocs)
-    # phase 1: shuffle — send my pieces to each domain owner
-    reqs = []
+    n, me = comm.size, comm.rank
+    if n == 1:
+        f._pwritev(extents, data)
+        out["n"] = len(data)
+        return
+    from ompi_tpu import pml
+
+    p = pml.current()
+    t_ext, t_shuf, t_bar = tags
+    # round 0: exchange access patterns (the allgather, linearized
+    # onto the object channel so it never blocks the caller)
+    sr = [p.isend_obj(comm, extents, d, t_ext, collective=True)
+          for d in range(n) if d != me]
+    rr = {s: p.irecv_obj(comm, s, t_ext, collective=True)
+          for s in range(n) if s != me}
+    yield sr + list(rr.values())
+    all_extents = [extents if r == me else rr[r]._obj
+                   for r in range(n)]
+    doms = _domains(all_extents, n)
+    # round 1: shuffle pieces to their file-domain owners
+    sreqs = []
     mine: List[Tuple[int, bytes]] = []
-    for owner in range(nprocs):
+    for owner in range(n):
         pieces = _intersect(extents, data, doms[owner])
-        if owner == comm.rank:
+        if owner == me:
             mine = pieces
-        elif pieces:  # receiver expects a message iff overlap exists
-            reqs.append(comm.isend(pieces, dest=owner,
-                                   tag=_TAG_SHUFFLE))
+        elif pieces:
+            sreqs.append(p.isend_obj(comm, pieces, owner, t_shuf,
+                                     collective=True))
+    rreqs = {src: p.irecv_obj(comm, src, t_shuf, collective=True)
+             for src in range(n)
+             if src != me and _intersect_spans(all_extents[src],
+                                               doms[me])}
+    yield sreqs + list(rreqs.values())
     gathered = list(mine)
-    for src in range(nprocs):
-        if src != comm.rank and _intersect_spans(
-                all_extents[src], doms[comm.rank]):
-            gathered.extend(comm.recv(source=src, tag=_TAG_SHUFFLE))
-    for r in reqs:
-        r.wait()
-    # phase 2: merge + coalesced write of my file domain
-    gathered.sort(key=lambda p: p[0])
+    for src in sorted(rreqs):
+        gathered.extend(rreqs[src]._obj)
+    gathered.sort(key=lambda piece: piece[0])
     merged: List[Tuple[int, bytes]] = []
     for off, chunk in gathered:
         if merged and merged[-1][0] + len(merged[-1][1]) == off:
@@ -98,59 +131,94 @@ def two_phase_write(f, extents: List[Extent], data: bytes) -> int:
             merged.append((off, chunk))
     for off, chunk in merged:
         f._pwritev([(off, len(chunk))], chunk)
-    comm.Barrier()  # collective completion: data visible to all
-    return len(data)
+    out["n"] = len(data)
+    # completion: every rank's domain is on disk before anyone returns
+    yield from _sched_barrier_obj(comm, p, t_bar)
 
 
-def two_phase_read(f, extents: List[Extent]) -> bytes:
-    """Collective read: domain owners read coalesced ranges, then ship
-    each rank the pieces it asked for."""
+def sched_read(f, extents: List[Extent], conv, tags, out: dict):
+    """Generator form of :func:`two_phase_read`: unpacks into the
+    caller's buffer (via ``conv``) at completion; ``out['n']`` holds
+    the byte count."""
     comm = f.comm
-    nprocs = comm.size
-    if nprocs == 1:
-        return f._preadv(extents)
-    all_extents = comm.allgather(extents)
-    doms = _domains(all_extents, nprocs)
-    my_dom = doms[comm.rank]
-    # phase 1: aggregate read of my domain (one coalesced range per
-    # requesting rank's overlap, merged)
-    wanted: List[List[Extent]] = [
-        _intersect_spans(all_extents[r], my_dom) for r in range(nprocs)]
-    reqs = []
+    n, me = comm.size, comm.rank
+    if n == 1:
+        data = f._preadv(extents)
+        conv.unpack(data)
+        out["n"] = len(data)
+        return
+    from ompi_tpu import pml
+
+    p = pml.current()
+    t_ext, t_reply, _ = tags
+    sr = [p.isend_obj(comm, extents, d, t_ext, collective=True)
+          for d in range(n) if d != me]
+    rr = {s: p.irecv_obj(comm, s, t_ext, collective=True)
+          for s in range(n) if s != me}
+    yield sr + list(rr.values())
+    all_extents = [extents if r == me else rr[r]._obj
+                   for r in range(n)]
+    doms = _domains(all_extents, n)
+    my_dom = doms[me]
+    wanted = [_intersect_spans(all_extents[r], my_dom)
+              for r in range(n)]
+    sreqs = []
     mine: List[Tuple[int, bytes]] = []
-    for r in range(nprocs):
+    for r in range(n):
         if not wanted[r]:
             continue
-        pieces = [(off, f._preadv([(off, ln)])) for off, ln in wanted[r]]
-        if r == comm.rank:
+        pieces = [(off, f._preadv([(off, ln)]))
+                  for off, ln in wanted[r]]
+        if r == me:
             mine = pieces
         else:
-            reqs.append(comm.isend(pieces, dest=r, tag=_TAG_REPLY))
-    # phase 2: collect my pieces from every domain owner
-    pieces_all: List[Tuple[int, bytes]] = []
-    for owner in range(nprocs):
-        if not _intersect_spans(extents, doms[owner]):
-            continue
-        if owner == comm.rank:
-            pieces_all.extend(mine)
-        else:
-            pieces_all.extend(comm.recv(source=owner, tag=_TAG_REPLY))
-    for r in reqs:
-        r.wait()
-    # reassemble into the caller's visible-byte order
+            sreqs.append(p.isend_obj(comm, pieces, r, t_reply,
+                                     collective=True))
+    rreqs = {owner: p.irecv_obj(comm, owner, t_reply,
+                                collective=True)
+             for owner in range(n)
+             if owner != me and _intersect_spans(extents, doms[owner])}
+    yield sreqs + list(rreqs.values())
+    pieces_all: List[Tuple[int, bytes]] = list(mine) if \
+        _intersect_spans(extents, my_dom) else []
+    for owner in sorted(rreqs):
+        pieces_all.extend(rreqs[owner]._obj)
     by_off = {}
     for off, chunk in pieces_all:
         by_off[off] = chunk
-    out = bytearray()
+    buf = bytearray()
     for off, ln in extents:
-        pos = off
-        end = off + ln
+        pos, end = off, off + ln
         while pos < end:
             chunk = by_off.get(pos)
             assert chunk is not None, f"missing piece at {pos}"
             take = min(len(chunk), end - pos)
-            out.extend(chunk[:take])
+            buf.extend(chunk[:take])
             if take < len(chunk):
                 by_off[pos + take] = chunk[take:]
             pos += take
-    return bytes(out)
+    conv.unpack(bytes(buf))
+    out["n"] = len(buf)
+
+
+def two_phase_write(f, extents: List[Extent], data: bytes) -> int:
+    """Blocking collective write — drives :func:`sched_write` to
+    completion (ONE two-phase implementation serves the blocking,
+    nonblocking and split forms)."""
+    from ompi_tpu.coll import libnbc
+
+    out: dict = {}
+    libnbc.NbcRequest(
+        sched_write(f, extents, data, f._coll_tags(), out)).wait()
+    return out.get("n", 0)
+
+
+def two_phase_read(f, extents: List[Extent], conv) -> int:
+    """Blocking collective read — drives :func:`sched_read`; unpacks
+    into the caller's buffer via ``conv``."""
+    from ompi_tpu.coll import libnbc
+
+    out: dict = {}
+    libnbc.NbcRequest(
+        sched_read(f, extents, conv, f._coll_tags(), out)).wait()
+    return out.get("n", 0)
