@@ -74,9 +74,36 @@ def comm_spawn(command: str, args: Sequence[str] = (),
             if k.startswith("mca_"):
                 mca.setdefault(k[4:], v)
 
+    return comm_spawn_multiple([(command, args, maxprocs)], comm,
+                               root, mca)
+
+
+def comm_spawn_multiple(specs: Sequence, comm=None, root: int = 0,
+                        mca: Optional[Dict[str, str]] = None,
+                        info=None):
+    """MPI_Comm_spawn_multiple (reference:
+    ompi/mpi/c/comm_spawn_multiple.c over ompi/dpm/dpm.c:386): start
+    SEVERAL app contexts — ``specs`` is a list of
+    ``(command, args, maxprocs)`` — whose processes merge into ONE
+    child COMM_WORLD (one contiguous world-rank block: app k's
+    processes follow app k-1's, per the standard's rank ordering).
+    Returns the parent<->children intercommunicator; children learn
+    their app context via :func:`appnum` (MPI_APPNUM)."""
+    from ompi_tpu.comm.intercomm import comm_accept, open_port
+    from ompi_tpu.runtime import state
+
+    if info is not None:
+        from ompi_tpu.info import as_info
+
+        mca = dict(mca or {})
+        for k, v in as_info(info).items():
+            if k.startswith("mca_"):
+                mca.setdefault(k[4:], v)
     if comm is None:
         comm = state.world()
-    if maxprocs == 0:
+    specs = [(c, list(a), int(n)) for c, a, n in specs]
+    total = sum(n for _, _, n in specs)
+    if total == 0:
         # MPI-4.1 §11.8.2: legal, returns an intercomm with an empty
         # remote group (no rendezvous — nobody will ever connect)
         from ompi_tpu.comm import Group, alloc_cid
@@ -89,27 +116,39 @@ def comm_spawn(command: str, args: Sequence[str] = (),
     global _atexit_installed
     if comm.rank == root:
         client = rte.client()
-        end = client.inc(f"ww:{rte.jobid}", maxprocs)
-        offset = end - maxprocs
+        end = client.inc(f"ww:{rte.jobid}", total)
+        offset = end - total
         port = open_port(f"spawn:{rte.jobid}:{offset}")
-        argv_tail = [command, *map(str, args)]
-        if command.endswith(".py"):
-            argv_tail = [sys.executable] + argv_tail
-        for i in range(maxprocs):
-            env = _child_env(offset + i, i, maxprocs, offset, port, mca)
-            _children.append(subprocess.Popen(argv_tail, env=env))
+        idx = 0
+        for appnum, (command, args, maxprocs) in enumerate(specs):
+            argv_tail = [command, *map(str, args)]
+            if command.endswith(".py"):
+                argv_tail = [sys.executable] + argv_tail
+            for _ in range(maxprocs):
+                env = _child_env(offset + idx, idx, total, offset,
+                                 port, mca)
+                env["OMPI_TPU_APPNUM"] = str(appnum)
+                _children.append(subprocess.Popen(argv_tail, env=env))
+                idx += 1
         if not _atexit_installed:
             atexit.register(_reap_children)
             _atexit_installed = True
-        pvar.record("spawned_procs", maxprocs)
-        _out.verbose(2, "spawned %d procs at world offset %d",
-                     maxprocs, offset)
+        pvar.record("spawned_procs", total)
+        _out.verbose(2, "spawned %d procs (%d apps) at world offset "
+                     "%d", total, len(specs), offset)
         data = port
     else:
         data = None
     port = comm.bcast(data, root=root)
     # children connect from their COMM_WORLD; we accept as a group
     return comm_accept(port, comm, root=root)
+
+
+def appnum() -> Optional[int]:
+    """MPI_APPNUM: this process's app-context index (spawn_multiple /
+    tpurun MPMD), or None when not part of a multi-app job."""
+    v = os.environ.get("OMPI_TPU_APPNUM")
+    return None if v is None else int(v)
 
 
 _parent = None

@@ -1175,7 +1175,9 @@ from ompi_tpu.io import (  # noqa: E402,F401
 
 # dynamic processes (ompi/dpm: PMIx_Spawn equivalent)
 from ompi_tpu.dpm import (  # noqa: E402,F401
-    comm_spawn as Comm_spawn, get_parent as Comm_get_parent,
+    appnum as Appnum, comm_spawn as Comm_spawn,
+    comm_spawn_multiple as Comm_spawn_multiple,
+    get_parent as Comm_get_parent,
 )
 
 # MPI_Pack family incl. the canonical external32 representation

@@ -178,19 +178,85 @@ def launch(argv: Sequence[str], nprocs: int,
     Ranks that *exit* nonzero still fail the job (that's a bug, not an
     injected fault).
     """
+    return launch_mpmd([(list(argv), nprocs)], mca, timeout,
+                       bind_to=bind_to)
+
+
+def parse_app_contexts(tokens: Sequence[str],
+                       first_n: Optional[int] = None):
+    """mpirun MPMD colon syntax: ``cmd1 args : -n 2 cmd2 args`` ->
+    [(argv, nprocs), ...] (reference: PRRTE app contexts behind
+    mpirun, ompi/dpm/dpm.c:386 consumes the same structure).
+
+    ``first_n``: a ``-n K`` typed BEFORE the first command is eaten
+    by the launcher's own argparse option — main() forwards it here
+    so ``tpurun -n 3 a.py : -n 2 b.py`` runs 3 copies of a.py."""
+    apps = []
+    seg: List[str] = []
+    first = True
+    for t in list(tokens) + [":"]:
+        if t == ":":
+            if seg:
+                n = (first_n if first and first_n is not None else 1)
+                if seg[0] in ("-n", "-np") and len(seg) >= 2:
+                    n = int(seg[1])
+                    seg = seg[2:]
+                if not seg:
+                    raise ValueError("empty MPMD app context")
+                apps.append((seg, n))
+                seg = []
+                first = False
+        else:
+            seg.append(t)
+    return apps
+
+
+def parse_appfile(path: str):
+    """mpirun --app file: one ``[-n K] prog args`` context per line
+    (# comments)."""
+    apps = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            apps.extend(parse_app_contexts(line.split()))
+    return apps
+
+
+def launch_mpmd(apps, mca: Optional[Dict[str, str]] = None,
+                timeout: Optional[float] = None,
+                bind_to: str = "none") -> int:
+    """MPMD launch: several app contexts share ONE world — app k's
+    ranks follow app k-1's (the MPI_APPNUM ordering). Single-host
+    (multi-host MPMD would need per-host app slicing; use
+    spawn_multiple from a running job for that). SPMD ``launch`` is
+    the one-context special case, so the store/FT/teardown scaffold
+    exists exactly once."""
+    apps = [(list(argv), int(n)) for argv, n in apps]
+    total = sum(n for _, n in apps)
     store = kvstore.Store().start()
     jobid = uuid.uuid4().hex[:12]
-    mca = _adaptive_mca(mca, nprocs)
-    # pre-claim world ranks [0, nprocs): MPI_Comm_spawn allocates
+    mca = _adaptive_mca(mca, total)
+    # pre-claim world ranks [0, total): MPI_Comm_spawn allocates
     # fresh blocks above this watermark (ompi_tpu.dpm)
-    store.seed_counter(f"ww:{jobid}", nprocs)
+    store.seed_counter(f"ww:{jobid}", total)
     ft = (mca or {}).get("ft", "0") not in ("0", "false", "")
     procs: List[subprocess.Popen] = []
     try:
-        for r in range(nprocs):
-            env = build_env(r, nprocs, store.addr, jobid, mca,
-                            bind_core=_bind_core_for(r, bind_to))
-            procs.append(subprocess.Popen(list(argv), env=env))
+        r = 0
+        for appnum, (argv, n) in enumerate(apps):
+            if argv[0].endswith(".py"):
+                argv = [sys.executable] + argv
+            for _ in range(n):
+                env = build_env(r, total, store.addr, jobid, mca,
+                                bind_core=_bind_core_for(r, bind_to))
+                if len(apps) > 1:  # MPI_APPNUM only exists for MPMD
+                    env["OMPI_TPU_APPNUM"] = str(appnum)
+                else:
+                    env.pop("OMPI_TPU_APPNUM", None)
+                procs.append(subprocess.Popen(argv, env=env))
+                r += 1
         return _wait_all(procs, timeout, store=store if ft else None)
     finally:
         reap(procs)
@@ -434,6 +500,11 @@ def main(args: Optional[Sequence[str]] = None) -> int:
                     help="host list 'name[:slots[:addr]],...'")
     ap.add_argument("--hostfile", default=None,
                     help="hostfile: 'name [slots=K] [addr=IP]' lines")
+    ap.add_argument("--app", default=None,
+                    help="MPMD appfile: one '[-n K] prog args' "
+                         "context per line; contexts share one world "
+                         "(also: 'cmd1 : -n 2 cmd2' on the command "
+                         "line)")
     ap.add_argument("--launch-agent", default="ssh",
                     choices=["ssh", "local"],
                     help="how daemons are started on hosts ('local' "
@@ -464,6 +535,18 @@ def main(args: Optional[Sequence[str]] = None) -> int:
         return run_daemon(ns)
 
     mca = {k: v for k, v in ns.mca}
+    cmd_tokens = list(ns.command)
+    if cmd_tokens and cmd_tokens[0] == "--":
+        cmd_tokens = cmd_tokens[1:]
+    if ns.app or ":" in cmd_tokens:
+        if ns.host or ns.hostfile:
+            ap.error("MPMD app contexts are single-host (use "
+                     "spawn_multiple from a running job for "
+                     "multi-host MPMD)")
+        apps = (parse_appfile(ns.app) if ns.app
+                else parse_app_contexts(cmd_tokens,
+                                        first_n=ns.nprocs))
+        return launch_mpmd(apps, mca, ns.timeout, bind_to=ns.bind_to)
     if ns.func:
         if ":" not in ns.func:
             ap.error(f"--func wants 'pkg.mod:fn', got {ns.func!r}")

@@ -82,3 +82,99 @@ def test_spawn_merge_forms_single_world():
             dpm.wait_children(timeout=120)
         comm.Barrier()
     """, 2)
+
+
+# -- MPI_Comm_spawn_multiple + MPMD (r3 VERDICT missing #7) ----------------
+# Reference: ompi/mpi/c/comm_spawn_multiple.c, ompi/dpm/dpm.c:386 (app
+# contexts), mpirun's 'cmd1 : cmd2' / --app syntax.
+
+_CHILD_MULTI = textwrap.dedent("""
+    import os
+    import sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+    from ompi_tpu import dpm, mpi
+
+    comm = mpi.Init()
+    parent = mpi.Comm_get_parent()
+    assert parent is not None
+    # one merged child world across BOTH app contexts
+    tot = np.zeros(1, dtype=np.int64)
+    comm.Allreduce(np.array([1], dtype=np.int64), tot)
+    assert tot[0] == 3, (tot, comm.size)  # 1 + 2 procs
+    # app contexts ordered per the standard: app 0 first
+    apps = comm.allgather((comm.rank, dpm.appnum(), sys.argv[1]))
+    assert sorted(apps) == [(0, 0, "appA"), (1, 1, "appB"),
+                            (2, 1, "appB")], apps
+    # bridge collective with the parents
+    out = np.zeros(1, dtype=np.int64)
+    parent.Allreduce(np.array([comm.rank + 1], dtype=np.int64), out)
+    assert out[0] == sum(r + 100 for r in range(parent.remote_size))
+    mpi.Finalize()
+""")
+
+
+def test_spawn_multiple_merged_child_world():
+    fd, child_path = tempfile.mkstemp(suffix="_spawnm_child.py")
+    with os.fdopen(fd, "w") as fh:
+        fh.write(_CHILD_MULTI)
+    try:
+        run_ranks("""
+            from ompi_tpu import dpm
+            inter = mpi.Comm_spawn_multiple(
+                [({child!r}, ("appA",), 1),
+                 ({child!r}, ("appB",), 2)])
+            assert inter.remote_size == 3
+            out = np.zeros(1, dtype=np.int64)
+            inter.Allreduce(np.array([rank + 100], dtype=np.int64), out)
+            assert out[0] == 1 + 2 + 3, out
+            if rank == 0:
+                codes = dpm.wait_children(timeout=120)
+                assert codes == [0, 0, 0], codes
+            comm.Barrier()
+        """.format(child=child_path), 2, timeout=180)
+    finally:
+        os.unlink(child_path)
+
+
+def test_tpurun_mpmd_colon_and_appfile():
+    """A two-binary MPMD job wires one world across app contexts,
+    via both the colon syntax and --app file."""
+    import subprocess
+    import sys as _sys
+
+    prog = textwrap.dedent("""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import numpy as np
+        from ompi_tpu import dpm, mpi
+        comm = mpi.Init()
+        role = sys.argv[1]
+        tot = np.zeros(1, np.int64)
+        comm.Allreduce(np.array([1], np.int64), tot)
+        assert tot[0] == comm.size == 3
+        apps = comm.allgather((dpm.appnum(), role))
+        assert sorted(set(apps)) == [(0, "one"), (1, "two")], apps
+        mpi.Finalize()
+    """)
+    fd, path = tempfile.mkstemp(suffix="_mpmd.py")
+    with os.fdopen(fd, "w") as fh:
+        fh.write(prog)
+    fd2, appfile = tempfile.mkstemp(suffix="_appfile")
+    with os.fdopen(fd2, "w") as fh:
+        fh.write(f"# two contexts, one world\n"
+                 f"-n 1 {path} one\n"
+                 f"-n 2 {path} two\n")
+    try:
+        for args in (
+            ["-n", "1", path, "one", ":", "-n", "2", path, "two"],
+            ["--app", appfile],
+        ):
+            r = subprocess.run(
+                [_sys.executable, "-m", "ompi_tpu.runtime.launcher",
+                 "--timeout", "120"] + args,
+                capture_output=True, text=True, timeout=150)
+            assert r.returncode == 0, (r.stdout, r.stderr)
+    finally:
+        os.unlink(path)
+        os.unlink(appfile)
