@@ -325,11 +325,16 @@ def reduce_dev(comm, sendbuf, op=op_mod.SUM, root: int = 0,
         return staging.reduce_dev(comm, sendbuf, op, root)
     det = _det(deterministic)
     n = comm.size
+    opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
     nbytes = int(sendbuf.size) * np.dtype(sendbuf.dtype).itemsize
-    # small buffers / deterministic modes: every device computes the
-    # full reduction (one compiled program; the rank-order fold
-    # contract requires the flat schedule anyway)
-    if n == 1 or det is not None or not _rooted(nbytes * n):
+    # small buffers / deterministic modes keep the one-program full
+    # reduction (the rank-order contract needs the flat schedule
+    # anyway). Non-SUM ops too: reduce_scatter has no native
+    # psum_scatter lowering for them, so the "rooted" program would
+    # still materialize the full reduction AND pay the per-source
+    # rounds on top — strictly worse than the shared program.
+    if (n == 1 or det is not None or opn.name != "MPI_SUM"
+            or not _rooted(nbytes * n)):
         out = allreduce_dev(comm, sendbuf, op, deterministic)
         return out if comm.rank == root else None
     # rooted schedule: reduce_scatter leaves each rank ONE 1/n chunk
@@ -342,7 +347,6 @@ def reduce_dev(comm, sendbuf, op=op_mod.SUM, root: int = 0,
     from ompi_tpu.parallel import collectives as C
 
     ctx = _ctx(comm)
-    opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
     flat = sendbuf.reshape(-1)
     pad = (-flat.size) % n
     if pad:
@@ -687,7 +691,6 @@ def alltoallv_dev(comm, sendbuf, scounts, rcounts, max_count=None):
     call — unless the caller passes ``max_count`` (e.g. a fixed MoE
     expert capacity, the common TPU dispatch pattern), which makes the
     path entirely host-free and is the recommended usage."""
-    pvar.record("coll_xla_device")
     scounts = tuple(int(c) for c in scounts)
     rcounts = tuple(int(c) for c in rcounts)
     if comm.size == 1:
@@ -719,6 +722,8 @@ def alltoallv_dev(comm, sendbuf, scounts, rcounts, max_count=None):
             raise ValueError(
                 f"alltoallv: max_count {m} below local max "
                 f"{max(max(scounts), max(rcounts))}")
+    pvar.record("coll_xla_device")  # after the fallback decision, so
+    # the device-path counter never counts host-staged calls
     rest = sendbuf.shape[1:]
     rows = []
     off = 0
