@@ -26,6 +26,25 @@ Buffer = Union[np.ndarray, bytearray, memoryview, bytes]
 _SPAN_WINDOW_LIMIT = 1 << 22
 
 
+def _pattern_perm(pattern) -> np.ndarray:
+    """Byte permutation applying a typemap wire pattern's byteswap to
+    ONE packed element: each (unit, nbytes) segment reverses bytes
+    within every unit (unit 1 = raw/padding, identity)."""
+    parts = []
+    pos = 0
+    for unit, nbytes in pattern:
+        if unit <= 1:
+            parts.append(np.arange(pos, pos + nbytes, dtype=np.int64))
+        else:
+            k = nbytes // unit
+            parts.append(
+                (pos + np.arange(k * unit, dtype=np.int64)
+                 .reshape(k, unit)[:, ::-1]).reshape(-1))
+        pos += nbytes
+    return (np.concatenate(parts) if parts
+            else np.empty(0, np.int64))
+
+
 def _writable_byte_view(buf: Buffer) -> np.ndarray:
     if isinstance(buf, np.ndarray):
         return buf.view(np.uint8).reshape(-1)
@@ -58,6 +77,8 @@ class Convertor:
         self.wire_swap = False
         self.wire_round = False
         self._swap_unit = 0
+        self._swap_dtype = None  # uniform-base fast path; mixed
+        self._swap_perm = None   # layouts use the pattern permutation
         if dtype.lb < 0:
             # MPI allows negative lb (bytes before the buffer pointer);
             # with array-backed buffers that memory does not exist. The
@@ -121,31 +142,52 @@ class Convertor:
         """Cross-architecture peer (reference:
         opal_copy_functions_heterogeneous.c; the arch descriptor of
         opal/util/arch.c rides the modex). The packed wire format is
-        element-dense, so conversion = per-element byte reversal on
-        the wire. ``swap=False`` still enables window ROUNDING to
+        element-dense, so conversion = per-typemap-entry byte reversal
+        on the wire. ``swap=False`` still enables window ROUNDING to
         whole elements (a swapping peer must never see a split
         element); ``swap=True`` also reverses bytes.
 
-        Only uniform-base layouts can convert: a derived type without
-        a single base element dtype (mixed struct) has no per-element
-        reversal and raises — the documented cross-arch limit."""
+        Uniform-base layouts swap with one vectorized byteswap; mixed
+        layouts (MINLOC pairs, structs of different-size fields) swap
+        through their wire pattern — a per-element byte permutation
+        derived from the typemap (datatype.wire_pattern), with window
+        rounding coarsened to whole packed elements so the pattern
+        always applies at offset 0."""
         base = self.dtype.base
-        if base is None or base.kind == "V":
+        if base is not None and base.names is None:
+            self._swap_unit = int(base.itemsize)
+            self._swap_dtype = base
+            self.wire_round = True
+            self.wire_swap = swap and self._swap_unit > 1
+            return
+        from ompi_tpu.datatype.datatype import wire_pattern
+
+        pat = wire_pattern(self.dtype)
+        if pat is None:
             raise ValueError(
-                f"datatype {self.dtype.name!r} has no uniform base "
-                "element dtype; cross-architecture transfer of mixed "
-                "layouts is unsupported (convert on the host first)")
-        self._swap_unit = int(base.itemsize)
-        self._swap_dtype = base
+                f"datatype {self.dtype.name!r} has no typemap wire "
+                "pattern (raw span table); cross-architecture "
+                "transfer of unknown layouts is unsupported "
+                "(convert on the host first)")
+        self._swap_dtype = None
+        # the pattern is ONE PERIOD of the packed stream: windows
+        # round to the period and the permutation applies by reshape
+        self._swap_unit = int(sum(nb for _, nb in pat)) or 1
+        self._swap_perm = _pattern_perm(pat)
         self.wire_round = True
-        self.wire_swap = swap and self._swap_unit > 1
+        self.wire_swap = swap and any(u > 1 for u, _ in pat)
 
     def _swap_bytes(self, data: bytes) -> bytes:
         # per-COMPONENT byteswap (complex values swap each float
         # half; whole-element reversal would exchange re/im) — the
         # same numpy semantics the external32 _swap_wire path uses
-        return np.frombuffer(
-            data, dtype=self._swap_dtype).byteswap().tobytes()
+        if self._swap_dtype is not None:
+            return np.frombuffer(
+                data, dtype=self._swap_dtype).byteswap().tobytes()
+        # mixed layout: apply the per-element typemap permutation
+        arr = np.frombuffer(data, np.uint8).reshape(-1,
+                                                    self._swap_unit)
+        return arr[:, self._swap_perm].tobytes()
 
     # -- pack -------------------------------------------------------------
     def pack(self, max_bytes: Optional[int] = None) -> bytes:

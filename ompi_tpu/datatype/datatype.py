@@ -97,6 +97,65 @@ def _tile(spans: np.ndarray, n: int, stride: int) -> np.ndarray:
     return _merge(np.stack([offs, lens], axis=1))
 
 
+def _pattern_of_np(dt: np.dtype):
+    """Wire pattern of one packed element of a numpy dtype: a list of
+    (unit_bytes, nbytes) segments in offset order — the typemap the
+    heterogeneous convertor swaps by
+    (opal_copy_functions_heterogeneous.c converts per typemap entry).
+    unit 1 = raw bytes (padding, no swap); complex swaps per
+    component."""
+    dt = np.dtype(dt)
+    if dt.names is None:
+        if dt.kind == "V":  # opaque raw bytes: NEVER swapped (the
+            # uniform numpy-byteswap path is an identity on void too)
+            return [(1, dt.itemsize)]
+        unit = dt.itemsize // 2 if dt.kind == "c" else dt.itemsize
+        return [(max(unit, 1), dt.itemsize)]
+    segs = []
+    pos = 0
+    for name in sorted(dt.names, key=lambda k: dt.fields[k][1]):
+        fld, off = dt.fields[name][0], dt.fields[name][1]
+        if off > pos:
+            segs.append((1, off - pos))  # padding: raw
+        segs.extend(_pattern_of_np(fld))
+        pos = off + fld.itemsize
+    if pos < dt.itemsize:
+        segs.append((1, dt.itemsize - pos))
+    return _merge_pattern(segs)
+
+
+def _merge_pattern(segs):
+    out = []
+    for unit, nbytes in segs:
+        if nbytes <= 0:
+            continue
+        if out and out[-1][0] == unit:
+            out[-1] = (unit, out[-1][1] + nbytes)
+        else:
+            out.append((unit, nbytes))
+    return out
+
+
+def wire_pattern(d: "Datatype"):
+    """ONE PERIOD of the (unit, nbytes) swap pattern of `d`'s packed
+    stream — the stream is a repetition of this period (the inner
+    typemap element), so the convertor tiles it by reshaping, never
+    by materializing O(count) patterns. None when unknown (a raw
+    span table with no type info — the heterogeneous path must
+    reject it rather than corrupt)."""
+    if d.pattern is not None:
+        return d.pattern
+    if d.base is not None and d.base.names is None:
+        if d.base.kind == "V":
+            return [(1, d.base.itemsize)] if d.size else []
+        unit = (d.base.itemsize // 2 if d.base.kind == "c"
+                else d.base.itemsize)
+        return [(max(unit, 1), d.base.itemsize)] if d.size else []
+    if d.base is not None:  # structured numpy base
+        return _pattern_of_np(d.base)
+    return None
+
+
 class Datatype:
     """An MPI datatype: a byte-layout description over an (N,2) span table."""
 
@@ -104,17 +163,19 @@ class Datatype:
     # (mpool.buffer_key) needs weakref support — without it a recycled
     # id() could alias a dead dtype's cached tables
     __slots__ = ("spans", "size", "extent", "lb", "name", "base",
-                 "committed", "__weakref__")
+                 "committed", "pattern", "__weakref__")
 
     def __init__(self, spans, extent: int, lb: int = 0,
                  base: Optional[np.dtype] = None,
-                 name: str = "derived") -> None:
+                 name: str = "derived", pattern=None) -> None:
         self.spans = _merge(_as_span_array(spans))
         self.size = int(self.spans[:, 1].sum()) if len(self.spans) else 0
         self.extent = int(extent)
         self.lb = int(lb)
         self.base = base
         self.name = name
+        self.pattern = pattern  # mixed-layout wire pattern (see
+        # wire_pattern); uniform-base types derive theirs on demand
         self.committed = False
 
     # -- introspection (MPI_Type_size / get_extent) ----------------------
@@ -144,7 +205,7 @@ class Datatype:
 
     def dup(self) -> "Datatype":
         return Datatype(self.spans, self.extent, self.lb, self.base,
-                        self.name + "_dup")
+                        self.name + "_dup", pattern=self.pattern)
 
     def spans_for_count(self, count: int) -> np.ndarray:
         """(N,2) span table covering ``count`` consecutive elements.
@@ -251,8 +312,11 @@ def contiguous(count: int, old: Datatype) -> Datatype:
     """MPI_Type_contiguous (ompi_datatype_create_contiguous.c)."""
     spans = _tile(old.spans, count, old.extent)
     base = old.base if old.is_contiguous else None
+    # the packed stream stays periodic in old's element: ONE period
+    # suffices (never tile O(count) patterns at type creation)
+    pat = wire_pattern(old) if base is None else None
     return Datatype(spans, count * old.extent, lb=old.lb, base=base,
-                    name="contiguous")
+                    name="contiguous", pattern=pat)
 
 
 def vector(count: int, blocklength: int, stride: int,
@@ -277,8 +341,13 @@ def hvector(count: int, blocklength: int, stride_bytes: int,
     lb = placements_lo + old.lb
     ub = placements_hi + old.ub
     # a vector of a uniform element keeps that element as its typemap
-    # base (external32 swaps by it)
-    return Datatype(spans, ub - lb, lb=lb, base=old.base, name="vector")
+    # base (external32 swaps by it); mixed elements carry ONE period
+    # of their wire pattern (the packed stream repeats it)
+    pat = None
+    if old.base is None or old.base.names is not None:
+        pat = wire_pattern(old)
+    return Datatype(spans, ub - lb, lb=lb, base=old.base,
+                    name="vector", pattern=pat)
 
 
 def indexed(blocklengths: Sequence[int], displs: Sequence[int],
@@ -326,9 +395,33 @@ def create_struct(blocklengths: Sequence[int], displs_bytes: Sequence[int],
     spans = np.concatenate(parts)
     bases = {t.base for t in types if t.size}
     base = bases.pop() if len(bases) == 1 else None  # uniform only
+    pat = None
+    if base is None:  # mixed: compose the wire pattern in pack
+        # (declaration) order so the hetero convertor can swap per
+        # typemap entry (opal_copy_functions_heterogeneous.c). Each
+        # field contributes bl*t.size bytes = its period tiled; a
+        # pathological pattern (huge blocklengths of mixed fields)
+        # degrades to None — the hetero path then rejects instead of
+        # building an unbounded descriptor.
+        pat = []
+        for bl, t in zip(blocklengths, types):
+            if bl <= 0 or t.size == 0:
+                continue
+            p = wire_pattern(t)
+            if p is None:
+                pat = None
+                break
+            period = sum(nb for _, nb in p)
+            reps = (bl * t.size) // period
+            if len(pat) + reps * len(p) > (1 << 16):
+                pat = None
+                break
+            pat.extend(p * reps)
+        pat = _merge_pattern(pat) if pat is not None else None
     # struct pack order follows declaration order (MPI pack traversal),
     # which for typical ascending-displacement structs is ascending
-    return Datatype(spans, ub - lb, lb=lb, base=base, name="struct")
+    return Datatype(spans, ub - lb, lb=lb, base=base, name="struct",
+                    pattern=pat)
 
 
 def subarray(sizes: Sequence[int], subsizes: Sequence[int],
@@ -363,4 +456,4 @@ def subarray(sizes: Sequence[int], subsizes: Sequence[int],
 def resized(old: Datatype, lb: int, extent: int) -> Datatype:
     """MPI_Type_create_resized."""
     return Datatype(old.spans, extent, lb=lb, base=old.base,
-                    name=old.name + "_resized")
+                    name=old.name + "_resized", pattern=old.pattern)
