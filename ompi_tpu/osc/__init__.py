@@ -791,3 +791,11 @@ def win_allocate(comm, shape, dtype=np.uint8,
     arr = np.zeros(shape, dtype)
     du = disp_unit if disp_unit is not None else arr.dtype.itemsize
     return Window(comm, arr, du, info=info)
+
+
+# compiled device one-sided (active-target fence epochs as ppermute
+# programs — the ICI analog of osc_rdma_comm.c RMA; passive target
+# stays on the Window AM path above)
+from ompi_tpu.osc.device_epoch import (  # noqa: E402,F401
+    DeviceEpochWindow, win_create_device,
+)

@@ -1,0 +1,67 @@
+"""Compiled device one-sided — fence epochs as ppermute programs
+(r3 VERDICT weak #6). Reference role: osc_rdma_comm.c:838 RMA inside
+access epochs; here the epoch's Put/Gets batch into edge-colored
+CollectivePermute rounds with zero host staging of payload bytes.
+"""
+
+from tests.harness import run_ranks
+
+MCA = {"device_plane": "on"}
+
+
+def test_device_epoch_put_get_no_staging():
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import osc
+    from ompi_tpu.core import pvar
+    win = osc.win_create_device(comm, jnp.zeros(16, jnp.float32))
+    win.Fence()
+    # ring of puts: rank r writes [r, r+0.5] into (r+1)%size at disp 2r
+    nxt = (rank + 1) % size
+    win.Put(jnp.array([rank, rank + 0.5], jnp.float32), target=nxt,
+            disp=2 * rank)
+    # and fetches back the location it just put (the schedule runs
+    # puts before gets, so the get observes the put deterministically
+    # — MPI leaves same-epoch conflicts undefined; ours is ordered)
+    prev = (rank - 1 + size) % size
+    h = win.Get(2, target=nxt, disp=2 * rank)
+    win.Fence()
+    # my window got my left neighbor's put at disp 2*prev
+    got = np.asarray(win.array)
+    assert got[2 * prev] == prev and got[2 * prev + 1] == prev + 0.5, got
+    np.testing.assert_array_equal(
+        np.asarray(h.array),
+        np.array([rank, rank + 0.5], np.float32))
+    # zero host staging of payload bytes
+    assert pvar.read("coll_accelerator_staged") == 0
+    assert pvar.read("osc_put") == 0 and pvar.read("osc_get") == 0
+    assert pvar.read("osc_device_epoch_op") == 2
+    win.Free()
+    """, 4, mca=MCA)
+
+
+def test_device_epoch_multiple_puts_and_sizes():
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import osc
+    from ompi_tpu.core import pvar
+    win = osc.win_create_device(comm, jnp.zeros(32, jnp.float32))
+    win.Fence()
+    if rank == 0:
+        # two different-size puts to two targets in ONE epoch
+        win.Put(jnp.full(4, 7.0, jnp.float32), target=1, disp=0)
+        win.Put(jnp.full(8, 9.0, jnp.float32), target=2, disp=8)
+    if rank == 3:
+        win.Put(jnp.full(4, 3.0, jnp.float32), target=1, disp=4)
+    win.Fence()
+    a = np.asarray(win.array)
+    if rank == 1:
+        assert (a[:4] == 7.0).all() and (a[4:8] == 3.0).all(), a
+    if rank == 2:
+        assert (a[8:16] == 9.0).all(), a
+    assert pvar.read("coll_accelerator_staged") == 0
+    # empty epoch is legal
+    win.Fence()
+    win.Fence()
+    win.Free()
+    """, 4, mca=MCA)
