@@ -38,7 +38,7 @@ from ompi_tpu.btl import base as btl_base
 from ompi_tpu.core import arch, events, memchecker, mpool, output, pvar
 from ompi_tpu.datatype import BYTE, Convertor
 from ompi_tpu.datatype.convertor import dtype_of
-from ompi_tpu.pml import peruse
+from ompi_tpu.pml import custommatch, peruse
 from ompi_tpu.pml import request as rq
 from ompi_tpu.runtime import rte
 
@@ -171,9 +171,12 @@ class Ob1:
         from ompi_tpu.btl.base import Bml
 
         self.bml = Bml()
-        # matching state, keyed by ctx (= cid*2 + collective bit)
-        self.posted: Dict[int, deque] = {}
-        self.unexpected: Dict[int, deque] = {}
+        # matching state, keyed by ctx (= cid*2 + collective bit);
+        # containers come from the selected matching engine (plain
+        # deques, or the indexed custom-match analog — see
+        # pml/custommatch.py, pml_ob1_custom_match.h)
+        self.posted: Dict[int, object] = {}
+        self.unexpected: Dict[int, object] = {}
         # ordered delivery: per (ctx, src) sequence numbers
         self.send_seq: Dict[Tuple[int, int], int] = {}
         self.recv_seq: Dict[Tuple[int, int], int] = {}
@@ -432,27 +435,51 @@ class Ob1:
         req.wait()
         return req._obj
 
+    def _find_unexpected(self, ctx: int, want_src: int, want_tag: int,
+                         take: bool):
+        """Oldest unexpected frag matching the receive pattern, via
+        the selected matching engine (the ONE dispatch point — post,
+        iprobe and improbe all route here so the engines can never
+        drift)."""
+        q = self.unexpected.get(ctx)
+        if q is None:
+            return None
+        if isinstance(q, custommatch.UnexpectedIndex):
+            return q.find(want_src, want_tag, take)
+        probe = RecvRequest(ctx, want_src, want_tag, None, 0, None,
+                            False)
+        for cand in q:
+            if self._hdr_matches(probe, cand.hdr):
+                if take:
+                    q.remove(cand)
+                return cand
+        return None
+
     def _post(self, req: RecvRequest) -> None:
         """Try the unexpected queue, else append to posted."""
-        ux_q = self.unexpected.setdefault(req.ctx, deque())
-        for ux in ux_q:
-            if self._hdr_matches(req, ux.hdr):
-                ux_q.remove(ux)
-                if peruse.active:
-                    peruse.fire(peruse.MSG_REMOVE_FROM_UNEX_Q,
-                                ctx=req.ctx, src=ux.hdr[2],
-                                tag=ux.hdr[3], size=ux.hdr[5],
-                                msgid=ux.hdr[7])
-                    peruse.fire(peruse.REQ_MATCH_UNEX, ctx=req.ctx,
-                                src=ux.hdr[2], tag=ux.hdr[3],
-                                size=ux.hdr[5], msgid=ux.hdr[7])
-                if events.active("pml_message_matched"):
-                    events.emit("pml_message_matched", ctx=req.ctx,
-                                src=ux.hdr[2], tag=ux.hdr[3],
-                                size=ux.hdr[5], from_unexpected=True)
-                self._match(req, ux.hdr, ux.payload, ux.src_world)
-                return
-        self.posted.setdefault(req.ctx, deque()).append(req)
+        ux = self._find_unexpected(req.ctx, req.want_src,
+                                   req.want_tag, take=True)
+        if ux is not None:
+            if peruse.active:
+                peruse.fire(peruse.MSG_REMOVE_FROM_UNEX_Q,
+                            ctx=req.ctx, src=ux.hdr[2],
+                            tag=ux.hdr[3], size=ux.hdr[5],
+                            msgid=ux.hdr[7])
+                peruse.fire(peruse.REQ_MATCH_UNEX, ctx=req.ctx,
+                            src=ux.hdr[2], tag=ux.hdr[3],
+                            size=ux.hdr[5], msgid=ux.hdr[7])
+            if events.active("pml_message_matched"):
+                events.emit("pml_message_matched", ctx=req.ctx,
+                            src=ux.hdr[2], tag=ux.hdr[3],
+                            size=ux.hdr[5], from_unexpected=True)
+            self._match(req, ux.hdr, ux.payload, ux.src_world)
+            return
+        # get-or-create (NOT setdefault: make_posted() costs a cvar
+        # lookup + container alloc, too much for the per-post path)
+        q = self.posted.get(req.ctx)
+        if q is None:
+            q = self.posted[req.ctx] = custommatch.make_posted()
+        q.append(req)
         if peruse.active:
             peruse.fire(peruse.REQ_INSERT_IN_POSTED_Q, ctx=req.ctx,
                         src=req.want_src, tag=req.want_tag)
@@ -475,14 +502,13 @@ class Ob1:
 
         progress.progress()
         ctx = self._ctx(comm)
-        probe = RecvRequest(ctx, src, tag, None, 0, None, False)
-        for ux in self.unexpected.get(ctx, ()):
-            if self._hdr_matches(probe, ux.hdr):
-                st = rq.Status()
-                _, _, s, t, _, size, _, _ = ux.hdr
-                st.source, st.tag, st.count = s, t, size
-                pvar.record("matched_probes")
-                return st
+        ux = self._find_unexpected(ctx, src, tag, take=False)
+        if ux is not None:
+            st = rq.Status()
+            _, _, s, t, _, size, _, _ = ux.hdr
+            st.source, st.tag, st.count = s, t, size
+            pvar.record("matched_probes")
+            return st
         return None
 
     def probe(self, comm, src: int, tag: int) -> rq.Status:
@@ -506,15 +532,12 @@ class Ob1:
 
         progress.progress()
         ctx = self._ctx(comm)
-        probe = RecvRequest(ctx, src, tag, None, 0, None, False)
-        q = self.unexpected.get(ctx, deque())
-        for ux in q:
-            if self._hdr_matches(probe, ux.hdr):
-                q.remove(ux)
-                st = rq.Status()
-                _, _, s, t, _, size, _, _ = ux.hdr
-                st.source, st.tag, st.count = s, t, size
-                return Message(self, ctx, ux), st
+        ux = self._find_unexpected(ctx, src, tag, take=True)
+        if ux is not None:
+            st = rq.Status()
+            _, _, s, t, _, size, _, _ = ux.hdr
+            st.source, st.tag, st.count = s, t, size
+            return Message(self, ctx, ux), st
         return None
 
     def mprobe(self, comm, src: int, tag: int) -> Tuple[Message, rq.Status]:
@@ -592,22 +615,33 @@ class Ob1:
 
     def _deliver_match(self, hdr, payload) -> None:
         _, ctx, src, tag, _, size, flags, msgid = hdr
-        q = self.posted.setdefault(ctx, deque())
-        for req in q:
-            if self._hdr_matches(req, hdr):
-                q.remove(req)
-                if peruse.active:
-                    peruse.fire(peruse.REQ_REMOVE_FROM_POSTED_Q,
-                                ctx=ctx, src=src, tag=tag, size=size,
-                                msgid=msgid)
-                if events.active("pml_message_matched"):
-                    events.emit("pml_message_matched", ctx=ctx,
-                                src=src, tag=tag, size=size,
-                                from_unexpected=False)
-                self._match(req, hdr, payload, self._src_world(ctx, src))
-                return
+        q = self.posted.get(ctx)
+        if q is None:
+            q = self.posted[ctx] = custommatch.make_posted()
+        if isinstance(q, custommatch.PostedIndex):
+            req = q.match_incoming(src, tag)  # four bucket heads
+        else:
+            req = None
+            for cand in q:
+                if self._hdr_matches(cand, hdr):
+                    q.remove(cand)
+                    req = cand
+                    break
+        if req is not None:
+            if peruse.active:
+                peruse.fire(peruse.REQ_REMOVE_FROM_POSTED_Q,
+                            ctx=ctx, src=src, tag=tag, size=size,
+                            msgid=msgid)
+            if events.active("pml_message_matched"):
+                events.emit("pml_message_matched", ctx=ctx,
+                            src=src, tag=tag, size=size,
+                            from_unexpected=False)
+            self._match(req, hdr, payload, self._src_world(ctx, src))
+            return
         pvar.record("unexpected")
-        uq = self.unexpected.setdefault(ctx, deque())
+        uq = self.unexpected.get(ctx)
+        if uq is None:
+            uq = self.unexpected[ctx] = custommatch.make_unexpected()
         uq.append(_Unexpected(hdr, payload, self._src_world(ctx, src)))
         if peruse.active:
             peruse.fire(peruse.MSG_INSERT_IN_UNEX_Q, ctx=ctx, src=src,

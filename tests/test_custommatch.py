@@ -1,0 +1,157 @@
+"""Indexed matching engines (the ob1 custom-match analog —
+pml_ob1_custom_match.h vector/fuzzy structures, r3 VERDICT missing
+#8). The indexed engine must be behavior-identical to the linear
+walk: MPI matching order is POST order across the wildcard lattice.
+"""
+
+from collections import namedtuple
+
+from tests.harness import run_ranks
+
+MCA = {"pml_ob1_matching": "indexed"}
+
+
+def test_posted_index_unit():
+    from ompi_tpu.pml.custommatch import PostedIndex
+    from ompi_tpu.pml.request import ANY_SOURCE, ANY_TAG
+
+    R = namedtuple("R", "want_src want_tag")
+    q = PostedIndex()
+    a, b, c, d = R(1, 5), R(ANY_SOURCE, 5), R(1, ANY_TAG), \
+        R(ANY_SOURCE, ANY_TAG)
+    for r in (a, b, c, d):
+        q.append(r)
+    assert len(q) == 4 and list(q) == [a, b, c, d]
+    # oldest across the four candidate buckets wins: a
+    assert q.match_incoming(1, 5) is a
+    # next oldest matching (1,5) is the ANY_SOURCE one
+    assert q.match_incoming(1, 5) is b
+    assert q.match_incoming(1, 5) is c
+    # internal (negative) tags never match ANY_TAG buckets
+    assert q.match_incoming(1, -3) is None
+    assert q.match_incoming(2, 9) is d
+    assert not q
+    # remove + tombstone behavior
+    e = R(2, 2)
+    q.append(e)
+    q.remove(e)
+    assert e not in q and q.match_incoming(2, 2) is None
+
+
+def test_unexpected_index_unit():
+    from ompi_tpu.pml.custommatch import UnexpectedIndex
+    from ompi_tpu.pml.request import ANY_SOURCE, ANY_TAG
+
+    class UX:
+        def __init__(self, src, tag):
+            self.hdr = (0, 0, src, tag, 0, 8, 0, 0)
+
+    q = UnexpectedIndex()
+    u1, u2, u3 = UX(0, 7), UX(1, 7), UX(0, -4)
+    for u in (u1, u2, u3):
+        q.append(u)
+    # peek does not remove
+    assert q.find(0, 7, take=False) is u1
+    assert q.find(0, 7, take=True) is u1
+    # wildcard source: oldest across buckets
+    assert q.find(ANY_SOURCE, 7, take=True) is u2
+    # wildcard tag skips internal (negative) tags
+    assert q.find(0, ANY_TAG, take=False) is None
+    assert q.find(0, -4, take=True) is u3
+
+
+def test_indexed_matching_end_to_end():
+    """Wildcards, many outstanding receives, probes and mprobe under
+    the indexed engine — results identical to the linear engine."""
+    run_ranks("""
+    from ompi_tpu import mpi
+    from ompi_tpu.core import cvar
+    assert cvar.get("pml_ob1_matching") == "indexed"
+    if rank == 0:
+        # out-of-order tags into many outstanding recvs on rank 1
+        for tag in (9, 3, 7, 5):
+            comm.Send(np.full(4, float(tag), np.float32), dest=1,
+                      tag=tag)
+        comm.Send(np.full(2, 99.0, np.float32), dest=1, tag=3)
+    else:
+        bufs = {t: np.zeros(4, np.float32) for t in (3, 5, 7, 9)}
+        reqs = [comm.Irecv(bufs[t], source=0, tag=t)
+                for t in (3, 5, 7, 9)]
+        any_buf = np.zeros(2, np.float32)
+        r_any = comm.Irecv(any_buf, source=mpi.ANY_SOURCE,
+                           tag=mpi.ANY_TAG)
+        mpi.wait_all(reqs + [r_any], timeout=60)
+        for t in (3, 5, 7, 9):
+            np.testing.assert_array_equal(
+                bufs[t], np.full(4, float(t), np.float32))
+        # the wildcard got the fifth message (the others were taken
+        # by the older specific receives — post-order semantics)
+        np.testing.assert_array_equal(any_buf,
+                                      np.full(2, 99.0, np.float32))
+    comm.Barrier()
+
+    # probe family over the indexed unexpected queue
+    if rank == 0:
+        comm.Send(np.arange(3, dtype=np.int32), dest=1, tag=42)
+    else:
+        st = comm.Probe(source=0, tag=42)
+        assert st.tag == 42 and st.count == 12
+        msg, mst = comm.Mprobe(source=0, tag=42)
+        got = np.zeros(3, np.int32)
+        comm.Mrecv(msg, got)
+        np.testing.assert_array_equal(got, np.arange(3, dtype=np.int32))
+    comm.Barrier()
+    """, 2, mca=MCA)
+
+
+def test_indexed_vs_linear_equivalence_fuzz():
+    """Seeded random traffic executed under BOTH engines must
+    deliver identically (same payload per receive)."""
+    body = """
+    from ompi_tpu import mpi
+    rng = np.random.default_rng(7)
+    n_msgs = 40
+    plan = [(int(rng.integers(0, 5)), int(rng.integers(1, 50)))
+            for _ in range(n_msgs)]  # (tag, size)
+    if rank == 0:
+        for i, (tag, sz) in enumerate(plan):
+            comm.Send(np.full(sz, float(i), np.float32), dest=1,
+                      tag=tag)
+    else:
+        got = []
+        # receive per-tag in posted order with occasional wildcards
+        reqs = []
+        for i, (tag, sz) in enumerate(plan):
+            buf = np.zeros(sz, np.float32)
+            src = mpi.ANY_SOURCE if i % 7 == 0 else 0
+            t = mpi.ANY_TAG if i % 11 == 0 else tag
+            reqs.append((i, buf, comm.Irecv(buf, source=src, tag=t)))
+        # hmm: wildcard recvs may match other-tag messages; just wait
+        mpi.wait_all([r for _, _, r in reqs], timeout=90)
+        sig = [tuple(np.asarray(b)[:1]) for _, b, _ in reqs]
+        comm.send(sig, dest=0, tag=999)
+    if rank == 0:
+        sig = comm.recv(source=1, tag=999)
+        import json, os
+        path = os.environ["OMPI_TPU_EQ_OUT"]
+        with open(path, "w") as fh:
+            json.dump([list(map(float, s)) for s in sig], fh)
+    comm.Barrier()
+    """
+    import json
+    import os
+    import tempfile
+
+    outs = []
+    for mode in ("list", "indexed"):
+        fd, path = tempfile.mkstemp(suffix=f"_eq_{mode}.json")
+        os.close(fd)
+        os.environ["OMPI_TPU_EQ_OUT"] = path
+        try:
+            run_ranks(body, 2, mca={"pml_ob1_matching": mode},
+                      isolate=True)
+            outs.append(json.load(open(path)))
+        finally:
+            os.unlink(path)
+            os.environ.pop("OMPI_TPU_EQ_OUT", None)
+    assert outs[0] == outs[1], (outs[0], outs[1])
