@@ -1,0 +1,108 @@
+"""Memory release hooks — the opal/memoryhooks + mca/patcher analog.
+
+Reference: opal/memoryhooks/memory.h
+``opal_mem_hooks_register_release`` + mca/patcher/overwrite — the
+runtime patches munmap/free so registration caches learn when user
+memory disappears and can drop entries that would otherwise alias a
+recycled address.
+
+TPU-first redesign: Python's runtime owns allocation, so the
+interception point is OBJECT DEATH, not libc symbols — one weakref
+finalizer per tracked buffer fires every registered release hook
+with the buffer's ``id()`` (the address-key analog). Same contract
+("this memory is going away; drop anything keyed on it"), no binary
+patching — which is the part of the reference's machinery that
+exists only because C cannot observe frees.
+
+Subscribers: every :class:`ompi_tpu.core.mpool.Rcache` registers at
+construction (the grdma pattern); :func:`release` is the explicit
+form for non-object-lifetime memory (an mmap segment unlinked before
+its Python wrapper dies — the literal munmap hook case).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Callable, List, Set
+
+from ompi_tpu.core import pvar
+
+_lock = threading.Lock()
+_hooks: List[Callable[[int], None]] = []
+_tracked: Set[int] = set()
+
+
+def register_release(cb: Callable[[int], None],
+                     weak: bool = False) -> None:
+    """opal_mem_hooks_register_release: ``cb(key)`` runs when a
+    tracked buffer with ``id() == key`` is released. ``weak=True``
+    (bound methods only) subscribes via WeakMethod so the hook never
+    pins its owner — caches subscribe weakly, or every Rcache ever
+    constructed would live (and fan out on every death) forever."""
+    entry = weakref.WeakMethod(cb) if weak else cb
+    with _lock:
+        if entry not in _hooks:
+            _hooks.append(entry)
+
+
+def unregister_release(cb: Callable[[int], None]) -> None:
+    with _lock:
+        for h in list(_hooks):
+            target = h() if isinstance(h, weakref.WeakMethod) else h
+            if target == cb or h is cb:
+                _hooks.remove(h)
+
+
+def nhooks() -> int:
+    return len(_hooks)
+
+
+def release(key: int) -> None:
+    """Explicit release notice (the munmap-hook form, for memory
+    whose lifetime is NOT the wrapper object's — e.g. an unlinked
+    /dev/shm segment)."""
+    with _lock:
+        _tracked.discard(key)
+        hooks = list(_hooks)
+    pvar.record("mem_hooks_released")
+    dead = []
+    for h in hooks:
+        cb = h() if isinstance(h, weakref.WeakMethod) else h
+        if cb is None:  # weak subscriber died: prune
+            dead.append(h)
+            continue
+        cb(key)
+    if dead:
+        with _lock:
+            for h in dead:
+                if h in _hooks:
+                    _hooks.remove(h)
+
+
+def _fire(key: int) -> None:
+    release(key)
+
+
+def track(buf) -> bool:
+    """Install the death hook on ``buf`` (idempotent per object).
+    Returns False for objects that cannot carry weak references —
+    callers must then skip id()-keyed caching entirely (a recycled
+    id could alias a dead object's entries).
+
+    The finalizer installs BEFORE the key publishes in ``_tracked``:
+    a concurrent caller must never be told "tracked" while weakref-
+    ability is still unresolved. Two racers may both install a
+    finalizer — release() is idempotent per key, so the double fire
+    is harmless."""
+    key = id(buf)
+    with _lock:
+        if key in _tracked:
+            return True
+    try:
+        weakref.finalize(buf, _fire, key)
+    except TypeError:
+        return False
+    with _lock:
+        _tracked.add(key)
+    return True

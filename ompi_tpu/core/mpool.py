@@ -27,7 +27,6 @@ malloc + NUMA placement; here: allocation + GC churn).
 from __future__ import annotations
 
 import threading
-import weakref
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -112,11 +111,19 @@ class Rcache:
                  = None) -> None:
         self._map: "OrderedDict[Any, Tuple[Any, int]]" = OrderedDict()
         self._bytes = 0
-        # reentrant: buffer_key's weakref finalizer calls invalidate(),
+        # reentrant: the memhooks release hook calls invalidate(),
         # and cyclic GC can fire it on a thread already inside insert/
         # lookup (allocations under the lock can trigger collection)
         self._lock = threading.RLock()
         self._on_evict = on_evict
+        # grdma pattern: every registration cache subscribes to the
+        # memory-release plane (core/memhooks — the patcher/
+        # memoryhooks analog); invalidate() on an unknown key is a
+        # cheap no-op. WEAK subscription: the hook must not pin the
+        # cache (transient caches would otherwise leak forever)
+        from ompi_tpu.core import memhooks
+
+        memhooks.register_release(self.invalidate, weak=True)
 
     def insert(self, key, value, nbytes: int) -> None:
         evicted = []
@@ -170,37 +177,17 @@ class Rcache:
         return len(self._map)
 
 
-_fin_lock = threading.Lock()
-_fin_registered: set = set()
-
-
 def buffer_key(buf, cache: "Rcache"):
-    """A cache key for a (device) buffer: ``id(buf)`` guarded by a
-    weakref finalizer that invalidates the entry when the buffer dies —
-    the analog of rcache's memory-hook invalidation on munmap
-    (opal/memoryhooks/). Registered once per (buffer, cache): repeat
-    calls on a hot path must not pile up finalizer objects.
+    """A cache key for a (device) buffer: ``id(buf)`` tracked on the
+    memory-release plane (core/memhooks — the opal/memoryhooks +
+    patcher analog); when the buffer dies, every registered cache
+    drops its entries for the key. One death hook per OBJECT serves
+    all caches (the cache subscribed at construction).
 
     Returns None for objects that cannot carry weak references:
     without the death hook a recycled id() could alias a dead object's
     entry and hand back stale cached state, so such objects get no
     cache key at all (callers skip caching)."""
-    key = id(buf)
-    token = (key, id(cache))
-    with _fin_lock:
-        if token in _fin_registered:
-            return key
-        _fin_registered.add(token)
+    from ompi_tpu.core import memhooks
 
-    def _die(k=key, c=cache, t=token):
-        with _fin_lock:
-            _fin_registered.discard(t)
-        c.invalidate(k)
-
-    try:
-        weakref.finalize(buf, _die)
-    except TypeError:
-        with _fin_lock:
-            _fin_registered.discard(token)
-        return None
-    return key
+    return id(buf) if memhooks.track(buf) else None

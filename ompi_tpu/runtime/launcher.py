@@ -81,17 +81,30 @@ def parse_hostfile(path: str) -> List[HostSpec]:
     return hosts
 
 
-def _bind_core_for(local_rank: int, bind_to: str) -> Optional[int]:
-    """CPU core for a local rank under --bind-to core (the
-    PRRTE-binding analog: round-robin over this host's allowed set).
-    The rank applies it via sched_setaffinity at rte.init."""
-    if bind_to != "core":
+def _topo_for(bind_to: str):
+    """ONE topology read per launch (sysfs walks cost O(cpus) file
+    opens — never per rank); None when not binding."""
+    if bind_to in ("none", ""):
         return None
     try:
-        cores = sorted(os.sched_getaffinity(0))
-    except AttributeError:  # non-Linux
+        from ompi_tpu.util.topology import Topology
+
+        return Topology()
+    except Exception:  # binding is a hint; never fail launch over it
         return None
-    return cores[local_rank % len(cores)]
+
+
+def _cpuset_for(local_rank: int, bind_to: str, topo) -> Optional[list]:
+    """CPU set for a local rank under --bind-to core|socket|numa (the
+    PRRTE map/bind analog: ranks round-robin over the policy's
+    topology objects). The rank applies the set via
+    sched_setaffinity at rte.init."""
+    if topo is None:
+        return None
+    try:
+        return topo.cpuset_for(local_rank, bind_to)
+    except Exception:
+        return None
 
 
 def build_env(rank: int, size: int, store_addr, jobid: str,
@@ -101,14 +114,14 @@ def build_env(rank: int, size: int, store_addr, jobid: str,
               local_size: Optional[int] = None,
               hostname: Optional[str] = None,
               bind_addr: Optional[str] = None,
-              bind_core: Optional[int] = None) -> Dict[str, str]:
+              bind_cpus: Optional[list] = None) -> Dict[str, str]:
     env = dict(base_env if base_env is not None else os.environ)
-    if bind_core is not None:
-        env["OMPI_TPU_BIND_CORE"] = str(bind_core)
+    if bind_cpus:
+        env["OMPI_TPU_BIND_CPUS"] = ",".join(map(str, bind_cpus))
     else:
         # never inherit a parent rank's binding (spawned children
-        # would otherwise all pin to the parent's single core)
-        env.pop("OMPI_TPU_BIND_CORE", None)
+        # would otherwise all pin to the parent's cpuset)
+        env.pop("OMPI_TPU_BIND_CPUS", None)
     env["OMPI_TPU_RANK"] = str(rank)
     env["OMPI_TPU_SIZE"] = str(size)
     env["OMPI_TPU_LOCAL_RANK"] = str(
@@ -242,6 +255,7 @@ def launch_mpmd(apps, mca: Optional[Dict[str, str]] = None,
     # fresh blocks above this watermark (ompi_tpu.dpm)
     store.seed_counter(f"ww:{jobid}", total)
     ft = (mca or {}).get("ft", "0") not in ("0", "false", "")
+    topo = _topo_for(bind_to)
     procs: List[subprocess.Popen] = []
     try:
         r = 0
@@ -250,7 +264,8 @@ def launch_mpmd(apps, mca: Optional[Dict[str, str]] = None,
                 argv = [sys.executable] + argv
             for _ in range(n):
                 env = build_env(r, total, store.addr, jobid, mca,
-                                bind_core=_bind_core_for(r, bind_to))
+                                bind_cpus=_cpuset_for(r, bind_to,
+                                                      topo))
                 if len(apps) > 1:  # MPI_APPNUM only exists for MPMD
                     env["OMPI_TPU_APPNUM"] = str(appnum)
                 else:
@@ -368,6 +383,7 @@ def run_daemon(ns) -> int:
         # wrapped HERE with the daemon's own interpreter, never the
         # head's (whose sys.executable may not exist on this host)
         argv = [sys.executable] + argv
+    topo = _topo_for(ns.bind_to)
     procs: List[subprocess.Popen] = []
     try:
         for i in range(ns.local_n):
@@ -376,7 +392,8 @@ def run_daemon(ns) -> int:
                             local_size=ns.local_n,
                             hostname=ns.host_name,
                             bind_addr=ns.bind_addr,
-                            bind_core=_bind_core_for(i, ns.bind_to))
+                            bind_cpus=_cpuset_for(i, ns.bind_to,
+                                                  topo))
             procs.append(subprocess.Popen(argv, env=env))
         rc, clean = _wait_stats(procs, ns.timeout, store=client,
                                 rank_base=ns.rank_base,
@@ -512,9 +529,12 @@ def main(args: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--bind", default=None,
                     help="address the rendezvous store binds")
     ap.add_argument("--bind-to", default="none",
-                    choices=["none", "core"],
-                    help="CPU binding per rank (PRRTE-binding analog: "
-                         "round-robin cores on each host)")
+                    choices=["none", "core", "socket", "numa"],
+                    help="CPU binding per rank (the PRRTE map/bind "
+                         "analog: ranks round-robin over the chosen "
+                         "topology objects — cores incl. SMT "
+                         "siblings, packages, or NUMA nodes, read "
+                         "from sysfs by util/topology)")
     # daemon (prted-analog) flags — internal, set by launch_hosts
     ap.add_argument("--daemon", action="store_true",
                     help=argparse.SUPPRESS)

@@ -79,12 +79,14 @@ def init() -> None:
             # spawn watermark for singleton-rooted spawns
             _local_store.seed_counter(f"ww:{jobid}", 1)
         atexit.register(_shutdown)
-        # CPU binding assigned by the launcher (--bind-to core);
-        # applied rank-side, as PRRTE daemons bind their children
-        core = os.environ.get("OMPI_TPU_BIND_CORE")
-        if core is not None:
+        # CPU binding assigned by the launcher (--bind-to
+        # core|socket|numa); applied rank-side, as PRRTE daemons bind
+        # their children
+        cpus = os.environ.get("OMPI_TPU_BIND_CPUS")
+        if cpus:
             try:
-                os.sched_setaffinity(0, {int(core)})
+                os.sched_setaffinity(
+                    0, {int(c) for c in cpus.split(",")})
             except (AttributeError, OSError, ValueError):
                 pass  # binding is a hint; never fail init over it
 

@@ -1,0 +1,139 @@
+"""Memory release hooks (opal/memoryhooks + patcher analog) and host
+topology mapping (hwloc-glue analog) — SURVEY rows 20/21.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def test_release_hooks_fire_on_object_death():
+    from ompi_tpu.core import memhooks
+
+    fired = []
+    memhooks.register_release(fired.append)
+    try:
+        buf = np.zeros(64)
+        key = id(buf)
+        assert memhooks.track(buf)
+        assert memhooks.track(buf)  # idempotent per object
+        del buf
+        import gc
+
+        gc.collect()
+        assert key in fired
+        # explicit release (the munmap-hook form)
+        memhooks.release(12345)
+        assert 12345 in fired
+    finally:
+        memhooks.unregister_release(fired.append)
+
+
+def test_rcache_invalidates_through_release_plane():
+    from ompi_tpu.core import memhooks, mpool
+
+    cache = mpool.Rcache()
+    buf = np.arange(16)
+    key = mpool.buffer_key(buf, cache)
+    assert key == id(buf)
+    cache.insert(key, "derived", 128)
+    assert cache.lookup(key) == "derived"
+    del buf
+    import gc
+
+    gc.collect()
+    assert cache.lookup(key) is None  # dropped at buffer death
+    # a second cache keyed on the same object is served by the SAME
+    # death hook (one interception point, many subscribers)
+    c2 = mpool.Rcache()
+    b2 = np.arange(4)
+    k2 = mpool.buffer_key(b2, c2)
+    c2.insert(k2, "x", 8)
+    cache.insert(k2, "y", 8)
+    del b2
+    gc.collect()
+    assert c2.lookup(k2) is None and cache.lookup(k2) is None
+    # unweakrefable objects get no key (callers skip caching)
+    assert mpool.buffer_key(42, cache) is None
+
+
+def _fake_sysfs(tmp_path, n_pkgs=2, cores_per_pkg=2, smt=2):
+    """Synthetic sysfs: n_pkgs x cores_per_pkg cores x smt threads,
+    one NUMA node per package."""
+    cpu = 0
+    cpuroot = tmp_path / "cpu"
+    for pkg in range(n_pkgs):
+        for core in range(cores_per_pkg):
+            sibs = [pkg * cores_per_pkg * smt + core * smt + t
+                    for t in range(smt)]
+            for t in sibs:
+                d = cpuroot / f"cpu{t}" / "topology"
+                d.mkdir(parents=True, exist_ok=True)
+                (d / "physical_package_id").write_text(str(pkg))
+                (d / "thread_siblings_list").write_text(
+                    ",".join(map(str, sibs)))
+                cpu += 1
+    for pkg in range(n_pkgs):
+        nd = tmp_path / "node" / f"node{pkg}"
+        nd.mkdir(parents=True, exist_ok=True)
+        lo = pkg * cores_per_pkg * smt
+        hi = lo + cores_per_pkg * smt - 1
+        (nd / "cpulist").write_text(f"{lo}-{hi}")
+    return str(tmp_path)
+
+
+def test_topology_policies_on_synthetic_sysfs(tmp_path):
+    from ompi_tpu.util import topology as T
+
+    root = _fake_sysfs(tmp_path)  # cpus 0..7: 2 pkgs x 2 cores x smt2
+    topo = T.Topology(root=root, allowed=range(8))
+    assert T.describe(topo) == "8 cpus / 4 cores / 2 packages / 2 numa nodes"
+    # core policy: SMT siblings bind together, round-robin
+    assert topo.cpuset_for(0, "core") == [0, 1]
+    assert topo.cpuset_for(1, "core") == [2, 3]
+    assert topo.cpuset_for(4, "core") == [0, 1]  # wraps
+    # socket policy: ranks float over the package
+    assert topo.cpuset_for(0, "socket") == [0, 1, 2, 3]
+    assert topo.cpuset_for(1, "socket") == [4, 5, 6, 7]
+    # numa mirrors packages here
+    assert topo.cpuset_for(1, "numa") == [4, 5, 6, 7]
+    assert topo.cpuset_for(3, "none") == list(range(8))
+    with pytest.raises(ValueError):
+        topo.cpuset_for(0, "bogus")
+    # restricted affinity masks out disallowed cpus
+    topo2 = T.Topology(root=root, allowed=[0, 1, 4])
+    assert topo2.cpuset_for(0, "socket") == [0, 1]
+    assert topo2.cpuset_for(1, "socket") == [4]
+
+
+def test_parse_cpulist():
+    from ompi_tpu.util.topology import parse_cpulist
+
+    assert parse_cpulist("0-3,8,10-11") == [0, 1, 2, 3, 8, 10, 11]
+    assert parse_cpulist("") == []
+
+
+def test_bind_to_core_end_to_end(tmp_path):
+    """--bind-to core works end to end on the real host (one core
+    here: every rank binds its round-robin core's sibling set)."""
+    import subprocess
+    import sys
+    import textwrap
+
+    prog = tmp_path / "bind_check.py"
+    prog.write_text(textwrap.dedent("""
+        import os
+        from ompi_tpu import mpi
+        comm = mpi.Init()
+        cpus = os.environ.get("OMPI_TPU_BIND_CPUS")
+        assert cpus, "launcher must export a cpuset"
+        assert os.sched_getaffinity(0) == {
+            int(c) for c in cpus.split(",")}
+        mpi.Finalize()
+    """))
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.runtime.launcher", "-n", "2",
+         "--bind-to", "core", "--timeout", "90", str(prog)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
