@@ -63,8 +63,30 @@ def _prepare_train():
         # gains only ~2% more while flirting with HBM limits; B=8 ->
         # 114.7 TFLOP/s, worse than B=4 — HBM pressure beats the
         # amortization; pallas flash attention -> ~4% slower at T=1024).
+        # param storage dtype: bfloat16 DEFAULT (measured 2026-07-30:
+        # 130-132 TFLOP/s / 66-67% MFU vs 125.9-128.1 with f32 — the
+        # halved weight HBM reads win ~3.5%, and the upload halves
+        # too. NOTE an earlier 30.3 'bf16 is 4x worse' reading was a
+        # measurement artifact: the SGD update used to promote bf16
+        # params to f32, changing the step signature and recompiling
+        # INSIDE the timed loop — fixed by keeping the storage dtype
+        # in the update). OMPI_TPU_BENCH_PARAM_DTYPE=float32 opts
+        # back into f32 master weights; unknown values raise.
+        want = os.environ.get("OMPI_TPU_BENCH_PARAM_DTYPE",
+                              "bfloat16")
+        if want == "float32":
+            pdt = np.float32
+        elif want == "bfloat16":
+            import ml_dtypes
+
+            pdt = ml_dtypes.bfloat16
+        else:
+            raise ValueError(
+                f"OMPI_TPU_BENCH_PARAM_DTYPE={want!r}: use float32 "
+                "or bfloat16")
         cfg = tfm.Config(vocab=32768, d_model=5120, n_layers=4,
-                         n_heads=40, d_ff=20480, max_seq=1024)
+                         n_heads=40, d_ff=20480, max_seq=1024,
+                         param_dtype=pdt)
         B, T, iters = 4, 1024, 10
     else:  # smoke config for CPU runs
         cfg = tfm.Config(vocab=512, d_model=128, n_layers=2, n_heads=4,

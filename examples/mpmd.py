@@ -26,6 +26,27 @@ def main() -> int:
     print(f"[{role}] rank {comm.rank}/{comm.size} "
           f"appnum={dpm.appnum()} world-sum={int(tot[0])}")
     comm.Barrier()
+
+    parent = mpi.Comm_get_parent()
+    if parent is not None:
+        # spawned child: bridge-allreduce with the parents
+        out = np.zeros(1, np.int64)
+        parent.Allreduce(np.ones(1, np.int64), out)
+        print(f"[{role}] spawned child sees "
+              f"{int(out[0])} parents across the bridge")
+    elif "--no-spawn" not in sys.argv:
+        # Comm_spawn_multiple: two child app contexts merged into ONE
+        # child world, bridged to us by an intercommunicator
+        inter = mpi.Comm_spawn_multiple(
+            [(__file__, ("spawned-a", "--no-spawn"), 1),
+             (__file__, ("spawned-b", "--no-spawn"), 2)], comm=comm)
+        out = np.zeros(1, np.int64)
+        inter.Allreduce(np.ones(1, np.int64), out)
+        print(f"[{role}] spawned {inter.remote_size} children "
+              f"(child contribution sum {int(out[0])})")
+        if comm.rank == 0:
+            dpm.wait_children(timeout=120)
+        comm.Barrier()
     mpi.Finalize()
     return 0
 

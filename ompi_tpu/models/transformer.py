@@ -50,6 +50,11 @@ class Config:
     n_experts: int = 8
     capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
+    #: parameter STORAGE dtype: float32 (default — full-precision
+    #: master weights) or bfloat16 (halves weight HBM traffic per
+    #: step; bench-style max-throughput training. The SGD update
+    #: runs in the storage dtype.)
+    param_dtype: Any = np.float32
     #: context-parallel schedule under sp: "ring" (KV rotation,
     #: O(T/P) memory) or "ulysses" (head-resharding all_to_alls,
     #: exact single-pass softmax; needs local heads % sp size == 0)
@@ -85,24 +90,27 @@ def _is_moe(cfg: Config, layer: int) -> bool:
 def init_params(rng: np.random.Generator, cfg: Config) -> Dict:
     """Full (unsharded) parameters, host-side numpy. Sharding happens at
     the jit boundary via param_specs (the driver of HtoD layout)."""
+    pdt = np.dtype(cfg.param_dtype)
+
     def normal(*shape, scale):
-        return (rng.standard_normal(shape) * scale).astype(np.float32)
+        return np.asarray(rng.standard_normal(shape) * scale,
+                          dtype=pdt)
 
     d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab
     s_emb = 1.0 / math.sqrt(d)
     params: Dict = {
         "embed": normal(v, d, scale=s_emb),
         "pos": normal(cfg.max_seq, d, scale=0.02),
-        "ln_f": {"g": np.ones(d, np.float32),
-                 "b": np.zeros(d, np.float32)},
+        "ln_f": {"g": np.ones(d, pdt),
+                 "b": np.zeros(d, pdt)},
         "layers": [],
     }
     for i in range(cfg.n_layers):
         lp = {
-            "ln1": {"g": np.ones(d, np.float32),
-                    "b": np.zeros(d, np.float32)},
-            "ln2": {"g": np.ones(d, np.float32),
-                    "b": np.zeros(d, np.float32)},
+            "ln1": {"g": np.ones(d, pdt),
+                    "b": np.zeros(d, pdt)},
+            "ln2": {"g": np.ones(d, pdt),
+                    "b": np.zeros(d, pdt)},
             "wq": normal(d, d, scale=s_emb),
             "wk": normal(d, d, scale=s_emb),
             "wv": normal(d, d, scale=s_emb),
@@ -352,7 +360,12 @@ def make_train_step(cfg: Config, ax: Axes, specs, lr: float = 1e-2):
         grads = grad_sync(grads, specs, ax, extra)
         scale = lr / cnt
         new_params = jax.tree.map(
-            lambda p, g: (p - scale * g.astype(p.dtype)), params, grads)
+            # the trailing astype keeps the STORAGE dtype: scale is
+            # f32, and bf16 params would otherwise promote to f32 —
+            # changing the step's input signature and forcing an XLA
+            # recompile inside any timed loop
+            lambda p, g: (p - scale * g.astype(p.dtype)).astype(
+                p.dtype), params, grads)
         return new_params, loss
 
     return step

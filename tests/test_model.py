@@ -169,3 +169,36 @@ def test_sp_ulysses_schedule_matches_single(rngp):
                          tokens, labels)
     np.testing.assert_allclose(float(l), float(ref_l), atol=1e-4)
     _assert_trees_close(p, ref_p, atol=5e-4)
+
+
+def test_bf16_param_storage_dtype_stable():
+    """Config.param_dtype=bfloat16: the SGD update must keep the
+    STORAGE dtype — a promotion to f32 changes the jitted step's
+    input signature and forces a recompile inside any steady-state
+    loop (the exact artifact that once mis-measured bf16 as 4x
+    slower; see BASELINE.md)."""
+    import jax
+    import ml_dtypes
+    import numpy as np
+
+    from ompi_tpu.models import transformer as tfm
+
+    cfg = tfm.Config(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                     d_ff=64, max_seq=32,
+                     param_dtype=ml_dtypes.bfloat16)
+    ax = tfm.Axes()
+    params = tfm.init_params(np.random.default_rng(0), cfg)
+    assert str(np.asarray(params["embed"]).dtype) == "bfloat16"
+    step = jax.jit(tfm.make_train_step(cfg, ax,
+                                       tfm.param_specs(cfg, ax)))
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, 64, (2, 16)).astype(np.int32)
+    labs = np.roll(toks, -1, 1).astype(np.int32)
+    p, loss = step(params, toks, labs)
+    leaves = jax.tree.leaves(p)
+    assert all(str(x.dtype) == "bfloat16" for x in leaves), \
+        sorted({str(x.dtype) for x in leaves})
+    p2, loss2 = step(p, toks, labs)  # same signature: no recompile
+    assert all(str(x.dtype) == "bfloat16"
+               for x in jax.tree.leaves(p2))
+    assert np.isfinite(float(loss2))
