@@ -58,11 +58,15 @@ def _prepare_train():
     if on_tpu:
         # MXU-saturating shape for one v5e-class chip: wide matmuls
         # dominate (d_model/d_ff >> T per-layer attention work), bf16
-        # with f32 accumulation. Probed 2026-07-30: d1024/L8 -> 39%
-        # MFU, d2048/L6 -> 51%, d4096/L4 -> 60%, this -> 64% (d6144/L3
-        # gains only ~2% more while flirting with HBM limits; B=8 ->
-        # 114.7 TFLOP/s, worse than B=4 — HBM pressure beats the
-        # amortization; pallas flash attention -> ~4% slower at T=1024).
+        # with f32 accumulation. Probe ladder (f32 params,
+        # 2026-07-30): d1024/L8 -> 39% MFU, d2048/L6 -> 51%,
+        # d4096/L4 -> 60%, d5120/L4 -> 64%. bf16 param storage
+        # (2026-07-31) freed enough HBM to climb further: d5120/L4 ->
+        # 66-67%, d6144/L3 -> 137 TFLOP/s, d7168/L3 -> 141.5,
+        # d8192/L2-3 -> 141.3-141.9 — a ~141.5 plateau (~72% MFU);
+        # d7168/L3 is mid-plateau with the cheapest upload. Still
+        # rejected: B=8 (121 even under bf16) and pallas flash
+        # attention (~4% slower at T=1024).
         # param storage dtype: bfloat16 DEFAULT (measured 2026-07-30:
         # 130-132 TFLOP/s / 66-67% MFU vs 125.9-128.1 with f32 — the
         # halved weight HBM reads win ~3.5%, and the upload halves
@@ -84,9 +88,18 @@ def _prepare_train():
             raise ValueError(
                 f"OMPI_TPU_BENCH_PARAM_DTYPE={want!r}: use float32 "
                 "or bfloat16")
-        cfg = tfm.Config(vocab=32768, d_model=5120, n_layers=4,
-                         n_heads=40, d_ff=20480, max_seq=1024,
-                         param_dtype=pdt)
+        if pdt is np.float32:
+            # the f32-master-weights opt-out measures the f32-tuned
+            # shape (the BASELINE.md f32 band): the bf16 plateau
+            # shape would need 8.4 GB params + 8.4 GB f32 grads —
+            # past v5e HBM — and would not reproduce that band anyway
+            cfg = tfm.Config(vocab=32768, d_model=5120, n_layers=4,
+                             n_heads=40, d_ff=20480, max_seq=1024,
+                             param_dtype=pdt)
+        else:
+            cfg = tfm.Config(vocab=32768, d_model=7168, n_layers=3,
+                             n_heads=56, d_ff=28672, max_seq=1024,
+                             param_dtype=pdt)
         B, T, iters = 4, 1024, 10
     else:  # smoke config for CPU runs
         cfg = tfm.Config(vocab=512, d_model=128, n_layers=2, n_heads=4,

@@ -45,7 +45,11 @@ def main() -> int:
         print(f"[{role}] spawned {inter.remote_size} children "
               f"(child contribution sum {int(out[0])})")
         if comm.rank == 0:
-            dpm.wait_children(timeout=120)
+            try:  # a hung child must not strand the other parents in
+                # the Barrier below — report and continue to teardown
+                dpm.wait_children(timeout=120)
+            except Exception as exc:  # noqa: BLE001
+                print(f"[{role}] child did not exit cleanly: {exc}")
         comm.Barrier()
     mpi.Finalize()
     return 0

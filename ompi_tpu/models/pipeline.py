@@ -196,8 +196,7 @@ def make_pp_train_step(cfg: tfm.Config, ax: tfm.Axes, specs,
             treedef, [pp_sync(g, s)
                       for g, s in zip(g_leaves, s_leaves)])
         scale = lr / cnt
-        new_params = jax.tree.map(
-            lambda p, g: (p - scale * g.astype(p.dtype)), params, grads)
+        new_params = tfm.sgd_update(params, grads, scale)
         return new_params, loss
 
     return step

@@ -343,6 +343,19 @@ def grad_sync(grads, specs, ax: Axes, extra=None):
     return jax.tree.unflatten(treedef, out)
 
 
+def sgd_update(params, grads, scale):
+    """The SGD step shared by the flat and pipeline train steps. The
+    trailing astype keeps each param's STORAGE dtype: scale is f32,
+    and bf16 params would otherwise promote to f32 — changing the
+    jitted step's input signature and forcing an XLA recompile inside
+    any steady-state loop (the artifact documented in BASELINE.md)."""
+    import jax
+
+    return jax.tree.map(
+        lambda p, g: (p - scale * g.astype(p.dtype)).astype(p.dtype),
+        params, grads)
+
+
 def make_train_step(cfg: Config, ax: Axes, specs, lr: float = 1e-2):
     """(params, tokens, labels) -> (new_params, loss). Call inside
     shard_map over the mesh (or directly when all axes are None)."""
@@ -359,13 +372,7 @@ def make_train_step(cfg: Config, ax: Axes, specs, lr: float = 1e-2):
         loss = nll / cnt
         grads = grad_sync(grads, specs, ax, extra)
         scale = lr / cnt
-        new_params = jax.tree.map(
-            # the trailing astype keeps the STORAGE dtype: scale is
-            # f32, and bf16 params would otherwise promote to f32 —
-            # changing the step's input signature and forcing an XLA
-            # recompile inside any timed loop
-            lambda p, g: (p - scale * g.astype(p.dtype)).astype(
-                p.dtype), params, grads)
+        new_params = sgd_update(params, grads, scale)
         return new_params, loss
 
     return step
