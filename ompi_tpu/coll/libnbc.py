@@ -39,6 +39,8 @@ class NbcRequest(rq.Request):
         self._gen = gen
         self._round: Optional[List[rq.Request]] = None
         self._rounds_run = 0
+        self._exc: Optional[BaseException] = None
+        self._in_init = True
         # MPI_T event metadata, harvested from the unstarted
         # generator's bound args (no call-site churn): the schedule
         # kind from its name, the comm from its locals
@@ -53,6 +55,7 @@ class NbcRequest(rq.Request):
             _registered = True
         _active.append(self)
         self._advance()
+        self._in_init = False
 
     def _advance(self) -> int:
         if self.completed:
@@ -80,6 +83,36 @@ class NbcRequest(rq.Request):
                                  rounds=self._rounds_run)
             self.complete()
             return events + 1
+        except Exception as exc:
+            # A schedule body failed (e.g. an ERRORS_RETURN file
+            # errhandler re-raised an IO error out of sched_write).
+            # Letting it escape would surface it in whatever call
+            # happened to be spinning progress.progress() — possibly
+            # an unrelated request's wait. Complete THIS request with
+            # the error instead; it re-raises at its own wait().
+            # Exception: the prologue runs synchronously inside
+            # __init__ — argument errors there stay loud at the
+            # call site.
+            _active.remove(self)
+            if self._in_init:
+                raise
+            self._exc = exc
+            from ompi_tpu import errors as _errors
+
+            code = exc.error_class if isinstance(exc, _errors.MPIError) \
+                else _errors.ERR_OTHER
+            self.complete(error=code)
+            return events + 1
+
+    def wait(self, timeout=None):
+        progress.wait_until(lambda: self.completed, timeout=timeout)
+        if not self.completed:
+            raise TimeoutError(f"request {self.id} did not complete")
+        if self._exc is not None:
+            raise self._exc
+        # completed: base wait returns immediately and runs the
+        # plain-error dispatch path
+        return super().wait(timeout)
 
 
 # -- schedules ------------------------------------------------------------

@@ -106,6 +106,14 @@ def _pattern_of_np(dt: np.dtype):
     component."""
     dt = np.dtype(dt)
     if dt.names is None:
+        if dt.subdtype is not None:
+            # subarray field (e.g. ('<f4', (3,))): kind is 'V' but the
+            # payload is n copies of the base scalar — swap per element,
+            # not raw (raw would skip the byteswap and corrupt)
+            base, shape = dt.subdtype
+            n = int(np.prod(shape))
+            inner = _pattern_of_np(base)
+            return _merge_pattern(inner * n)
         if dt.kind == "V":  # opaque raw bytes: NEVER swapped (the
             # uniform numpy-byteswap path is an identity on void too)
             return [(1, dt.itemsize)]
@@ -145,14 +153,12 @@ def wire_pattern(d: "Datatype"):
     reject it rather than corrupt)."""
     if d.pattern is not None:
         return d.pattern
-    if d.base is not None and d.base.names is None:
-        if d.base.kind == "V":
-            return [(1, d.base.itemsize)] if d.size else []
-        unit = (d.base.itemsize // 2 if d.base.kind == "c"
-                else d.base.itemsize)
-        return [(max(unit, 1), d.base.itemsize)] if d.size else []
-    if d.base is not None:  # structured numpy base
-        return _pattern_of_np(d.base)
+    if d.base is not None:
+        # scalar, complex, void, subarray and structured bases all
+        # derive through ONE function — duplicating the scalar logic
+        # here once skipped the subarray case and shipped a no-swap
+        # pattern for subarray bases
+        return _pattern_of_np(d.base) if d.size else []
     return None
 
 

@@ -62,17 +62,26 @@ def element_indices(dt, count: int, itemsize: int) -> np.ndarray:
     return starts + inc
 
 
-def _indices(dt, count: int, itemsize: int) -> np.ndarray:
+def _bounds(idx: np.ndarray):
+    if len(idx) == 0:
+        return 0, -1
+    return int(idx.min()), int(idx.max())
+
+
+def _indices(dt, count: int, itemsize: int):
+    """(index vector, (min, max)) — bounds are cached with the vector
+    so the per-call check stays O(1) on the big-count hot path."""
     key = _mpool.buffer_key(dt, _idx_cache)
     if key is None:
-        return element_indices(dt, count, itemsize)
+        idx = element_indices(dt, count, itemsize)
+        return idx, _bounds(idx)
     per = _idx_cache.lookup(key) or {}
     got = per.get((count, itemsize))
     if got is None:
-        got = per[(count, itemsize)] = element_indices(dt, count,
-                                                       itemsize)
+        idx = element_indices(dt, count, itemsize)
+        got = per[(count, itemsize)] = (idx, _bounds(idx))
         _idx_cache.insert(key, per,
-                          sum(v.nbytes for v in per.values()))
+                          sum(v[0].nbytes for v in per.values()))
     return got
 
 
@@ -88,11 +97,13 @@ def pack(arr, dt, count: int):
         return flat if count is None else flat[:count]
     if dt.is_contiguous:
         return flat[:(dt.size * count) // k]
-    idx = _indices(dt, count, k)
-    if len(idx) and int(idx[-1]) >= flat.size:
+    idx, (lo, hi) = _indices(dt, count, k)
+    # span tables preserve declaration order (descending displacements
+    # are legal) — bound by max/min, not the last entry
+    if len(idx) and (hi >= flat.size or lo < 0):
         raise ValueError(
             f"datatype {dt.name} x {count} spans element "
-            f"{int(idx[-1])} but the device array has {flat.size}")
+            f"{hi} but the device array has {flat.size}")
     return jnp.take(flat, jnp.asarray(idx), axis=0)
 
 
@@ -109,12 +120,13 @@ def unpack(packed, dt, count: int, template):
             packed.reshape(-1)).reshape(template.shape)
     import jax.numpy as jnp
 
-    idx = _indices(dt, count, np.dtype(template.dtype).itemsize)
+    idx, (lo, hi) = _indices(dt, count,
+                             np.dtype(template.dtype).itemsize)
     flat = template.reshape(-1)
-    if len(idx) and int(idx[-1]) >= flat.size:
+    if len(idx) and (hi >= flat.size or lo < 0):
         raise ValueError(
             f"datatype {dt.name} x {count} spans element "
-            f"{int(idx[-1])} but the template has {flat.size}")
+            f"{hi} but the template has {flat.size}")
     return flat.at[jnp.asarray(idx)].set(
         packed.reshape(-1)).reshape(template.shape)
 

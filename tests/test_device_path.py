@@ -11,6 +11,7 @@ plane up).
 """
 
 import numpy as np
+import pytest
 
 from tests.harness import run_ranks
 
@@ -236,6 +237,28 @@ def test_device_datatype_pack_unpack_unit():
     s = D.create_struct([1, 1], [0, 4],
                         [D.INT8, D.FLOAT])
     assert not dtdev.supports(s, x)
+
+
+def test_device_pack_descending_displacements_bounds():
+    """ADVICE r4: span tables preserve declaration order, so an
+    indexed type with DESCENDING displacements must still be
+    bounds-checked (idx.max(), not idx[-1]) — the XLA gather clamps
+    silently otherwise."""
+    import jax.numpy as jnp
+
+    from ompi_tpu.datatype import datatype as D
+    from ompi_tpu.datatype import device as dtdev
+
+    desc = D.indexed([2, 2], [8, 0], D.FLOAT)
+    x6 = jnp.arange(6, dtype=jnp.float32)
+    with pytest.raises(ValueError):
+        dtdev.pack(x6, desc, 1)
+    with pytest.raises(ValueError):
+        dtdev.unpack(jnp.zeros(4, jnp.float32), desc, 1, x6)
+    # a large-enough array packs in declaration order
+    x10 = jnp.arange(10, dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(dtdev.pack(x10, desc, 1)),
+                                  [8, 9, 0, 1])
 
 
 def test_device_icollective_with_datatype():

@@ -112,6 +112,50 @@ else:
 """)
 
 
+def test_subarray_struct_pattern():
+    """ADVICE r4: a subarray field like ('v','<f4',(3,)) has kind 'V'
+    with names None but is NOT opaque padding — it swaps per float
+    element. True void stays raw."""
+    import numpy as np
+
+    from ompi_tpu.datatype.datatype import _pattern_of_np
+
+    dt = np.dtype([("v", "<f4", (3,)), ("i", "<i4")])
+    assert _pattern_of_np(dt) == [(4, 16)]  # four 4-byte swaps, merged
+    inner = np.dtype([("d", "<f8"), ("i", "<i4")])
+    nested = np.dtype([("s", inner, (2,))])
+    assert _pattern_of_np(nested) == [(8, 8), (4, 4), (8, 8), (4, 4)]
+    # true void is still raw
+    assert _pattern_of_np(np.dtype("V12")) == [(1, 12)]
+    # wire_pattern must agree for a subarray-BASE datatype (it once
+    # duplicated the scalar logic and skipped the subarray case)
+    from ompi_tpu.datatype import from_numpy_dtype
+    from ompi_tpu.datatype.datatype import wire_pattern
+
+    assert wire_pattern(from_numpy_dtype(
+        np.dtype(("<f4", (3,))))) == [(4, 12)]
+
+
+def test_subarray_struct_cross_arch_roundtrip():
+    """The ADVICE r4 corruption case end-to-end: a struct with a
+    subarray field survives a forced-cross-endian transfer."""
+    _run("""
+from ompi_tpu.datatype import from_numpy_dtype
+dt = np.dtype([("v", "<f4", (3,)), ("i", "<i4")])
+mdt = from_numpy_dtype(dt)
+send = np.zeros(2, dt)
+send["v"] = [[1.5, -2.25, 3e7], [0.5, 4.0, -8.25]]
+send["i"] = [42, -7]
+if rank == 0:
+    comm.Send((send, 2, mdt), dest=1, tag=9)
+else:
+    got = np.zeros_like(send)
+    comm.Recv((got, 2, mdt), source=0, tag=9)
+    np.testing.assert_array_equal(got["v"], send["v"])
+    np.testing.assert_array_equal(got["i"], send["i"])
+""")
+
+
 def test_wire_pattern_unit():
     """Pattern derivation + permutation (single process)."""
     import numpy as np

@@ -58,3 +58,33 @@ def test_multiple_outstanding_nbc():
         for k, out in enumerate(outs):
             assert (out == tot * (k + 1)).all(), (k, out)
     """, 3)
+
+
+def test_nbc_schedule_error_surfaces_at_own_wait():
+    """ADVICE r4: an exception thrown inside a progressed schedule
+    (e.g. an ERRORS_RETURN file errhandler re-raising out of a
+    two-phase IO round) must complete THAT request with the error —
+    not escape out of whatever unrelated call was spinning
+    progress.progress()."""
+    import pytest
+
+    from ompi_tpu import errors
+    from ompi_tpu.coll.libnbc import NbcRequest
+    from ompi_tpu.core import progress
+    from ompi_tpu.pml import request as rq
+
+    gate = rq.Request()
+
+    def bad_sched():
+        yield [gate]
+        raise errors.MPIError(errors.ERR_FILE, "disk on fire")
+
+    req = NbcRequest(bad_sched())
+    assert not req.completed
+    gate.complete()
+    # an unrelated caller spinning progress must NOT see the error
+    progress.progress()
+    assert req.completed
+    assert req.status.error == errors.ERR_FILE
+    with pytest.raises(errors.MPIError, match="disk on fire"):
+        req.wait()
