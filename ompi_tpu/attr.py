@@ -1,0 +1,286 @@
+"""MPI attribute/keyval caching subsystem.
+
+Reference: ompi/attribute/attribute.c (1,498 LoC) — unified keyval
+space across comm/win/datatype with user copy/delete callbacks fired
+on dup/free (ompi/mpi/c/comm_create_keyval.c:47-62), and
+ompi/attribute/attribute_predefined.c:119-195 — ~20 predefined
+attributes (MPI_TAG_UB, MPI_APPNUM, MPI_UNIVERSE_SIZE,
+MPI_WTIME_IS_GLOBAL, window WIN_BASE/WIN_SIZE/DISP_UNIT, ...).
+
+Design notes (vs the reference):
+- One keyval namespace with a ``kind`` marker ("comm"/"win"/"type"),
+  like the reference's unified attribute.c space; kind mismatches
+  raise ERR_KEYVAL at set/get time.
+- Callback convention is Pythonic, not pointer-based:
+  ``copy_fn(obj, keyval, extra_state, value) -> new value`` — return
+  the sentinel :data:`NO_COPY` to drop the attribute on dup (the
+  MPI flag=0 outcome); ``delete_fn(obj, keyval, value, extra_state)``
+  fires on Delete_attr, on overwrite by Set_attr (MPI-3.1 §6.7.2),
+  and on object free.
+- Predefined attributes are read-only resolver functions answered
+  from the runtime/window, never stored — exactly the reference's
+  attribute_predefined.c scheme of registering them against system
+  state at init.
+- Deletion order on object free is insertion order (MPI-4 leaves the
+  order arbitrary; the reference iterates its hash).
+- MPI_Comm_free_keyval semantics: the keyval is marked freed and
+  becomes invalid for NEW set/get calls, but attributes already
+  cached under it keep FUNCTIONING — copy callbacks still fire on
+  dup and delete callbacks on free (MPI-4 §7.7.2: the keyval is only
+  truly freed when the last attached attribute is deleted;
+  attribute.c refcounts the keyval for this).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from ompi_tpu import errors
+
+KEYVAL_INVALID = -1
+
+#: copy_fn return sentinel: do NOT propagate this attribute to the dup
+NO_COPY = object()
+
+
+class Keyval:
+    __slots__ = ("id", "kind", "copy_fn", "delete_fn", "extra_state",
+                 "freed")
+
+    def __init__(self, kid: int, kind: str,
+                 copy_fn: Optional[Callable],
+                 delete_fn: Optional[Callable],
+                 extra_state: Any) -> None:
+        self.id = kid
+        self.kind = kind
+        self.copy_fn = copy_fn
+        self.delete_fn = delete_fn
+        self.extra_state = extra_state
+        self.freed = False
+
+
+# predefined ids live below 100; user keyvals above
+_next_id = itertools.count(100)
+_keyvals: Dict[int, Keyval] = {}
+_lock = threading.Lock()
+
+# -- predefined attribute ids (attribute_predefined.c:119-195) ------------
+TAG_UB = 1
+HOST = 2
+IO = 3
+WTIME_IS_GLOBAL = 4
+APPNUM = 5
+UNIVERSE_SIZE = 6
+LASTUSEDCODE = 7
+WIN_BASE = 20
+WIN_SIZE = 21
+WIN_DISP_UNIT = 22
+WIN_CREATE_FLAVOR = 23
+WIN_MODEL = 24
+
+#: the framework's tag ceiling (pml tags are Python ints on the wire;
+#: advertise the MPI minimum-guarantee-compatible 2^31-1)
+MAX_TAG = (1 << 31) - 1
+
+# window models (MPI-3 §11.4): the AM-backed windows are
+# separate-memory-model; "unified" would claim public==private copy
+WIN_SEPARATE = "separate"
+WIN_FLAVOR_CREATE = "create"
+
+
+def _predef_comm(kid: int):
+    """Resolver for predefined COMM attributes (value, found)."""
+    if kid == TAG_UB:
+        return MAX_TAG, True
+    if kid == WTIME_IS_GLOBAL:
+        # Wtime is per-process perf_counter — never globally synced
+        return False, True
+    if kid == APPNUM:
+        from ompi_tpu import dpm
+
+        return dpm.appnum(), True
+    if kid == UNIVERSE_SIZE:
+        from ompi_tpu.runtime import rte
+
+        return rte.size, True
+    if kid == HOST:
+        from ompi_tpu.runtime import rte
+
+        return rte.hostname(), True
+    if kid == IO:
+        # any rank can perform IO (ompio equivalent is rank-agnostic)
+        return True, True
+    if kid == LASTUSEDCODE:
+        return errors.ERR_LASTCODE, True
+    return None, False
+
+
+def _predef_win(win, kid: int):
+    if kid == WIN_BASE:
+        return win.base, True
+    if kid == WIN_SIZE:
+        return (0 if win.base is None else win.base.nbytes), True
+    if kid == WIN_DISP_UNIT:
+        return win.disp_unit, True
+    if kid == WIN_CREATE_FLAVOR:
+        return getattr(win, "flavor", WIN_FLAVOR_CREATE), True
+    if kid == WIN_MODEL:
+        return WIN_SEPARATE, True
+    return None, False
+
+
+_PREDEF_COMM_IDS = frozenset((TAG_UB, HOST, IO, WTIME_IS_GLOBAL,
+                              APPNUM, UNIVERSE_SIZE, LASTUSEDCODE))
+_PREDEF_WIN_IDS = frozenset((WIN_BASE, WIN_SIZE, WIN_DISP_UNIT,
+                             WIN_CREATE_FLAVOR, WIN_MODEL))
+
+
+# -- keyval lifecycle -----------------------------------------------------
+
+def create_keyval(kind: str, copy_fn: Optional[Callable] = None,
+                  delete_fn: Optional[Callable] = None,
+                  extra_state: Any = None) -> int:
+    """MPI_{Comm,Win,Type}_create_keyval. ``copy_fn=None`` is
+    MPI_NULL_COPY_FN (attribute NOT propagated on dup); pass
+    :func:`dup_fn` for MPI_COMM_DUP_FN (value copied by reference)."""
+    if kind not in ("comm", "win", "type"):
+        raise errors.MPIError(errors.ERR_ARG, f"bad keyval kind {kind}")
+    with _lock:
+        kid = next(_next_id)
+        _keyvals[kid] = Keyval(kid, kind, copy_fn, delete_fn,
+                               extra_state)
+    return kid
+
+
+def free_keyval(kid: int) -> int:
+    """MPI_{Comm,Win,Type}_free_keyval: marks the keyval freed (new
+    set/get raise); existing cached attributes still fire delete
+    callbacks at their object's free. Returns KEYVAL_INVALID for the
+    MPI 'handle set to invalid' convention."""
+    kv = _keyvals.get(kid)
+    if kv is None or kv.freed:
+        raise errors.MPIError(errors.ERR_KEYVAL,
+                              f"invalid keyval {kid}")
+    kv.freed = True
+    return KEYVAL_INVALID
+
+
+def dup_fn(obj, keyval, extra_state, value):
+    """MPI_COMM_DUP_FN / MPI_WIN_DUP_FN / MPI_TYPE_DUP_FN: copy the
+    attribute value by reference."""
+    return value
+
+
+def null_copy_fn(obj, keyval, extra_state, value):
+    """MPI_NULL_COPY_FN: never propagate."""
+    return NO_COPY
+
+
+def _get_kv(kid: int, kind: str, for_set: bool) -> Keyval:
+    kv = _keyvals.get(kid)
+    if kv is None or kv.freed:
+        raise errors.MPIError(errors.ERR_KEYVAL,
+                              f"invalid keyval {kid}")
+    if kv.kind != kind:
+        raise errors.MPIError(
+            errors.ERR_KEYVAL,
+            f"keyval {kid} is a {kv.kind} keyval, used on a {kind}")
+    return kv
+
+
+# -- attribute plane on a host object -------------------------------------
+# Host objects expose a dict attribute ``attrs`` (keyval id -> value).
+# The same dict may hold non-int internal keys (e.g. pml/part state);
+# the keyval plane only ever touches int keys it registered.
+
+
+class AttrHost:
+    """Mixin giving a class the MPI attribute API over its ``attrs``
+    dict. Subclasses set ``_attr_kind`` ("comm"/"win"/"type") and call
+    :func:`copy_attrs` / :func:`delete_attrs` from their dup/free."""
+
+    __slots__ = ()
+    _attr_kind = "comm"
+
+    def Set_attr(self, keyval: int, value) -> None:
+        set_attr(self, self._attr_kind, keyval, value)
+
+    def Get_attr(self, keyval: int):
+        return get_attr(self, self._attr_kind, keyval)
+
+    def Delete_attr(self, keyval: int) -> None:
+        delete_attr(self, self._attr_kind, keyval)
+
+def set_attr(obj, kind: str, kid: int, value: Any) -> None:
+    """MPI_*_set_attr: overwriting an existing value fires the delete
+    callback on the OLD value first (MPI-3.1 §6.7.2). Predefined
+    attributes are read-only (the reference errors on user writes)."""
+    if kid in (_PREDEF_COMM_IDS if kind == "comm" else
+               _PREDEF_WIN_IDS if kind == "win" else ()):
+        raise errors.MPIError(errors.ERR_KEYVAL,
+                              f"predefined attribute {kid} is "
+                              "read-only")
+    kv = _get_kv(kid, kind, for_set=True)
+    if kid in obj.attrs and kv.delete_fn is not None:
+        kv.delete_fn(obj, kid, obj.attrs[kid], kv.extra_state)
+    obj.attrs[kid] = value
+
+
+def get_attr(obj, kind: str, kid: int):
+    """MPI_*_get_attr: returns the value, or None when not set (the
+    flag=false outcome). Predefined ids answer from system state."""
+    if kind == "comm" and kid in _PREDEF_COMM_IDS:
+        val, _ = _predef_comm(kid)
+        return val
+    if kind == "win" and kid in _PREDEF_WIN_IDS:
+        val, _ = _predef_win(obj, kid)
+        return val
+    _get_kv(kid, kind, for_set=False)
+    return obj.attrs.get(kid)
+
+
+def delete_attr(obj, kind: str, kid: int) -> None:
+    """MPI_*_delete_attr: fires the delete callback."""
+    if kid in (_PREDEF_COMM_IDS if kind == "comm" else
+               _PREDEF_WIN_IDS if kind == "win" else ()):
+        raise errors.MPIError(errors.ERR_KEYVAL,
+                              f"predefined attribute {kid} is "
+                              "read-only")
+    kv = _get_kv(kid, kind, for_set=True)
+    if kid not in obj.attrs:
+        raise errors.MPIError(errors.ERR_KEYVAL,
+                              f"attribute {kid} not set")
+    kv.delete_fn and kv.delete_fn(obj, kid, obj.attrs[kid],
+                                  kv.extra_state)
+    del obj.attrs[kid]
+
+
+def copy_attrs(old, new, kind: str) -> None:
+    """The dup hook (ompi_attr_copy_all): fire each cached keyval's
+    copy callback; copy_fn=None (NULL_COPY_FN) and the NO_COPY
+    sentinel both drop the attribute from the dup. Attrs attached
+    before free_keyval still propagate (MPI-4 §7.7.2 — the
+    PETSc-style create/set/free-immediately caching pattern)."""
+    for kid in list(old.attrs):
+        kv = _keyvals.get(kid) if isinstance(kid, int) else None
+        if kv is None or kv.kind != kind:
+            continue
+        if kv.copy_fn is None:
+            continue
+        out = kv.copy_fn(old, kid, kv.extra_state, old.attrs[kid])
+        if out is not NO_COPY:
+            new.attrs[kid] = out
+
+
+def delete_attrs(obj, kind: str) -> None:
+    """The free hook (ompi_attr_delete_all): fire delete callbacks in
+    insertion order, once, and clear."""
+    for kid in list(obj.attrs):
+        kv = _keyvals.get(kid) if isinstance(kid, int) else None
+        if kv is None or kv.kind != kind:
+            continue
+        val = obj.attrs.pop(kid)
+        if kv.delete_fn is not None:
+            kv.delete_fn(obj, kid, val, kv.extra_state)

@@ -41,6 +41,7 @@ class NbcRequest(rq.Request):
         self._rounds_run = 0
         self._exc: Optional[BaseException] = None
         self._in_init = True
+        self._advancing = False
         # MPI_T event metadata, harvested from the unstarted
         # generator's bound args (no call-site churn): the schedule
         # kind from its name, the comm from its locals
@@ -58,12 +59,18 @@ class NbcRequest(rq.Request):
         self._in_init = False
 
     def _advance(self) -> int:
-        if self.completed:
+        if self.completed or self._advancing:
+            # _advancing: a schedule body's send can spin the progress
+            # engine when a transport is full (ob1._pump), re-entering
+            # this sweep while the generator is executing — resuming
+            # it again would raise "generator already executing" into
+            # the error path below (a silent false completion)
             return 0
         if self._round is not None and \
                 not all(r.completed for r in self._round):
             return 0
         events = 0
+        self._advancing = True
         try:
             while True:
                 self._round = self._gen.send(None)
@@ -103,6 +110,8 @@ class NbcRequest(rq.Request):
                 else _errors.ERR_OTHER
             self.complete(error=code)
             return events + 1
+        finally:
+            self._advancing = False
 
     def wait(self, timeout=None):
         progress.wait_until(lambda: self.completed, timeout=timeout)

@@ -13,6 +13,7 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ompi_tpu import errors
+from ompi_tpu.attr import AttrHost
 from ompi_tpu.core import output
 from ompi_tpu.runtime import rte
 
@@ -89,13 +90,16 @@ def lookup_cid(cid: int) -> Optional["Communicator"]:
     return _comms.get(cid)
 
 
-class Communicator:
+class Communicator(AttrHost):
     """Base communicator: group + cid + per-comm collective table.
 
     P2P methods (send/recv families) and collective methods are attached
     by ompi_tpu.mpi (the API layer) and ompi_tpu.coll (table stacking) —
-    this module owns identity, construction and destruction.
+    this module owns identity, construction and destruction. Attribute
+    caching (Set/Get/Delete_attr) comes from AttrHost.
     """
+
+    _attr_kind = "comm"
 
     def __init__(self, group: Group, cid: int,
                  errhandler: str = errors.ERRORS_ARE_FATAL) -> None:
@@ -153,6 +157,10 @@ class Communicator:
         c = Communicator(Group(self.group.ranks), cid,
                          self.errhandler)
         c.info = self.info.dup()
+        if self.attrs:  # keyval copy callbacks (ompi_attr_copy_all)
+            from ompi_tpu import attr as _attr
+
+            _attr.copy_attrs(self, c, "comm")
         return c
 
     def split(self, color: int, key: int = 0) -> Optional["Communicator"]:
@@ -210,6 +218,10 @@ class Communicator:
         return sub
 
     def free(self) -> None:
+        if self.attrs:  # delete callbacks fire BEFORE destruction
+            from ompi_tpu import attr as _attr
+
+            _attr.delete_attrs(self, "comm")
         with _comms_lock:
             _comms.pop(self.cid, None)
 

@@ -17,6 +17,7 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from ompi_tpu.attr import AttrHost
 from ompi_tpu.core import mpool as _mpool
 
 #: tiled span tables per (derived dtype, count) — rcache analog
@@ -162,14 +163,17 @@ def wire_pattern(d: "Datatype"):
     return None
 
 
-class Datatype:
-    """An MPI datatype: a byte-layout description over an (N,2) span table."""
+class Datatype(AttrHost):
+    """An MPI datatype: a byte-layout description over an (N,2) span table.
+
+    Attribute caching (Set/Get/Delete_attr) comes from AttrHost."""
 
     # __weakref__: the span cache's invalidate-on-death hook
     # (mpool.buffer_key) needs weakref support — without it a recycled
     # id() could alias a dead dtype's cached tables
     __slots__ = ("spans", "size", "extent", "lb", "name", "base",
-                 "committed", "pattern", "__weakref__")
+                 "committed", "pattern", "attrs", "__weakref__")
+    _attr_kind = "type"
 
     def __init__(self, spans, extent: int, lb: int = 0,
                  base: Optional[np.dtype] = None,
@@ -183,6 +187,7 @@ class Datatype:
         self.pattern = pattern  # mixed-layout wire pattern (see
         # wire_pattern); uniform-base types derive theirs on demand
         self.committed = False
+        self.attrs = {}  # keyval attribute cache (ompi_tpu.attr)
 
     # -- introspection (MPI_Type_size / get_extent) ----------------------
     @property
@@ -206,12 +211,22 @@ class Datatype:
         self.committed = True
         return self
 
-    def free(self) -> None:  # handles are GC'd; kept for API parity
-        pass
+    def free(self) -> None:
+        """MPI_Type_free: handles are GC'd; the visible effect is the
+        attribute delete callbacks (ompi_attr_delete_all)."""
+        if self.attrs:
+            from ompi_tpu import attr as _attr
+
+            _attr.delete_attrs(self, "type")
 
     def dup(self) -> "Datatype":
-        return Datatype(self.spans, self.extent, self.lb, self.base,
-                        self.name + "_dup", pattern=self.pattern)
+        d = Datatype(self.spans, self.extent, self.lb, self.base,
+                     self.name + "_dup", pattern=self.pattern)
+        if self.attrs:
+            from ompi_tpu import attr as _attr
+
+            _attr.copy_attrs(self, d, "type")
+        return d
 
     def spans_for_count(self, count: int) -> np.ndarray:
         """(N,2) span table covering ``count`` consecutive elements.

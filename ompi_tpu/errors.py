@@ -31,11 +31,13 @@ ERR_IN_STATUS = 19
 ERR_WIN = 45
 ERR_FILE = 27
 ERR_NO_MEM = 34
+ERR_KEYVAL = 48
 ERR_NOT_SUPPORTED = 51
 # ULFM (reference: ompi/mpiext/ftmpi)
 ERR_PROC_FAILED = 75
 ERR_PROC_FAILED_PENDING = 76
 ERR_REVOKED = 77
+ERR_LASTCODE = 92  # MPI_ERR_LASTCODE (the MPI_LASTUSEDCODE floor)
 
 
 class MPIError(Exception):

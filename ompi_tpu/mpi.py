@@ -1200,6 +1200,43 @@ from ompi_tpu.errors import (  # noqa: E402,F401
     create_errhandler as File_create_errhandler,
 )
 
+# attribute/keyval caching (ompi/attribute/attribute.c; predefined
+# attrs attribute_predefined.c:119-195). Objects expose
+# Set_attr/Get_attr/Delete_attr; keyvals are created per object class.
+from ompi_tpu import attr as _attr_mod  # noqa: E402
+from ompi_tpu.attr import (  # noqa: E402,F401
+    APPNUM, HOST, IO, KEYVAL_INVALID, LASTUSEDCODE, NO_COPY, TAG_UB,
+    UNIVERSE_SIZE, WIN_BASE, WIN_CREATE_FLAVOR, WIN_DISP_UNIT,
+    WIN_MODEL, WIN_SIZE, WTIME_IS_GLOBAL, dup_fn, null_copy_fn,
+)
+
+
+def Comm_create_keyval(copy_fn=None, delete_fn=None, extra_state=None):
+    """MPI_Comm_create_keyval: copy_fn(obj, keyval, extra_state, val)
+    -> new val (return mpi.NO_COPY to drop the attr on dup; copy_fn
+    None never propagates); delete_fn(obj, keyval, val, extra_state)
+    fires on delete/overwrite/free."""
+    return _attr_mod.create_keyval("comm", copy_fn, delete_fn,
+                                   extra_state)
+
+
+def Win_create_keyval(copy_fn=None, delete_fn=None, extra_state=None):
+    return _attr_mod.create_keyval("win", copy_fn, delete_fn,
+                                   extra_state)
+
+
+def Type_create_keyval(copy_fn=None, delete_fn=None, extra_state=None):
+    return _attr_mod.create_keyval("type", copy_fn, delete_fn,
+                                   extra_state)
+
+
+def Comm_free_keyval(keyval: int) -> int:
+    return _attr_mod.free_keyval(keyval)
+
+
+Win_free_keyval = Comm_free_keyval
+Type_free_keyval = Comm_free_keyval
+
 
 # ---------------------------------------------------------------------------
 # module-level state: COMM_WORLD / COMM_SELF / init / finalize

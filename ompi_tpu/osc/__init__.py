@@ -29,6 +29,7 @@ import numpy as np
 
 from ompi_tpu import op as op_mod
 from ompi_tpu import pml
+from ompi_tpu.attr import AttrHost
 from ompi_tpu.core import output, pvar
 from ompi_tpu.pml.request import ANY_SOURCE, Request
 
@@ -67,7 +68,7 @@ class _WinRequest(Request):
         return self.status
 
 
-class Window:
+class Window(AttrHost):
     """MPI_Win over a local numpy buffer (Win_create semantics).
 
     Device windows (r2 VERDICT missing #5): ``base`` may be a jax
@@ -142,6 +143,11 @@ class Window:
         self._progress_cb = self._progress
         progress.register(self._progress_cb)
         self.comm.coll.barrier(self.comm)  # creation is collective
+
+    # Attribute caching (Set/Get/Delete_attr) comes from AttrHost;
+    # predefined WIN_BASE/WIN_SIZE/WIN_DISP_UNIT/... answer from the
+    # window's own fields (attribute_predefined.c:119-195).
+    _attr_kind = "win"
 
     # ------------------------------------------------------------------
     # service plumbing
@@ -640,6 +646,10 @@ class Window:
 
     # -------------------------------------------------------------------
     def Free(self) -> None:
+        if self.attrs:  # delete callbacks fire BEFORE destruction
+            from ompi_tpu import attr as _attr
+
+            _attr.delete_attrs(self, "win")
         self.comm.coll.barrier(self.comm)
         self._closed = True
         from ompi_tpu.core import progress

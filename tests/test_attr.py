@@ -1,0 +1,170 @@
+"""MPI attribute/keyval caching subsystem (r4 VERDICT missing #1).
+
+Reference parity: ompi/attribute/attribute.c (keyval space, copy/
+delete callbacks on dup/free, overwrite-fires-delete), and
+ompi/attribute/attribute_predefined.c:119-195 (TAG_UB, APPNUM,
+UNIVERSE_SIZE, WTIME_IS_GLOBAL, WIN_BASE/WIN_SIZE/DISP_UNIT).
+"""
+
+import pytest
+
+from tests.harness import run_ranks
+
+
+# -- single-process: keyval lifecycle + type attrs ------------------------
+
+class _Obj:
+    def __init__(self):
+        self.attrs = {}
+
+
+def test_keyval_lifecycle_and_kind_check():
+    from ompi_tpu import attr, errors
+
+    o = _Obj()
+    kv = attr.create_keyval("comm")
+    attr.set_attr(o, "comm", kv, 7)
+    assert attr.get_attr(o, "comm", kv) == 7
+    # kind mismatch: a comm keyval used on a win
+    with pytest.raises(errors.MPIError):
+        attr.set_attr(o, "win", kv, 1)
+    with pytest.raises(errors.MPIError):
+        attr.get_attr(o, "win", kv)
+    # freeing invalidates NEW set/get...
+    assert attr.free_keyval(kv) == attr.KEYVAL_INVALID
+    with pytest.raises(errors.MPIError):
+        attr.set_attr(o, "comm", kv, 8)
+    with pytest.raises(errors.MPIError):
+        attr.free_keyval(kv)  # double free
+    # ...but cached attrs still fire delete callbacks at object free
+    log = []
+    kv2 = attr.create_keyval(
+        "comm", delete_fn=lambda ob, k, v, e: log.append(v))
+    attr.set_attr(o, "comm", kv2, "alive")
+    attr.free_keyval(kv2)
+    attr.delete_attrs(o, "comm")
+    assert log == ["alive"]
+    # unknown keyval
+    with pytest.raises(errors.MPIError):
+        attr.get_attr(o, "comm", 99999)
+
+
+def test_predefined_readonly_and_values():
+    from ompi_tpu import attr, errors
+
+    o = _Obj()
+    assert attr.get_attr(o, "comm", attr.TAG_UB) == (1 << 31) - 1
+    assert attr.get_attr(o, "comm", attr.WTIME_IS_GLOBAL) is False
+    with pytest.raises(errors.MPIError):
+        attr.set_attr(o, "comm", attr.TAG_UB, 5)
+    with pytest.raises(errors.MPIError):
+        attr.delete_attr(o, "comm", attr.TAG_UB)
+
+
+def test_type_keyval_dup_and_free():
+    """Type attrs propagate through Datatype.dup via copy callbacks
+    and fire delete callbacks at Type_free — the PETSc-style caching
+    pattern."""
+    from ompi_tpu import mpi
+    from ompi_tpu.datatype import FLOAT, vector
+
+    log = []
+
+    def cpy(obj, k, extra, val):
+        log.append(("copy", val))
+        return val * 2
+
+    def dele(obj, k, val, extra):
+        log.append(("del", val))
+
+    kv = mpi.Type_create_keyval(cpy, dele)
+    t = vector(3, 2, 4, FLOAT).commit()
+    t.Set_attr(kv, 5)
+    d = t.dup()
+    assert d.Get_attr(kv) == 10 and t.Get_attr(kv) == 5
+    d.free()
+    t.free()
+    assert log == [("copy", 5), ("del", 10), ("del", 5)]
+    # NULL copy (copy_fn=None): not propagated
+    kv2 = mpi.Type_create_keyval()
+    t2 = vector(2, 1, 2, FLOAT)
+    t2.Set_attr(kv2, "x")
+    assert t2.dup().Get_attr(kv2) is None
+    # dup_fn: copied by reference
+    kv3 = mpi.Type_create_keyval(copy_fn=mpi.dup_fn)
+    t2.Set_attr(kv3, ["ref"])
+    assert t2.dup().Get_attr(kv3) is t2.Get_attr(kv3)
+    # NO_COPY sentinel from a user copy_fn drops the attr
+    kv4 = mpi.Type_create_keyval(
+        copy_fn=lambda o, k, e, v: mpi.NO_COPY)
+    t2.Set_attr(kv4, 1)
+    assert t2.dup().Get_attr(kv4) is None
+    # MPI-4 §7.7.2: attrs attached BEFORE free_keyval keep functioning
+    # — the PETSc create/set/free-immediately caching pattern
+    kv5 = mpi.Type_create_keyval(copy_fn=mpi.dup_fn)
+    t2.Set_attr(kv5, 77)
+    mpi.Type_free_keyval(kv5)
+    assert t2.dup().attrs.get(kv5) == 77  # Get_attr is invalid now,
+    # but the cached attr propagated through the copy callback
+
+
+# -- rank tests: comm dup/free order, predefined, windows -----------------
+
+def test_comm_attr_callbacks_exact_order():
+    run_ranks("""
+        log = []
+        def cpy(obj, k, extra, val):
+            assert extra == "es"
+            log.append(("copy", val))
+            return val + 1
+        def dele(obj, k, val, extra):
+            log.append(("del", val))
+        kv = mpi.Comm_create_keyval(cpy, dele, extra_state="es")
+        comm.Set_attr(kv, 10)
+        assert comm.Get_attr(kv) == 10
+        c2 = comm.dup()
+        assert c2.Get_attr(kv) == 11        # copy_fn's return
+        assert comm.Get_attr(kv) == 10      # source untouched
+        c2.free()
+        assert log == [("copy", 10), ("del", 11)], log
+        comm.Set_attr(kv, 20)               # overwrite fires delete(old)
+        assert log[-1] == ("del", 10), log
+        comm.Delete_attr(kv)
+        assert log[-1] == ("del", 20), log
+        assert comm.Get_attr(kv) is None
+    """, 2)
+
+
+def test_comm_predefined_attrs():
+    run_ranks("""
+        assert comm.Get_attr(mpi.TAG_UB) == (1 << 31) - 1
+        assert comm.Get_attr(mpi.WTIME_IS_GLOBAL) is False
+        assert comm.Get_attr(mpi.UNIVERSE_SIZE) == size
+        assert comm.Get_attr(mpi.IO) is True
+        import ompi_tpu.runtime.rte as rte
+        assert comm.Get_attr(mpi.HOST) == rte.hostname()
+        try:
+            comm.Set_attr(mpi.TAG_UB, 1)
+            raise SystemExit("predefined attr was writable")
+        except Exception:
+            pass
+    """, 2)
+
+
+def test_win_attrs_and_callbacks():
+    run_ranks("""
+        from ompi_tpu import osc
+        buf = np.arange(8, dtype=np.float64)
+        win = osc.win_create(comm, buf, disp_unit=8)
+        assert win.Get_attr(mpi.WIN_SIZE) == 64
+        assert win.Get_attr(mpi.WIN_DISP_UNIT) == 8
+        assert win.Get_attr(mpi.WIN_BASE) is win.base
+        assert win.Get_attr(mpi.WIN_MODEL) == "separate"
+        log = []
+        kv = mpi.Win_create_keyval(
+            delete_fn=lambda o, k, v, e: log.append(v))
+        win.Set_attr(kv, "cached")
+        assert win.Get_attr(kv) == "cached"
+        win.Free()                       # delete callbacks fire here
+        assert log == ["cached"], log
+    """, 2)

@@ -88,3 +88,45 @@ def test_nbc_schedule_error_surfaces_at_own_wait():
     assert req.status.error == errors.ERR_FILE
     with pytest.raises(errors.MPIError, match="disk on fire"):
         req.wait()
+
+
+def test_nbc_schedule_reentrant_progress_safe():
+    """A schedule body that spins the progress engine (ob1 ep.send
+    does when a transport is full) must not resume its own executing
+    generator — that ValueError would silently complete the request
+    with ERR_OTHER and strand the collective's peers."""
+    from ompi_tpu.coll.libnbc import NbcRequest
+    from ompi_tpu.core import progress
+    from ompi_tpu.pml import request as rq
+
+    gate = rq.Request()
+    seen = []
+
+    def sched():
+        yield [gate]
+        progress.progress()  # re-enters the NBC sweep mid-body
+        seen.append("resumed-once")
+        yield []
+
+    req = NbcRequest(sched())
+    gate.complete()
+    progress.progress()
+    assert req.completed and req.status.error == 0
+    assert seen == ["resumed-once"]
+
+
+def test_nbc_prologue_error_raises_at_call_site():
+    """Argument errors in a schedule's synchronous prologue (before
+    the first yield executes a round) still raise at the call site,
+    not as a deferred completed-with-error request."""
+    import numpy as np
+    import pytest
+
+    from ompi_tpu.coll.libnbc import NbcRequest
+
+    def bad_prologue():
+        raise ValueError("bad recvbuf shape")
+        yield []  # pragma: no cover
+
+    with pytest.raises(ValueError, match="bad recvbuf shape"):
+        NbcRequest(bad_prologue())
