@@ -50,5 +50,10 @@ from ompi_tpu.datatype.datatype import (  # noqa: F401
     create_struct,
     subarray,
     resized,
+    darray,
+    DISTRIBUTE_BLOCK,
+    DISTRIBUTE_CYCLIC,
+    DISTRIBUTE_NONE,
+    DISTRIBUTE_DFLT_DARG,
 )
 from ompi_tpu.datatype.convertor import Convertor  # noqa: F401

@@ -172,7 +172,8 @@ class Datatype(AttrHost):
     # (mpool.buffer_key) needs weakref support — without it a recycled
     # id() could alias a dead dtype's cached tables
     __slots__ = ("spans", "size", "extent", "lb", "name", "base",
-                 "committed", "pattern", "attrs", "__weakref__")
+                 "committed", "pattern", "attrs", "combiner", "cargs",
+                 "__weakref__")
     _attr_kind = "type"
 
     def __init__(self, spans, extent: int, lb: int = 0,
@@ -188,6 +189,11 @@ class Datatype(AttrHost):
         # wire_pattern); uniform-base types derive theirs on demand
         self.committed = False
         self.attrs = {}  # keyval attribute cache (ompi_tpu.attr)
+        # constructor provenance (MPI_Type_get_envelope/_contents,
+        # ompi/mpi/c/type_get_envelope.c): predefined until a
+        # constructor stamps itself via _prov
+        self.combiner = "named"
+        self.cargs = ((), (), ())
 
     # -- introspection (MPI_Type_size / get_extent) ----------------------
     @property
@@ -211,6 +217,27 @@ class Datatype(AttrHost):
         self.committed = True
         return self
 
+    # -- introspection (MPI_Type_get_envelope / get_contents) ------------
+    def Get_envelope(self):
+        """MPI_Type_get_envelope (ompi/mpi/c/type_get_envelope.c):
+        (num_integers, num_addresses, num_datatypes, combiner)."""
+        ints, addrs, types = self.cargs
+        return len(ints), len(addrs), len(types), self.combiner
+
+    def Get_contents(self):
+        """MPI_Type_get_contents (ompi/mpi/c/type_get_contents.c):
+        (integers, addresses, datatypes) exactly as passed to the
+        constructor (MPI-3.1 §4.1.13 per-combiner layout). Erroneous
+        on predefined types, as in the reference."""
+        if self.combiner == "named":
+            from ompi_tpu import errors
+
+            raise errors.MPIError(
+                errors.ERR_TYPE,
+                f"{self.name}: get_contents on a predefined type")
+        ints, addrs, types = self.cargs
+        return list(ints), list(addrs), list(types)
+
     def free(self) -> None:
         """MPI_Type_free: handles are GC'd; the visible effect is the
         attribute delete callbacks (ompi_attr_delete_all)."""
@@ -222,6 +249,7 @@ class Datatype(AttrHost):
     def dup(self) -> "Datatype":
         d = Datatype(self.spans, self.extent, self.lb, self.base,
                      self.name + "_dup", pattern=self.pattern)
+        _prov(d, "dup", (), (), (self,))
         if self.attrs:
             from ompi_tpu import attr as _attr
 
@@ -329,6 +357,14 @@ def from_numpy_dtype(dt) -> Datatype:
 
 # -- constructors (MPI_Type_*) -------------------------------------------
 
+def _prov(d: Datatype, combiner: str, ints, addrs, types) -> Datatype:
+    """Stamp constructor provenance (the MPI-3.1 §4.1.13 envelope/
+    contents record): argument lists exactly as the user passed them."""
+    d.combiner = combiner
+    d.cargs = (tuple(ints), tuple(addrs), tuple(types))
+    return d
+
+
 def contiguous(count: int, old: Datatype) -> Datatype:
     """MPI_Type_contiguous (ompi_datatype_create_contiguous.c)."""
     spans = _tile(old.spans, count, old.extent)
@@ -336,14 +372,16 @@ def contiguous(count: int, old: Datatype) -> Datatype:
     # the packed stream stays periodic in old's element: ONE period
     # suffices (never tile O(count) patterns at type creation)
     pat = wire_pattern(old) if base is None else None
-    return Datatype(spans, count * old.extent, lb=old.lb, base=base,
-                    name="contiguous", pattern=pat)
+    return _prov(Datatype(spans, count * old.extent, lb=old.lb,
+                          base=base, name="contiguous", pattern=pat),
+                 "contiguous", (count,), (), (old,))
 
 
 def vector(count: int, blocklength: int, stride: int,
            old: Datatype) -> Datatype:
     """MPI_Type_vector — stride in elements of old."""
-    return hvector(count, blocklength, stride * old.extent, old)
+    return _prov(hvector(count, blocklength, stride * old.extent, old),
+                 "vector", (count, blocklength, stride), (), (old,))
 
 
 def hvector(count: int, blocklength: int, stride_bytes: int,
@@ -367,15 +405,19 @@ def hvector(count: int, blocklength: int, stride_bytes: int,
     pat = None
     if old.base is None or old.base.names is not None:
         pat = wire_pattern(old)
-    return Datatype(spans, ub - lb, lb=lb, base=old.base,
-                    name="vector", pattern=pat)
+    return _prov(Datatype(spans, ub - lb, lb=lb, base=old.base,
+                          name="vector", pattern=pat),
+                 "hvector", (count, blocklength), (stride_bytes,),
+                 (old,))
 
 
 def indexed(blocklengths: Sequence[int], displs: Sequence[int],
             old: Datatype) -> Datatype:
     """MPI_Type_indexed — displacements in elements of old."""
-    return hindexed([b for b in blocklengths],
-                    [d * old.extent for d in displs], old)
+    bl = list(blocklengths)
+    displs = list(displs)
+    return _prov(hindexed(bl, [d * old.extent for d in displs], old),
+                 "indexed", (len(bl), *bl, *displs), (), (old,))
 
 
 def hindexed(blocklengths: Sequence[int], displs_bytes: Sequence[int],
@@ -383,21 +425,31 @@ def hindexed(blocklengths: Sequence[int], displs_bytes: Sequence[int],
     """MPI_Type_create_hindexed — displacements in bytes. Pack order
     follows the type map (declaration) order per MPI-3.1 §4.1, exactly
     like create_struct with a single repeated type."""
-    d = create_struct(blocklengths, displs_bytes,
-                      [old] * len(blocklengths))
+    bl = list(blocklengths)
+    displs_bytes = list(displs_bytes)
+    d = create_struct(bl, displs_bytes, [old] * len(bl))
     d.name = "indexed"
-    return d
+    return _prov(d, "hindexed", (len(bl), *bl), tuple(displs_bytes),
+                 (old,))
 
 
 def indexed_block(blocklength: int, displs: Sequence[int],
                   old: Datatype) -> Datatype:
     """MPI_Type_create_indexed_block."""
-    return indexed([blocklength] * len(displs), displs, old)
+    displs = list(displs)
+    return _prov(indexed([blocklength] * len(displs), displs, old),
+                 "indexed_block", (len(displs), blocklength, *displs),
+                 (), (old,))
 
 
 def create_struct(blocklengths: Sequence[int], displs_bytes: Sequence[int],
                   types: Sequence[Datatype]) -> Datatype:
     """MPI_Type_create_struct."""
+    # materialize once: callers may pass one-shot iterables, and the
+    # provenance stamp below re-reads every argument list
+    blocklengths = list(blocklengths)
+    displs_bytes = list(displs_bytes)
+    types = list(types)
     parts = []
     lb = None
     ub = None
@@ -411,8 +463,11 @@ def create_struct(blocklengths: Sequence[int], displs_bytes: Sequence[int],
         this_ub = disp + (bl - 1) * t.extent + t.ub
         lb = this_lb if lb is None else min(lb, this_lb)
         ub = this_ub if ub is None else max(ub, this_ub)
-    if not parts:
-        return Datatype([], 0, name="struct")
+    if not parts:  # zero-count struct is still a DERIVED type with
+        # a contents record (MPI_Type_create_struct with count 0)
+        return _prov(Datatype([], 0, name="struct"),
+                     "struct", (len(blocklengths), *blocklengths),
+                     tuple(displs_bytes), tuple(types))
     spans = np.concatenate(parts)
     bases = {t.base for t in types if t.size}
     base = bases.pop() if len(bases) == 1 else None  # uniform only
@@ -441,8 +496,11 @@ def create_struct(blocklengths: Sequence[int], displs_bytes: Sequence[int],
         pat = _merge_pattern(pat) if pat is not None else None
     # struct pack order follows declaration order (MPI pack traversal),
     # which for typical ascending-displacement structs is ascending
-    return Datatype(spans, ub - lb, lb=lb, base=base, name="struct",
-                    pattern=pat)
+    return _prov(Datatype(spans, ub - lb, lb=lb, base=base,
+                          name="struct", pattern=pat),
+                 "struct", (len(list(blocklengths)),
+                            *blocklengths), tuple(displs_bytes),
+                 tuple(types))
 
 
 def subarray(sizes: Sequence[int], subsizes: Sequence[int],
@@ -450,6 +508,7 @@ def subarray(sizes: Sequence[int], subsizes: Sequence[int],
              order: str = "C") -> Datatype:
     """MPI_Type_create_subarray — an ndim tile out of a larger array."""
     ndim = len(sizes)
+    orig = (list(sizes), list(subsizes), list(starts))
     if order != "C":
         sizes = list(reversed(sizes))
         subsizes = list(reversed(subsizes))
@@ -471,10 +530,103 @@ def subarray(sizes: Sequence[int], subsizes: Sequence[int],
     total = 1
     for s in sizes:
         total *= s
-    return Datatype(spans, total * old.extent, name="subarray")
+    return _prov(Datatype(spans, total * old.extent, name="subarray"),
+                 "subarray", (ndim, *orig[0], *orig[1], *orig[2],
+                              order), (), (old,))
 
 
 def resized(old: Datatype, lb: int, extent: int) -> Datatype:
     """MPI_Type_create_resized."""
-    return Datatype(old.spans, extent, lb=lb, base=old.base,
-                    name=old.name + "_resized", pattern=old.pattern)
+    return _prov(Datatype(old.spans, extent, lb=lb, base=old.base,
+                          name=old.name + "_resized",
+                          pattern=old.pattern),
+                 "resized", (), (lb, extent), (old,))
+
+
+# -- darray (MPI_Type_create_darray, ompi/mpi/c/type_create_darray.c) -----
+
+DISTRIBUTE_NONE = "none"
+DISTRIBUTE_BLOCK = "block"
+DISTRIBUTE_CYCLIC = "cyclic"
+DISTRIBUTE_DFLT_DARG = -1
+
+
+def _darray_dim_indices(gsize: int, distrib: str, darg: int,
+                        psize: int, coord: int) -> np.ndarray:
+    """Global indices along one dimension owned by process `coord` of
+    `psize` (HPF block/cyclic rules, type_create_darray.c helpers)."""
+    if distrib == DISTRIBUTE_NONE:
+        if psize != 1:
+            raise ValueError("DISTRIBUTE_NONE requires psize 1")
+        return np.arange(gsize, dtype=np.int64)
+    if distrib == DISTRIBUTE_BLOCK:
+        bsize = (-(-gsize // psize) if darg == DISTRIBUTE_DFLT_DARG
+                 else int(darg))
+        if bsize * psize < gsize:
+            raise ValueError(
+                f"block darg {bsize} x {psize} procs < gsize {gsize}")
+        lo = coord * bsize
+        return np.arange(lo, min(lo + bsize, gsize), dtype=np.int64)
+    if distrib == DISTRIBUTE_CYCLIC:
+        k = 1 if darg == DISTRIBUTE_DFLT_DARG else int(darg)
+        period = k * psize
+        starts = np.arange(coord * k, gsize, period, dtype=np.int64)
+        out = (starts[:, None] + np.arange(k, dtype=np.int64)[None, :])
+        return out.reshape(-1)[out.reshape(-1) < gsize]
+    raise ValueError(f"unknown distribution {distrib!r}")
+
+
+def darray(size: int, rank: int, gsizes: Sequence[int],
+           distribs: Sequence[str], dargs: Sequence[int],
+           psizes: Sequence[int], old: Datatype,
+           order: str = "C") -> Datatype:
+    """MPI_Type_create_darray: the HPF block/cyclic decomposition of
+    an ndim global array over a process grid — THE fileview type for
+    distributed HPC-IO. Process grid ordering is always row-major
+    (MPI-3.1 §4.1.3); ``order`` describes the array storage.
+
+    Extent spans the whole global array so fileviews tile correctly.
+    """
+    gsizes, distribs, dargs, psizes = (list(gsizes), list(distribs),
+                                       list(dargs), list(psizes))
+    ndim = len(gsizes)
+    if int(np.prod(psizes)) != size:
+        raise ValueError(f"psizes {psizes} != size {size}")
+    if not old.is_contiguous:
+        raise NotImplementedError(
+            "darray over non-contiguous base types")
+    orig = (gsizes, distribs, dargs, psizes)
+    # rank -> grid coords, row-major over psizes
+    coords = []
+    stride = size
+    rem = rank
+    for p in psizes:
+        stride //= p
+        coords.append(rem // stride)
+        rem %= stride
+    gs, ds, da, ps = (list(gsizes), list(distribs), list(dargs),
+                      list(psizes))
+    if order != "C":  # F storage: reverse dims, keep coords aligned
+        gs, ds, da, ps = (list(reversed(gs)), list(reversed(ds)),
+                          list(reversed(da)), list(reversed(ps)))
+        coords = list(reversed(coords))
+    owned = [_darray_dim_indices(gs[d], ds[d], da[d], ps[d], coords[d])
+             for d in range(ndim)]
+    strides = [1] * ndim
+    for i in range(ndim - 2, -1, -1):
+        strides[i] = strides[i + 1] * gs[i + 1]
+    if any(len(o) == 0 for o in owned):
+        flat = np.empty(0, dtype=np.int64)
+    else:
+        grids = np.meshgrid(*owned, indexing="ij")
+        flat = sum(g.astype(np.int64) * strides[d]
+                   for d, g in enumerate(grids)).reshape(-1)
+        flat.sort()
+    offs = flat * old.extent
+    lens = np.full(len(offs), old.extent, dtype=np.int64)
+    spans = (np.stack([offs, lens], axis=1) if len(offs)
+             else np.empty((0, 2), dtype=np.int64))
+    total = int(np.prod(gs)) if ndim else 0
+    return _prov(Datatype(spans, total * old.extent, name="darray"),
+                 "darray", (size, rank, ndim, *orig[0], *orig[1],
+                            *orig[2], *orig[3], order), (), (old,))

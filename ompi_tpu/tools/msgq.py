@@ -108,6 +108,41 @@ def snapshot() -> Dict:
     return out
 
 
+def decode_type(dt) -> Dict:
+    """Decode a derived datatype's constructor tree via
+    Get_envelope/Get_contents — what a debugger's handle-introspection
+    DLL shows for a type handle (reference: ompi_mpihandles_dll.c
+    datatype decoding over MPI_Type_get_envelope/_contents)."""
+    ni, na, nd, combiner = dt.Get_envelope()
+    node: Dict = {"combiner": combiner, "name": dt.name,
+                  "size": dt.size, "extent": dt.extent}
+    if combiner == "named":
+        return node
+    ints, addrs, types = dt.Get_contents()
+    node["integers"] = ints
+    node["addresses"] = addrs
+    node["types"] = [decode_type(t) for t in types]
+    return node
+
+
+def render_type(dt, indent: int = 0) -> List[str]:
+    """Human-readable lines for a derived-type tree — one
+    envelope/contents walk per node."""
+    _, _, _, combiner = dt.Get_envelope()
+    pad = "  " * indent
+    line = (f"{pad}{combiner} '{dt.name}' "
+            f"size={dt.size} extent={dt.extent}")
+    if combiner == "named":
+        return [line]
+    ints, addrs, types = dt.Get_contents()
+    if ints or addrs:
+        line += f" args={ints + addrs}"
+    lines = [line]
+    for t in types:
+        lines.extend(render_type(t, indent + 1))
+    return lines
+
+
 def render(snap: Dict = None) -> List[str]:
     snap = snapshot() if snap is None else snap
     lines = ["MPI message queues:"]

@@ -1,0 +1,214 @@
+"""Type introspection + darray (r4 VERDICT missing #3).
+
+Reference parity: ompi/mpi/c/type_get_envelope.c /
+type_get_contents.c (constructor provenance for tools/debuggers) and
+type_create_darray.c (HPF block/cyclic decomposition fileview type).
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.datatype import datatype as D
+from tests.harness import run_ranks
+
+
+def test_envelope_contents_all_combiners():
+    v = D.vector(3, 2, 4, D.FLOAT)
+    assert v.Get_envelope() == (3, 0, 1, "vector")
+    ints, addrs, types = v.Get_contents()
+    assert ints == [3, 2, 4] and addrs == [] and types == [D.FLOAT]
+
+    hv = D.hvector(3, 2, 16, D.FLOAT)
+    assert hv.Get_envelope() == (2, 1, 1, "hvector")
+    assert hv.Get_contents() == ([3, 2], [16], [D.FLOAT])
+
+    c = D.contiguous(5, D.INT32)
+    assert c.Get_envelope() == (1, 0, 1, "contiguous")
+    assert c.Get_contents() == ([5], [], [D.INT32])
+
+    ix = D.indexed([2, 1], [0, 4], D.DOUBLE)
+    assert ix.Get_envelope() == (5, 0, 1, "indexed")
+    assert ix.Get_contents() == ([2, 2, 1, 0, 4], [], [D.DOUBLE])
+
+    hx = D.hindexed([2, 1], [0, 32], D.DOUBLE)
+    assert hx.Get_envelope() == (3, 2, 1, "hindexed")
+    assert hx.Get_contents() == ([2, 2, 1], [0, 32], [D.DOUBLE])
+
+    ib = D.indexed_block(2, [0, 3], D.FLOAT)
+    assert ib.Get_envelope() == (4, 0, 1, "indexed_block")
+    assert ib.Get_contents() == ([2, 2, 0, 3], [], [D.FLOAT])
+
+    st = D.create_struct([1, 2], [0, 8], [D.DOUBLE, D.INT32])
+    assert st.Get_envelope() == (3, 2, 2, "struct")
+    assert st.Get_contents() == ([2, 1, 2], [0, 8],
+                                 [D.DOUBLE, D.INT32])
+
+    sa = D.subarray([4, 4], [2, 2], [1, 1], D.FLOAT)
+    assert sa.Get_envelope() == (8, 0, 1, "subarray")
+    assert sa.Get_contents() == ([2, 4, 4, 2, 2, 1, 1, "C"], [],
+                                 [D.FLOAT])
+
+    rz = D.resized(v, 0, 64)
+    assert rz.Get_envelope() == (0, 2, 1, "resized")
+    assert rz.Get_contents() == ([], [0, 64], [v])
+
+    dp = v.dup()
+    assert dp.Get_envelope() == (0, 0, 1, "dup")
+    assert dp.Get_contents()[2] == [v]
+
+    da = D.darray(4, 1, [8, 6], [D.DISTRIBUTE_BLOCK,
+                                 D.DISTRIBUTE_CYCLIC],
+                  [D.DISTRIBUTE_DFLT_DARG, 2], [2, 2], D.INT32)
+    ni, na, nd, comb = da.Get_envelope()
+    assert comb == "darray" and nd == 1
+    ints, addrs, types = da.Get_contents()
+    assert ints[:3] == [4, 1, 2] and types == [D.INT32]
+
+    # predefined types have no contents (erroneous per MPI)
+    assert D.FLOAT.Get_envelope() == (0, 0, 0, "named")
+    from ompi_tpu import errors
+
+    with pytest.raises(errors.MPIError):
+        D.FLOAT.Get_contents()
+
+
+def test_msgq_decodes_type_tree():
+    """The debugger plane walks a nested constructor tree via
+    envelope/contents (ompi_mpihandles_dll.c role)."""
+    from ompi_tpu.tools import msgq
+
+    inner = D.create_struct([1, 1], [0, 8], [D.DOUBLE, D.INT32])
+    outer = D.vector(2, 1, 2, inner)
+    tree = msgq.decode_type(outer)
+    assert tree["combiner"] == "vector"
+    assert tree["integers"] == [2, 1, 2]
+    assert tree["types"][0]["combiner"] == "struct"
+    leaf_names = [t["name"] for t in tree["types"][0]["types"]]
+    assert leaf_names == ["MPI_DOUBLE", "MPI_INT32_T"]
+    lines = msgq.render_type(outer)
+    assert lines[0].startswith("vector") and "struct" in lines[1]
+
+
+def test_darray_block_equals_subarray():
+    """Default-darg BLOCK x BLOCK over a 2x2 grid reproduces the
+    manual subarray decomposition rank by rank."""
+    gs = [8, 6]
+    for rank in range(4):
+        i, j = rank // 2, rank % 2
+        da = D.darray(4, rank, gs,
+                      [D.DISTRIBUTE_BLOCK, D.DISTRIBUTE_BLOCK],
+                      [D.DISTRIBUTE_DFLT_DARG] * 2, [2, 2], D.INT32)
+        sa = D.subarray(gs, [4, 3], [4 * i, 3 * j], D.INT32)
+        assert da.merged_spans() == sa.merged_spans(), rank
+        assert da.extent == sa.extent == 8 * 6 * 4
+
+
+def test_darray_cover_and_disjoint():
+    """CYCLIC(2) x BLOCK over ragged gsizes: the rank tiles partition
+    the global array exactly (every cell owned once)."""
+    gs = [7, 5]
+    seen = np.zeros(35, dtype=np.int32)
+    for rank in range(4):
+        da = D.darray(4, rank, gs,
+                      [D.DISTRIBUTE_CYCLIC, D.DISTRIBUTE_BLOCK],
+                      [2, D.DISTRIBUTE_DFLT_DARG], [2, 2], D.INT32)
+        for off, ln in da.merged_spans():
+            assert off % 4 == 0 and ln % 4 == 0
+            seen[off // 4: (off + ln) // 4] += 1
+    assert (seen == 1).all(), seen.reshape(7, 5)
+
+
+def test_darray_fortran_order():
+    """F storage reverses the stride structure, not the grid."""
+    da_c = D.darray(2, 0, [4, 4], [D.DISTRIBUTE_BLOCK,
+                                   D.DISTRIBUTE_NONE],
+                    [D.DISTRIBUTE_DFLT_DARG] * 2, [2, 1], D.FLOAT)
+    da_f = D.darray(2, 0, [4, 4], [D.DISTRIBUTE_BLOCK,
+                                   D.DISTRIBUTE_NONE],
+                    [D.DISTRIBUTE_DFLT_DARG] * 2, [2, 1], D.FLOAT,
+                    order="F")
+    # C: rank 0 owns rows 0-1 (contiguous 32B); F: rank 0 owns the
+    # first two of every column (strided)
+    assert da_c.merged_spans() == [(0, 32)]
+    assert da_f.merged_spans() == [(0, 8), (16, 8), (32, 8), (48, 8)]
+
+
+def test_contents_from_oneshot_iterables_and_empty_struct():
+    """Provenance must record arguments even when callers pass
+    one-shot iterables, and a zero-count struct is still a derived
+    type with a contents record."""
+    ix = D.indexed([2, 1], iter([0, 4]), D.DOUBLE)
+    assert ix.Get_contents() == ([2, 2, 1, 0, 4], [], [D.DOUBLE])
+    hx = D.hindexed(iter([2, 1]), iter([0, 32]), D.DOUBLE)
+    assert hx.Get_contents() == ([2, 2, 1], [0, 32], [D.DOUBLE])
+    st = D.create_struct(iter([1]), iter([0]), iter([D.FLOAT]))
+    assert st.Get_contents() == ([1, 1], [0], [D.FLOAT])
+    empty = D.create_struct([], [], [])
+    assert empty.Get_envelope() == (1, 0, 0, "struct")
+    assert empty.Get_contents() == ([0], [], [])
+
+
+def test_darray_noncontiguous_base_rejected():
+    """darray spans assume a contiguous base cell — a gappy base must
+    reject (same contract as subarray), never silently cover gaps."""
+    v = D.vector(2, 1, 2, D.FLOAT)
+    with pytest.raises(NotImplementedError):
+        D.darray(1, 0, [2], [D.DISTRIBUTE_BLOCK],
+                 [D.DISTRIBUTE_DFLT_DARG], [1], v)
+
+
+def test_darray_errors():
+    with pytest.raises(ValueError):
+        D.darray(4, 0, [8], [D.DISTRIBUTE_BLOCK],
+                 [D.DISTRIBUTE_DFLT_DARG], [2], D.FLOAT)  # grid != size
+    with pytest.raises(ValueError):
+        D.darray(2, 0, [8, 8],
+                 [D.DISTRIBUTE_NONE, D.DISTRIBUTE_BLOCK],
+                 [D.DISTRIBUTE_DFLT_DARG] * 2, [2, 1],
+                 D.FLOAT)  # NONE with psize != 1
+    with pytest.raises(ValueError):
+        D.darray(4, 0, [8, 8],
+                 [D.DISTRIBUTE_BLOCK, D.DISTRIBUTE_BLOCK],
+                 [1, D.DISTRIBUTE_DFLT_DARG], [4, 1],
+                 D.FLOAT)  # block darg too small: 1*4 < 8
+
+
+def test_darray_fileview_collective_io(tmp_path):
+    """The headline use: a darray fileview collective write across 4
+    ranks assembles the exact global array a manual-subarray view
+    produces (type_create_darray.c's purpose)."""
+    path = str(tmp_path / "darray.mpiio")
+    run_ranks(f"""
+        from ompi_tpu import io as io_mod
+        from ompi_tpu.datatype import datatype as D
+        path = {path!r}
+        gs = [8, 8]
+        i, j = rank // 2, rank % 2
+        local = (np.arange(16, dtype=np.int32).reshape(4, 4)
+                 + 100 * (rank + 1))
+        ft = D.darray(size, rank, gs,
+                      [D.DISTRIBUTE_BLOCK, D.DISTRIBUTE_BLOCK],
+                      [D.DISTRIBUTE_DFLT_DARG] * 2, [2, 2], D.INT32)
+        f = io_mod.File_open(comm, path,
+                             io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+        f.Set_view(0, etype=D.INT32, filetype=ft)
+        f.Write_at_all(0, local.reshape(-1))
+        f.Set_view(0)
+        whole = np.zeros(64, dtype=np.int32)
+        f.Read_at_all(0, whole)
+        world = whole.reshape(8, 8)
+        # expected: each rank's 4x4 block at (4i, 4j)
+        for r in range(size):
+            ri, rj = r // 2, r % 2
+            exp = (np.arange(16, dtype=np.int32).reshape(4, 4)
+                   + 100 * (r + 1))
+            np.testing.assert_array_equal(
+                world[4*ri:4*ri+4, 4*rj:4*rj+4], exp)
+        # cross-check: the same write through a manual subarray view
+        ft2 = D.subarray(gs, [4, 4], [4 * i, 4 * j], D.INT32)
+        f.Set_view(0, etype=D.INT32, filetype=ft2)
+        back = np.zeros(16, dtype=np.int32)
+        f.Read_at_all(0, back)
+        np.testing.assert_array_equal(back.reshape(4, 4), local)
+        f.Close()
+    """, 4, timeout=120)
