@@ -96,7 +96,17 @@ class File:
         self.view = FileView()
         self._pos = 0          # individual pointer, visible bytes
         self._lock = threading.Lock()
-        self._fileid: Optional[str] = None
+        # fileid keys the shared-pointer counter. Derived WITHOUT a
+        # bcast: opens are collective and ordered per comm, so a
+        # per-comm open sequence number matches across ranks — and
+        # non-collective shared-fp calls (Get_position_shared,
+        # Write_shared) must never enter a collective to learn it.
+        seq = comm.attrs.get("io:open_seq", 0)
+        comm.attrs["io:open_seq"] = seq + 1
+        # group.ranks[0] disambiguates same-cid comms on different
+        # ranks (every rank's COMM_SELF is cid 1)
+        self._fileid: Optional[str] = \
+            f"{comm.cid}:{comm.group.ranks[0]}:{seq}"
         flags = 0
         if amode & MODE_RDWR:
             flags |= os.O_RDWR
@@ -245,14 +255,22 @@ class File:
         return _IORequest(run)
 
     # -- individual-pointer I/O -------------------------------------------
+    def _seek_target(self, cur: int, offset_bytes: int,
+                     whence: int) -> int:
+        """Seek arithmetic in VISIBLE byte space — both file pointers
+        live there, so SEEK_END maps the physical size through the
+        view's inverse (a view with disp/holes sees fewer bytes than
+        the file holds)."""
+        if whence == SEEK_SET:
+            return offset_bytes
+        if whence == SEEK_CUR:
+            return cur + offset_bytes
+        return self.view.visible_size(self.Get_size()) + offset_bytes
+
     def Seek(self, offset: int, whence: int = SEEK_SET) -> None:
         ebytes = self.view.etype.size
-        if whence == SEEK_SET:
-            self._pos = offset * ebytes
-        elif whence == SEEK_CUR:
-            self._pos += offset * ebytes
-        else:
-            self._pos = self.Get_size() + offset * ebytes
+        self._pos = self._seek_target(self._pos, offset * ebytes,
+                                      whence)
         if self._pos < 0:
             raise errors.MPIError(errors.ERR_ARG, "seek before start")
 
@@ -280,11 +298,6 @@ class File:
 
     # -- shared file pointer (sharedfp equivalent) ------------------------
     def _sfp_key(self) -> str:
-        if self._fileid is None:
-            # collectively-unique per open (rank 0 allocates)
-            self._fileid = self.comm.bcast(
-                f"{self.filename}:{rte.next_id('io')}"
-                if self.comm.rank == 0 else None, root=0)
         return f"io:sfp:{rte.jobid}:{self._fileid}"
 
     def Write_shared(self, buf, count: int = None,
@@ -304,6 +317,111 @@ class File:
         data = self._preadv(extents)
         conv.unpack(data)
         return len(data)
+
+    def Seek_shared(self, offset: int, whence: int = SEEK_SET) -> None:
+        """MPI_File_seek_shared (collective, identical args on every
+        rank — ompi/mpi/c/file_seek_shared.c). Rank 0 moves the shared
+        counter via read+adjust (race-free: MPI forbids concurrent
+        shared-fp ops during the collective); the resolved target
+        broadcasts so a bad seek raises on EVERY rank instead of
+        stranding peers in a barrier."""
+        key = self._sfp_key()
+        cur = tgt = None
+        if self.comm.rank == 0:
+            cur = rte.client().inc(key, 0)
+            tgt = self._seek_target(cur, offset * self.view.etype.size,
+                                    whence)
+        tgt = self.comm.bcast(tgt, root=0)
+        if tgt < 0:
+            raise errors.MPIError(errors.ERR_ARG,
+                                  "shared seek before start")
+        if self.comm.rank == 0:
+            rte.client().inc(key, tgt - cur)
+        self.comm.Barrier()
+
+    def Get_position_shared(self) -> int:
+        """MPI_File_get_position_shared (etype units)."""
+        return (rte.client().inc(self._sfp_key(), 0)
+                // self.view.etype.size)
+
+    # -- ordered shared-fp collectives ------------------------------------
+    # Reference: ompi/mpi/c/file_read_ordered.c (+_begin/_end, write
+    # forms) over sharedfp's write_ordered: ranks write rank-ordered
+    # slices off the shared pointer. Here an allgather of per-rank
+    # sizes yields exscan offsets, rank 0 claims the whole range with
+    # ONE atomic add on the shared counter, and the data movement
+    # rides the existing fcoll two-phase plane.
+    def _ordered_setup(self, nbytes: int) -> int:
+        key = self._sfp_key()  # lazily COLLECTIVE on first use — must
+        # run on every rank here, or rank 0's fileid bcast would pair
+        # with the peers' base bcast below
+        sizes = self.comm.coll.allgather_obj(self.comm, nbytes)
+        total = sum(sizes)
+        base = None
+        if self.comm.rank == 0:
+            base = rte.client().inc(key, total) - total
+        base = self.comm.bcast(base, root=0)
+        return base + sum(sizes[:self.comm.rank])
+
+    def Write_ordered(self, buf, count: int = None,
+                      datatype: dt_mod.Datatype = None) -> int:
+        """MPI_File_write_ordered: as-if serialized in rank order off
+        the shared pointer."""
+        from ompi_tpu.io import fcoll
+
+        data, nbytes = _pack(buf, count, datatype)
+        start = self._ordered_setup(nbytes)
+        return fcoll.two_phase_write(self, self.view.map(start, nbytes),
+                                     data)
+
+    def Read_ordered(self, buf, count: int = None,
+                     datatype: dt_mod.Datatype = None) -> int:
+        from ompi_tpu.io import fcoll
+
+        conv, nbytes = _conv(buf, count, datatype)
+        start = self._ordered_setup(nbytes)
+        return fcoll.two_phase_read(self, self.view.map(start, nbytes),
+                                    conv)
+
+    def Write_ordered_begin(self, buf, count: int = None,
+                            datatype: dt_mod.Datatype = None) -> None:
+        """Split form: the shared pointer and this rank's slice are
+        claimed NOW (collective metadata round); the data movement
+        runs as a progressed schedule so compute overlaps until
+        Write_ordered_end."""
+        from ompi_tpu.coll import libnbc
+        from ompi_tpu.io import fcoll
+
+        self._split_check()
+        data, nbytes = _pack(buf, count, datatype)
+        start = self._ordered_setup(nbytes)
+        out: dict = {}
+        req = libnbc.NbcRequest(fcoll.sched_write(
+            self, self.view.map(start, nbytes), data,
+            self._coll_tags(), out))
+        req.result = out
+        self._split_req = req
+
+    def Write_ordered_end(self) -> int:
+        return self._split_end()
+
+    def Read_ordered_begin(self, buf, count: int = None,
+                           datatype: dt_mod.Datatype = None) -> None:
+        from ompi_tpu.coll import libnbc
+        from ompi_tpu.io import fcoll
+
+        self._split_check()
+        conv, nbytes = _conv(buf, count, datatype)
+        start = self._ordered_setup(nbytes)
+        out: dict = {}
+        req = libnbc.NbcRequest(fcoll.sched_read(
+            self, self.view.map(start, nbytes), conv,
+            self._coll_tags(), out))
+        req.result = out
+        self._split_req = req
+
+    def Read_ordered_end(self) -> int:
+        return self._split_end()
 
     # -- collective I/O (fcoll equivalent) --------------------------------
     def Write_at_all(self, offset: int, buf, count: int = None,

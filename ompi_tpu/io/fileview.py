@@ -42,6 +42,19 @@ class FileView:
         return (len(self._offs) == 1 and self._offs[0] == 0
                 and self._lens[0] == self.tile_extent)
 
+    def visible_size(self, file_size: int) -> int:
+        """Inverse of :meth:`map` for SEEK_END: how many VISIBLE bytes
+        lie below absolute file offset ``file_size`` (both file
+        pointers live in visible space; the physical size does not)."""
+        rel = file_size - self.disp
+        if rel <= 0:
+            return 0
+        tiles = rel // self.tile_extent
+        within = rel - tiles * self.tile_extent
+        part = int(np.minimum(np.maximum(within - self._offs, 0),
+                              self._lens).sum())
+        return int(tiles * self.bytes_per_tile + part)
+
     def map(self, pos: int, nbytes: int) -> List[Tuple[int, int]]:
         """Visible range [pos, pos+nbytes) -> merged absolute
         (file_offset, length) extents."""

@@ -247,3 +247,98 @@ def test_iwrite_all_individual_pointer():
         try: os.unlink(path)
         except OSError: pass
     """, 2)
+
+
+def test_write_ordered_rank_order(tmp_path):
+    """Ordered shared-fp collective: different-sized blocks land in
+    RANK order regardless of arrival order (file_write_ordered.c
+    semantics), and the shared pointer advances past the total."""
+    path = str(tmp_path / "ordered.mpiio")
+    run_ranks(f"""
+        import time
+        from ompi_tpu import io as io_mod
+        f = io_mod.File_open(comm, {path!r},
+                             io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+        n = 4 + 3 * rank  # different size per rank
+        rec = np.full(n, rank + 1, dtype=np.int32)
+        if rank == 0:
+            time.sleep(0.2)  # rank order must not depend on arrival
+        f.Write_ordered(rec)
+        # a second ordered round continues after the first total
+        f.Write_ordered(np.full(2, 10 + rank, dtype=np.int32))
+        comm.Barrier()
+        if rank == 0:
+            sizes = [4 + 3 * r for r in range(size)]
+            out = np.zeros(sum(sizes) + 2 * size, dtype=np.int32)
+            f.Read_at(0, out)
+            pos = 0
+            for r in range(size):
+                assert (out[pos:pos + sizes[r]] == r + 1).all(), \
+                    (r, out)
+                pos += sizes[r]
+            for r in range(size):
+                assert (out[pos:pos + 2] == 10 + r).all(), (r, out)
+                pos += 2
+        f.Close()
+    """, 3, timeout=120)
+
+
+def test_read_ordered_and_split_forms(tmp_path):
+    """Read_ordered slices rank-ordered ranges; begin/end overlaps
+    compute and enforces the one-active-split rule."""
+    path = str(tmp_path / "ordered_r.mpiio")
+    run_ranks(f"""
+        from ompi_tpu import io as io_mod
+        f = io_mod.File_open(comm, {path!r},
+                             io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+        sizes = [2 + r for r in range(size)]
+        # rank-ordered payload written via the ordered collective
+        f.Write_ordered_begin(
+            np.full(sizes[rank], rank + 1, dtype=np.int32))
+        acc = float(np.arange(500).sum())  # overlapped compute
+        n = f.Write_ordered_end()
+        assert acc == 124750.0 and n == sizes[rank] * 4
+        # default byte view: position is in bytes
+        assert f.Get_position_shared() == sum(sizes) * 4
+        f.Seek_shared(0)  # collective rewind (file_seek_shared.c)
+        got = np.zeros(sizes[rank], dtype=np.int32)
+        f.Read_ordered_begin(got)
+        try:
+            f.Read_ordered_begin(got)  # second active split: error
+            raise SystemExit("double begin allowed")
+        except Exception as e:
+            assert "split collective" in str(e), e
+        f.Read_ordered_end()
+        assert (got == rank + 1).all(), got
+        f.Close()
+    """, 3, timeout=120)
+
+
+def test_seek_end_visible_space_and_bad_shared_seek(tmp_path):
+    """SEEK_END resolves in VISIBLE byte space under a view with
+    disp/holes (both pointers live there), and an invalid shared seek
+    raises on EVERY rank instead of stranding peers in the barrier."""
+    path = str(tmp_path / "seekend.mpiio")
+    run_ranks(f"""
+        from ompi_tpu import io as io_mod
+        from ompi_tpu.datatype import datatype as dt
+        f = io_mod.File_open(comm, {path!r},
+                             io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+        if rank == 0:
+            f.Write_at(0, np.arange(26, dtype=np.int32))  # 104 bytes
+        comm.Barrier()
+        # view: disp 8, every other int32 visible (vector holes)
+        ft = dt.vector(6, 1, 2, dt.INT32)
+        f.Set_view(disp=8, etype=dt.INT32, filetype=ft)
+        f.Seek(0, io_mod.SEEK_END)
+        # visible bytes below 104: disp 8 -> rel 96; tile extent 44
+        # (vector ub), 24B visible per tile -> 2 tiles + 4B = 52B
+        assert f.Get_position() == 13, f.Get_position()
+        try:
+            f.Seek_shared(-999, io_mod.SEEK_SET)
+            raise SystemExit("bad shared seek accepted")
+        except Exception as e:
+            assert "seek before start" in str(e), e
+        comm.Barrier()  # every rank got here: nobody stranded
+        f.Close()
+    """, 3, timeout=120)
