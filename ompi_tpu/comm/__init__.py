@@ -252,6 +252,13 @@ class Communicator(AttrHost):
 
         return _shrink(self)
 
+    def iagree(self, flag: int):
+        """MPIX_Comm_iagree -> request; after wait, .result is
+        blocking agree's (value, failed) tuple."""
+        from ompi_tpu.ft import iagree as _iagree
+
+        return _iagree(self, flag)
+
     def agree(self, flag: int):
         """MPIX_Comm_agree -> (flag AND-combined over survivors,
         failed comm ranks)."""

@@ -70,6 +70,7 @@ def _ftmpi() -> None:
     register("MPIX_Comm_revoke", ft.revoke)
     register("MPIX_Comm_shrink", ft.shrink)
     register("MPIX_Comm_agree", ft.agree)
+    register("MPIX_Comm_iagree", ft.iagree)
     register("MPIX_Comm_get_failed", ft.get_failed)
     register("MPIX_Comm_ack_failed", ft.ack_failed)
 

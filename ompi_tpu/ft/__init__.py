@@ -19,7 +19,8 @@ reference has with its PMIx server.
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+import threading
+from typing import Dict, List, Optional, Tuple
 
 from ompi_tpu import errors
 from ompi_tpu.runtime import rte
@@ -106,18 +107,136 @@ def agree(comm, flag: int) -> Tuple[int, List[int]]:
     comm ranks at decision time). Every caller gets the SAME answer —
     the store freezes one result per (comm, epoch) (see kvstore
     ftgather). Works on revoked communicators, per ULFM."""
+    contribs, dead = rte.client().ftgather(
+        _agree_tag(comm), rte.rank, int(flag), comm.group.ranks,
+        hb_timeout=_hb_timeout())
+    return _decide(contribs, dead, comm.group.ranks)
+
+
+def _agree_tag(comm) -> str:
+    """Next agreement tag for this comm — blocking and nonblocking
+    agree share ONE epoch sequence (ULFM: all members call agreement
+    ops in the same order, so a mixed iagree/agree program still
+    pairs epochs correctly across ranks)."""
     epoch = _agree_epochs.get(comm.cid, 0)
     _agree_epochs[comm.cid] = epoch + 1
-    tag = f"ftagree:{rte.jobid}:{comm.cid}:{epoch}"
-    contribs, dead = rte.client().ftgather(
-        tag, rte.rank, int(flag), comm.group.ranks,
-        hb_timeout=_hb_timeout())
+    return f"ftagree:{rte.jobid}:{comm.cid}:{epoch}"
+
+
+def _decide(contribs: Dict[int, int], dead: Dict[int, str],
+            group_ranks) -> Tuple[int, List[int]]:
     result = ~0
     for v in contribs.values():
         result &= v
-    failed = sorted(i for i, world in enumerate(comm.group.ranks)
+    failed = sorted(i for i, world in enumerate(group_ranks)
                     if world in dead)
     return result, failed
+
+
+# -- nonblocking agreement (MPIX_Comm_iagree) -----------------------------
+# Reference: ompi/mpiext/ftmpi/c/mpiext_ftmpi_c.h:34 (iagree); ERA in
+# coll/ftagree is event-driven on the progress engine. Here the store
+# rendezvous is inherently blocking RPC, so the nonblocking form runs
+# it on a helper thread over its OWN store connection — the main
+# client's socket must stay free (a parked RPC there would stall
+# unrelated puts/incs), and sharing one dedicated socket would
+# serialize concurrent agreements on different comms into a
+# cross-communicator deadlock. The request completes via the progress
+# engine, composing with wait_all/test.
+
+_active_agrees: List["AgreeRequest"] = []
+_agree_lock = threading.Lock()
+_agree_progress_registered = False
+
+
+def _agree_progress() -> int:
+    events = 0
+    for req in list(_active_agrees):
+        events += req._harvest()
+    return events
+
+
+from ompi_tpu.pml import request as _rq  # noqa: E402  (request base)
+
+
+class AgreeRequest(_rq.Request):
+    """The request MPIX_Comm_iagree returns; after wait/test success,
+    ``.result`` is (decided flag, failed comm ranks) — identical to
+    blocking agree's return. A store failure mid-agreement re-raises
+    at wait() or at ``.result`` access."""
+
+    def __init__(self, comm, flag: int) -> None:
+        super().__init__()
+        self.comm = comm
+        self._result: Optional[Tuple[int, List[int]]] = None
+        self._exc: Optional[BaseException] = None
+        self._outcome = None
+        self._tag = _agree_tag(comm)
+        self._thread = threading.Thread(
+            target=self._run, args=(int(flag),), daemon=True,
+            name=f"iagree-{self._tag}")
+        global _agree_progress_registered
+        with _agree_lock:
+            if not _agree_progress_registered:
+                from ompi_tpu.core import progress
+
+                progress.register(_agree_progress)
+                _agree_progress_registered = True
+            _active_agrees.append(self)
+        self._thread.start()
+
+    def _run(self, flag: int) -> None:
+        from ompi_tpu.runtime import kvstore
+
+        try:
+            client = kvstore.Client(rte.client().addr)
+            try:
+                contribs, dead = client.ftgather(
+                    self._tag, rte.rank, flag, self.comm.group.ranks,
+                    hb_timeout=_hb_timeout())
+            finally:
+                client.close()
+            self._outcome = ("ok", _decide(contribs, dead,
+                                           self.comm.group.ranks))
+        except Exception as exc:  # store down == job down; surface it
+            self._outcome = ("err", exc)
+
+    def _harvest(self) -> int:
+        with _agree_lock:
+            if self._outcome is None or self.completed:
+                return 0
+            _active_agrees.remove(self)
+            kind, payload = self._outcome
+            if kind == "ok":
+                self._result = payload
+                self.complete()
+            else:
+                self._exc = payload  # published BEFORE completion
+                self.complete(error=errors.ERR_INTERN)
+        return 1
+
+    @property
+    def result(self) -> Tuple[int, List[int]]:
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def wait(self, timeout: Optional[float] = None):
+        from ompi_tpu.core import progress
+
+        progress.wait_until(lambda: self.completed, timeout=timeout)
+        if not self.completed:
+            raise TimeoutError(f"iagree {self._tag} did not complete")
+        if self._exc is not None:
+            raise self._exc
+        return super().wait(timeout)
+
+
+def iagree(comm, flag: int) -> AgreeRequest:
+    """MPIX_Comm_iagree: nonblocking agreement; overlap p2p/compute,
+    then wait/test (or mpi.wait_all with other requests). The decided
+    value under failures equals blocking agree's."""
+    return AgreeRequest(comm, flag)
 
 
 def shrink(comm):

@@ -127,3 +127,72 @@ def test_wildcard_recv_fails_pending():
             comm.Recv(buf, source=mpi.ANY_SOURCE, tag=9)
             assert buf[0] == 7
     """, 3, mca=FT, timeout=90)
+
+
+def test_iagree_overlaps_p2p_and_matches_blocking():
+    """MPIX_Comm_iagree (nonblocking ERA analog): overlap p2p traffic
+    with a pending agreement; iagree composes with wait and decides
+    exactly what blocking agree would."""
+    run_ranks("""
+        flag = 0b110 if rank == 0 else 0b011
+        req = comm.iagree(flag)
+        # p2p traffic while the agreement is parked
+        peer = 1 - rank
+        for k in range(3):
+            comm.send(("ping", k, rank), dest=peer, tag=40 + k)
+            assert comm.recv(source=peer, tag=40 + k) == \
+                ("ping", k, peer)
+        req.wait(timeout=60)
+        value, failed = req.result
+        assert value == 0b010, bin(value)
+        assert failed == []
+        # a second round: blocking agree continues the SAME epoch
+        # sequence, so mixed programs stay paired across ranks
+        v2, _ = comm.agree(0b111)
+        assert v2 == 0b111
+    """, 2, mca=FT, timeout=90)
+
+
+def test_iagree_with_sigkill_mid_agreement():
+    """A rank dies AFTER iagree is posted but before contributing:
+    survivors' iagree completes with the same decided value and
+    failed set blocking agree reports."""
+    run_ranks("""
+        import os, signal, time
+        comm.Barrier()
+        if rank == 2:
+            os.kill(os.getpid(), signal.SIGKILL)  # never contributes
+        reqs = [comm.iagree(0b11 if rank == 0 else 0b01)]
+        acc = float(np.arange(2000).sum())  # overlapped compute
+        from ompi_tpu.pml import request as rq
+        # composes with the plural wait forms
+        from ompi_tpu.core import progress
+        progress.wait_until(lambda: all(r.completed for r in reqs),
+                            timeout=60)
+        value, failed = reqs[0].result
+        assert value == 0b01, bin(value)
+        assert failed == [2], failed
+        assert acc == 1999000.0
+        # cross-check survivors decided identically
+        other = 1 - rank
+        comm.send((value, tuple(failed)), dest=other, tag=7)
+        assert comm.recv(source=other, tag=7) == (value, tuple(failed))
+    """, 3, mca=FT, timeout=90)
+
+
+def test_concurrent_iagree_different_comms():
+    """Two outstanding iagrees on DIFFERENT comms in opposite wait
+    order across ranks (legal: nonblocking ordering is only
+    per-communicator). Each runs on its own store connection, so they
+    overlap instead of serializing into a cross-comm deadlock."""
+    run_ranks("""
+        sub = comm.dup()
+        ra = comm.iagree(0b11)
+        rb = sub.iagree(0b10 if rank == 0 else 0b11)
+        if rank == 0:
+            ra.wait(timeout=60); rb.wait(timeout=60)
+        else:
+            rb.wait(timeout=60); ra.wait(timeout=60)
+        assert ra.result == (0b11, []), ra.result
+        assert rb.result == (0b10, []), rb.result
+    """, 2, mca=FT, timeout=90)
