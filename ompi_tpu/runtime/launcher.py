@@ -240,12 +240,11 @@ def parse_appfile(path: str):
 def launch_mpmd(apps, mca: Optional[Dict[str, str]] = None,
                 timeout: Optional[float] = None,
                 bind_to: str = "none") -> int:
-    """MPMD launch: several app contexts share ONE world — app k's
-    ranks follow app k-1's (the MPI_APPNUM ordering). Single-host
-    (multi-host MPMD would need per-host app slicing; use
-    spawn_multiple from a running job for that). SPMD ``launch`` is
-    the one-context special case, so the store/FT/teardown scaffold
-    exists exactly once."""
+    """MPMD launch on this machine: several app contexts share ONE
+    world — app k's ranks follow app k-1's (the MPI_APPNUM ordering).
+    SPMD ``launch`` is the one-context special case, so the
+    store/FT/teardown scaffold exists exactly once. Multi-host MPMD
+    goes through ``launch_hosts(apps=...)``."""
     apps = [(list(argv), int(n)) for argv, n in apps]
     total = sum(n for _, n in apps)
     store = kvstore.Store().start()
@@ -279,6 +278,17 @@ def launch_mpmd(apps, mca: Optional[Dict[str, str]] = None,
         store.stop()
 
 
+def _app_of_rank(apps, r: int):
+    """(appnum, argv) owning global rank r — app k's ranks follow app
+    k-1's (the MPI_APPNUM ordering, ompi/dpm/dpm.c:386)."""
+    rem = r
+    for appnum, (argv, n) in enumerate(apps):
+        if rem < n:
+            return appnum, argv
+        rem -= n
+    raise ValueError(f"rank {r} beyond the app contexts")
+
+
 def _head_addr(agent: str, bind: Optional[str]) -> str:
     """Address the store binds and daemons dial back to. Local agent
     (fake hosts on this machine): loopback. ssh agent: the best
@@ -297,15 +307,31 @@ def launch_hosts(argv: Sequence[str], hosts: Sequence[HostSpec],
                  timeout: Optional[float] = None,
                  agent: str = "local",
                  bind: Optional[str] = None,
-                 bind_to: str = "none") -> int:
+                 bind_to: str = "none",
+                 apps=None) -> int:
     """Multi-host launch: one daemon per host (prted analog), each
     forking its local rank block. Reference: prterun starting prted
     daemons which fork/exec the ranks per node (SURVEY §3.2);
     btl/tcp endpoints then cross hosts via the modex
-    (opal/mca/btl/tcp/btl_tcp_component.c:1191-1240)."""
+    (opal/mca/btl/tcp/btl_tcp_component.c:1191-1240).
+
+    ``apps``: MPMD app contexts [(argv, nprocs), ...] sliced across
+    the host set — global ranks go to apps in MPI_APPNUM order and to
+    hosts by slot order, so one app may span hosts (PRRTE maps app
+    contexts over the node list the same way). With apps, ``argv`` is
+    ignored and the total rank count comes from the contexts."""
+    if apps is not None:
+        apps = [(list(a), int(n)) for a, n in apps]
+        total = sum(n for _, n in apps)
+        capacity = sum(h.slots for h in hosts)
+        if capacity < total:
+            raise ValueError(
+                f"app contexts need {total} slots; hosts provide "
+                f"{capacity}")
+    else:
+        total = sum(h.slots for h in hosts)
     store = kvstore.Store(host=_head_addr(agent, bind)).start()
     jobid = uuid.uuid4().hex[:12]
-    total = sum(h.slots for h in hosts)
     if agent == "local":  # fake hosts: every rank runs on THIS
         # machine, so job-wide oversubscription is knowable here.
         # ssh agent: remote core counts are not, and the setting
@@ -318,10 +344,15 @@ def launch_hosts(argv: Sequence[str], hosts: Sequence[HostSpec],
     try:
         base = 0
         for h in hosts:
+            local_n = (h.slots if apps is None
+                       else min(h.slots, total - base))
+            if local_n <= 0:
+                continue  # app ranks exhausted: surplus hosts idle
             cmd = [sys.executable, "-m", "ompi_tpu.runtime.launcher",
                    "--daemon", "--store", store_addr, "--jobid", jobid,
                    "--host-name", h.name, "--rank-base", str(base),
-                   "--local-n", str(h.slots), "--world-size", str(total)]
+                   "--local-n", str(local_n),
+                   "--world-size", str(total)]
             if h.addr:
                 cmd += ["--bind-addr", h.addr]
             if bind_to != "none":
@@ -330,7 +361,12 @@ def launch_hosts(argv: Sequence[str], hosts: Sequence[HostSpec],
                 cmd += ["--timeout", str(timeout)]
             for k, v in (mca or {}).items():
                 cmd += ["--mca", k, v]
-            cmd += ["--"] + list(argv)
+            if apps is not None:
+                import json
+
+                cmd += ["--apps-json", json.dumps(apps)]
+            else:
+                cmd += ["--"] + list(argv)
             if agent == "ssh":
                 import shlex
 
@@ -343,7 +379,7 @@ def launch_hosts(argv: Sequence[str], hosts: Sequence[HostSpec],
                 daemons.append(subprocess.Popen(full))
             else:
                 daemons.append(subprocess.Popen(cmd))
-            base += h.slots
+            base += local_n
         # daemons supervise their ranks; the head aggregates daemons.
         # +30s grace over the per-daemon timeout so daemons time out
         # first and report 124 themselves.
@@ -376,6 +412,11 @@ def run_daemon(ns) -> int:
     mca = {k: v for k, v in ns.mca}
     ft = mca.get("ft", "0") not in ("0", "false", "")
     client = kvstore.Client(store_addr) if ft else None
+    apps = None
+    if ns.apps_json:
+        import json
+
+        apps = [(list(a), int(n)) for a, n in json.loads(ns.apps_json)]
     argv = list(ns.command)
     if argv and argv[0] == "--":
         argv = argv[1:]
@@ -394,7 +435,19 @@ def run_daemon(ns) -> int:
                             bind_addr=ns.bind_addr,
                             bind_cpus=_cpuset_for(i, ns.bind_to,
                                                   topo))
-            procs.append(subprocess.Popen(argv, env=env))
+            rank_argv = argv
+            # build_env copies os.environ: a stale APPNUM from a
+            # nested launch must never leak into the children
+            env.pop("OMPI_TPU_APPNUM", None)
+            if apps is not None:  # MPMD: this host's block may span
+                # app contexts — each rank gets ITS app's command
+                appnum, rank_argv = _app_of_rank(apps,
+                                                 ns.rank_base + i)
+                if rank_argv and rank_argv[0].endswith(".py"):
+                    rank_argv = [sys.executable] + rank_argv
+                if len(apps) > 1:
+                    env["OMPI_TPU_APPNUM"] = str(appnum)
+            procs.append(subprocess.Popen(rank_argv, env=env))
         rc, clean = _wait_stats(procs, ns.timeout, store=client,
                                 rank_base=ns.rank_base,
                                 all_killed_fails=False)
@@ -548,6 +601,7 @@ def main(args: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--world-size", type=int, default=1,
                     help=argparse.SUPPRESS)
     ap.add_argument("--bind-addr", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--apps-json", default=None, help=argparse.SUPPRESS)
     ap.add_argument("command", nargs=argparse.REMAINDER)
     ns = ap.parse_args(args)
 
@@ -558,14 +612,19 @@ def main(args: Optional[Sequence[str]] = None) -> int:
     cmd_tokens = list(ns.command)
     if cmd_tokens and cmd_tokens[0] == "--":
         cmd_tokens = cmd_tokens[1:]
+    hosts = None
+    if ns.host or ns.hostfile:
+        hosts = (parse_hostfile(ns.hostfile) if ns.hostfile
+                 else parse_host_list(ns.host))
     if ns.app or ":" in cmd_tokens:
-        if ns.host or ns.hostfile:
-            ap.error("MPMD app contexts are single-host (use "
-                     "spawn_multiple from a running job for "
-                     "multi-host MPMD)")
         apps = (parse_appfile(ns.app) if ns.app
                 else parse_app_contexts(cmd_tokens,
                                         first_n=ns.nprocs))
+        if hosts is not None:
+            # multi-host MPMD: app contexts slice across the host set
+            return launch_hosts(None, hosts, mca, ns.timeout,
+                                agent=ns.launch_agent, bind=ns.bind,
+                                bind_to=ns.bind_to, apps=apps)
         return launch_mpmd(apps, mca, ns.timeout, bind_to=ns.bind_to)
     if ns.func:
         if ":" not in ns.func:
@@ -586,14 +645,12 @@ def main(args: Optional[Sequence[str]] = None) -> int:
         # command: each DAEMON wraps .py with its own local
         # interpreter (the head's sys.executable path may not exist on
         # remote hosts).
-        if ns.host or ns.hostfile:
+        if hosts is not None:
             argv = cmd
         else:
             argv = ([sys.executable] + cmd if cmd[0].endswith(".py")
                     else cmd)
-    if ns.host or ns.hostfile:
-        hosts = (parse_hostfile(ns.hostfile) if ns.hostfile
-                 else parse_host_list(ns.host))
+    if hosts is not None:
         return launch_hosts(argv, hosts, mca, ns.timeout,
                             agent=ns.launch_agent, bind=ns.bind,
                             bind_to=ns.bind_to)

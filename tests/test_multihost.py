@@ -174,3 +174,61 @@ def test_multihost_device_plane_collectives():
         assert ctx.mesh2d is not None, "forced 2-slice hierarchy"
         assert ctx.mesh2d.devices.shape == (2, 2)
     """, TWO_HOSTS, mca={"device_plane": "on", "coll_xla_hier": "2"})
+
+
+def test_multihost_mpmd_app_slicing(tmp_path):
+    """Multi-host MPMD (PRRTE app-context mapping): app 0 (1 rank) on
+    host A, app 1 (3 ranks) spanning A+B — one world, correct
+    MPI_APPNUM everywhere, cross-host cross-app p2p, and the per-host
+    shared split (the han two-level basis) intact."""
+    common = """
+import numpy as np
+from ompi_tpu import mpi, dpm
+comm = mpi.Init()
+assert comm.size == 4, comm.size
+local = comm.split_type("shared")
+assert local.size == 2, (comm.rank, local.size)
+out = np.zeros(4, np.float32)
+comm.Allreduce(np.full(4, comm.rank + 1, np.float32), out)
+assert (out == 10).all(), out
+mpi.Finalize()
+"""
+    a = tmp_path / "app_a.py"
+    a.write_text("""
+import numpy as np
+from ompi_tpu import mpi, dpm
+comm = mpi.Init()
+assert comm.rank == 0 and comm.size == 4
+assert dpm.appnum() == 0, dpm.appnum()
+assert comm.Get_attr(mpi.APPNUM) == 0
+assert mpi.Get_processor_name() == "fakeA"
+comm.send(("from-app0", comm.rank), dest=3, tag=9)
+assert comm.recv(source=3, tag=10) == ("from-app1", 3)
+""" + common.split("comm = mpi.Init()", 1)[1])
+    b = tmp_path / "app_b.py"
+    b.write_text("""
+import numpy as np
+from ompi_tpu import mpi, dpm
+comm = mpi.Init()
+assert comm.rank in (1, 2, 3) and comm.size == 4
+assert dpm.appnum() == 1, dpm.appnum()
+host = mpi.Get_processor_name()
+assert host == ("fakeA" if comm.rank == 1 else "fakeB"), \
+    (comm.rank, host)
+if comm.rank == 3:
+    assert comm.recv(source=0, tag=9) == ("from-app0", 0)
+    comm.send(("from-app1", comm.rank), dest=0, tag=10)
+""" + common.split("comm = mpi.Init()", 1)[1])
+    rc = launcher.launch_hosts(
+        None, TWO_HOSTS, mca=None, timeout=120, agent="local",
+        apps=[([str(a)], 1), ([str(b)], 3)])
+    assert rc == 0, rc
+
+
+def test_multihost_mpmd_capacity_error():
+    import pytest
+
+    with pytest.raises(ValueError, match="slots"):
+        launcher.launch_hosts(
+            None, TWO_HOSTS, agent="local",
+            apps=[(["x.py"], 3), (["y.py"], 2)])  # 5 ranks, 4 slots
