@@ -259,8 +259,7 @@ def launch_mpmd(apps, mca: Optional[Dict[str, str]] = None,
     try:
         r = 0
         for appnum, (argv, n) in enumerate(apps):
-            if argv[0].endswith(".py"):
-                argv = [sys.executable] + argv
+            argv = _wrap_py(argv)
             for _ in range(n):
                 env = build_env(r, total, store.addr, jobid, mca,
                                 bind_cpus=_cpuset_for(r, bind_to,
@@ -276,6 +275,15 @@ def launch_mpmd(apps, mca: Optional[Dict[str, str]] = None,
         reap(procs)
         cleanup_shm(jobid)
         store.stop()
+
+
+def _wrap_py(argv: List[str]) -> List[str]:
+    """Run *.py commands under THIS interpreter (mpirun ergonomics);
+    anything else execs as-is. One policy for SPMD, MPMD and daemon
+    paths."""
+    if argv and argv[0].endswith(".py"):
+        return [sys.executable] + list(argv)
+    return list(argv)
 
 
 def _app_of_rank(apps, r: int):
@@ -302,7 +310,8 @@ def _head_addr(agent: str, bind: Optional[str]) -> str:
     return net.best_address()
 
 
-def launch_hosts(argv: Sequence[str], hosts: Sequence[HostSpec],
+def launch_hosts(argv: Optional[Sequence[str]],
+                 hosts: Sequence[HostSpec],
                  mca: Optional[Dict[str, str]] = None,
                  timeout: Optional[float] = None,
                  agent: str = "local",
@@ -330,6 +339,11 @@ def launch_hosts(argv: Sequence[str], hosts: Sequence[HostSpec],
                 f"{capacity}")
     else:
         total = sum(h.slots for h in hosts)
+    apps_json = None
+    if apps is not None:
+        import json
+
+        apps_json = json.dumps(apps)
     store = kvstore.Store(host=_head_addr(agent, bind)).start()
     jobid = uuid.uuid4().hex[:12]
     if agent == "local":  # fake hosts: every rank runs on THIS
@@ -361,10 +375,8 @@ def launch_hosts(argv: Sequence[str], hosts: Sequence[HostSpec],
                 cmd += ["--timeout", str(timeout)]
             for k, v in (mca or {}).items():
                 cmd += ["--mca", k, v]
-            if apps is not None:
-                import json
-
-                cmd += ["--apps-json", json.dumps(apps)]
+            if apps_json is not None:
+                cmd += ["--apps-json", apps_json]
             else:
                 cmd += ["--"] + list(argv)
             if agent == "ssh":
@@ -420,10 +432,9 @@ def run_daemon(ns) -> int:
     argv = list(ns.command)
     if argv and argv[0] == "--":
         argv = argv[1:]
-    if argv and argv[0].endswith(".py"):
-        # wrapped HERE with the daemon's own interpreter, never the
-        # head's (whose sys.executable may not exist on this host)
-        argv = [sys.executable] + argv
+    # wrapped HERE with the daemon's own interpreter, never the
+    # head's (whose sys.executable may not exist on this host)
+    argv = _wrap_py(argv)
     topo = _topo_for(ns.bind_to)
     procs: List[subprocess.Popen] = []
     try:
@@ -443,8 +454,7 @@ def run_daemon(ns) -> int:
                 # app contexts — each rank gets ITS app's command
                 appnum, rank_argv = _app_of_rank(apps,
                                                  ns.rank_base + i)
-                if rank_argv and rank_argv[0].endswith(".py"):
-                    rank_argv = [sys.executable] + rank_argv
+                rank_argv = _wrap_py(rank_argv)
                 if len(apps) > 1:
                     env["OMPI_TPU_APPNUM"] = str(appnum)
             procs.append(subprocess.Popen(rank_argv, env=env))
@@ -646,10 +656,9 @@ def main(args: Optional[Sequence[str]] = None) -> int:
         # interpreter (the head's sys.executable path may not exist on
         # remote hosts).
         if hosts is not None:
-            argv = cmd
+            argv = cmd  # daemons wrap .py with their own interpreter
         else:
-            argv = ([sys.executable] + cmd if cmd[0].endswith(".py")
-                    else cmd)
+            argv = _wrap_py(cmd)
     if hosts is not None:
         return launch_hosts(argv, hosts, mca, ns.timeout,
                             agent=ns.launch_agent, bind=ns.bind,

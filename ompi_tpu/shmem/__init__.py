@@ -381,15 +381,77 @@ class Team:
         quiet()
         self._comm.Barrier()
 
+    # -- team collectives (OpenSHMEM 1.5 team-based API) -----------------
+    # Reference: scoll serves any active set/team
+    # (oshmem/mca/scoll/scoll.h:158-159) and the reductions are
+    # team-based in the API (oshmem/shmem/c/shmem_reduce.c:384-396,
+    # shmem_*_reduce(shmem_team_t team, ...)). Every world collective
+    # below delegates here with TEAM_WORLD.
     def broadcast(self, dest: SymArray, source: SymArray,
                   root: int) -> None:
         if self._comm.rank == root:
             dest.local[...] = source.local
         self._comm.Bcast(dest.local, root=root)
 
-    def sum_to_all(self, dest: SymArray, source: SymArray) -> None:
+    def fcollect(self, dest: SymArray, source: SymArray) -> None:
+        """shmem_fcollect: equal-size blocks concatenated in team PE
+        order."""
+        self._comm.Allgather(np.array(source.local, copy=True),
+                             dest.local)
+
+    def collect(self, dest: SymArray, source: SymArray,
+                nelems: int) -> None:
+        """shmem_collect: variable-size contributions in team PE
+        order (Allgatherv over the delegated comm)."""
+        cbuf = np.zeros(self._comm.size, np.int64)
+        self._comm.Allgather(np.asarray([nelems], np.int64), cbuf)
+        self._comm.Allgatherv(
+            np.array(source.local.reshape(-1)[:nelems], copy=True),
+            dest.local.reshape(-1), [int(c) for c in cbuf])
+
+    def alltoall(self, dest: SymArray, source: SymArray) -> None:
+        """shmem_alltoall: team PE i's block j lands in PE j's block
+        i (equal block sizes)."""
+        n = self._comm.size
+        flat = source.local.reshape(-1)
+        if flat.size % n:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                f"alltoall: {flat.size} elements not divisible by "
+                f"{n} PEs")
+        self._comm.Alltoall(np.array(flat, copy=True),
+                            dest.local.reshape(-1))
+
+    def reduce(self, dest: SymArray, source: SymArray, op) -> None:
+        """shmem_*_reduce core (shmem_reduce.c:384-396 — reductions
+        are team-scoped in the OpenSHMEM 1.5 API)."""
         self._comm.Allreduce(np.array(source.local, copy=True),
-                             dest.local, op=op_mod.SUM)
+                             dest.local, op=op)
+
+    def sum_reduce(self, dest: SymArray, source: SymArray) -> None:
+        self.reduce(dest, source, op_mod.SUM)
+
+    def prod_reduce(self, dest: SymArray, source: SymArray) -> None:
+        self.reduce(dest, source, op_mod.PROD)
+
+    def min_reduce(self, dest: SymArray, source: SymArray) -> None:
+        self.reduce(dest, source, op_mod.MIN)
+
+    def max_reduce(self, dest: SymArray, source: SymArray) -> None:
+        self.reduce(dest, source, op_mod.MAX)
+
+    def and_reduce(self, dest: SymArray, source: SymArray) -> None:
+        self.reduce(dest, source, op_mod.BAND)
+
+    def or_reduce(self, dest: SymArray, source: SymArray) -> None:
+        self.reduce(dest, source, op_mod.BOR)
+
+    def xor_reduce(self, dest: SymArray, source: SymArray) -> None:
+        self.reduce(dest, source, op_mod.BXOR)
+
+    # pre-1.5 naming kept for symmetry with the world forms
+    def sum_to_all(self, dest: SymArray, source: SymArray) -> None:
+        self.reduce(dest, source, op_mod.SUM)
 
     def destroy(self) -> None:
         self._comm.free()
@@ -413,6 +475,21 @@ def team_split_strided(parent: Team, start: int, stride: int,
         color if color is not None else UNDEFINED,
         key=members.index(me) if me in members else 0)
     return Team(sub) if sub is not None else None
+
+
+def team_split_2d(parent: Team, xrange: int):
+    """shmem_team_split_2d: factor the parent into a 2-D grid, PE p
+    at (x, y) = (p % xrange, p // xrange); returns (x_team, y_team) —
+    the calling PE's row (shared y) and column (shared x) teams.
+    Reference: oshmem/shmem/c/shmem_team_split_2d role."""
+    if xrange < 1:
+        raise errors.MPIError(errors.ERR_ARG,
+                              f"team_split_2d: xrange {xrange} < 1")
+    me = parent._comm.rank
+    x, y = me % xrange, me // xrange
+    xteam = parent._comm.split(y, key=x)
+    yteam = parent._comm.split(x, key=y)
+    return Team(xteam), Team(yteam)
 
 
 # -- shmem_ptr (direct same-host load/store access) ------------------------
@@ -671,16 +748,12 @@ def barrier_all() -> None:
 
 def broadcast(dest: SymArray, source: SymArray, root: int) -> None:
     """shmem_broadcast across all PEs (scoll/mpi -> coll bcast)."""
-    st = _require()
-    if st.comm.rank == root:
-        dest.local[...] = source.local
-    st.comm.Bcast(dest.local, root=root)
+    team_world().broadcast(dest, source, root)
 
 
 def fcollect(dest: SymArray, source: SymArray) -> None:
     """shmem_fcollect: concatenate equal-size blocks from every PE."""
-    st = _require()
-    st.comm.Allgather(source.local, dest.local)
+    team_world().fcollect(dest, source)
 
 
 def sum_to_all(dest: SymArray, source: SymArray) -> None:
@@ -714,30 +787,14 @@ def xor_to_all(dest: SymArray, source: SymArray) -> None:
 def alltoall(dest: SymArray, source: SymArray) -> None:
     """shmem_alltoall: PE i's block j lands in PE j's block i (equal
     block sizes; scoll/mpi -> coll alltoall)."""
-    st = _require()
-    n = st.comm.size
-    flat = source.local.reshape(-1)
-    if flat.size % n:
-        raise errors.MPIError(
-            errors.ERR_ARG,
-            f"alltoall: {flat.size} elements not divisible by {n} PEs")
-    st.comm.Alltoall(np.array(flat, copy=True),
-                     dest.local.reshape(-1))
+    team_world().alltoall(dest, source)
 
 
 def collect(dest: SymArray, source: SymArray, nelems: int) -> None:
     """shmem_collect: concatenate variable-size contributions in PE
     order (Allgatherv over the delegated comm)."""
-    st = _require()
-    cbuf = np.zeros(st.comm.size, np.int64)
-    st.comm.Allgather(np.asarray([nelems], np.int64), cbuf)
-    st.comm.Allgatherv(np.array(source.local.reshape(-1)[:nelems],
-                                copy=True),
-                       dest.local.reshape(-1),
-                       [int(c) for c in cbuf])
+    team_world().collect(dest, source, nelems)
 
 
 def _to_all(dest: SymArray, source: SymArray, op) -> None:
-    st = _require()
-    st.comm.Allreduce(np.array(source.local, copy=True), dest.local,
-                      op=op)
+    team_world().reduce(dest, source, op)

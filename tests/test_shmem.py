@@ -348,3 +348,79 @@ def test_shmem_test_family():
         shmem.barrier_all()
     shmem.finalize()
     """, 2, isolate=True)
+
+
+def test_team_scoped_collective_breadth():
+    """Every world collective has a team form (r4 VERDICT missing
+    #6): collect/fcollect/alltoall/broadcast and the full reduction
+    op family on a proper sub-team, matching manual expectations."""
+    run_ranks("""
+    from ompi_tpu import shmem
+    shmem.init()
+    me, n = shmem.my_pe(), shmem.n_pes()
+    world = shmem.team_world()
+    # sub-team of the first 3 PEs
+    sub = shmem.team_split_strided(world, 0, 1, 3)
+    if me < 3:
+        t = sub.my_pe()
+        # fcollect: equal blocks in team order
+        s = shmem.zeros(2, np.int64); s.local[:] = t + 1
+        d = shmem.zeros(6, np.int64)
+        sub.sync()
+        sub.fcollect(d, s)
+        assert (d.local == [1, 1, 2, 2, 3, 3]).all(), d.local
+        # collect: variable contributions (t+1 elems each)
+        vs = shmem.zeros(3, np.int64); vs.local[:] = 10 * (t + 1)
+        vd = shmem.zeros(6, np.int64)
+        sub.collect(vd, vs, t + 1)
+        assert (vd.local == [10, 20, 20, 30, 30, 30]).all(), vd.local
+        # alltoall: 1 elem per peer
+        a = shmem.zeros(3, np.int64)
+        a.local[:] = [100 * t + j for j in range(3)]
+        ad = shmem.zeros(3, np.int64)
+        sub.alltoall(ad, a)
+        assert (ad.local == [t, 100 + t, 200 + t]).all(), ad.local
+        # broadcast from team root 1
+        b = shmem.zeros(2, np.int64)
+        if t == 1: b.local[:] = 77
+        sub.broadcast(b, b, 1)
+        assert (b.local == 77).all(), b.local
+        # the reduction op family
+        r = shmem.zeros(1, np.int64); r.local[:] = t + 2
+        out = shmem.zeros(1, np.int64)
+        sub.sum_reduce(out, r);  assert out.local[0] == 2 + 3 + 4
+        sub.prod_reduce(out, r); assert out.local[0] == 2 * 3 * 4
+        sub.min_reduce(out, r);  assert out.local[0] == 2
+        sub.max_reduce(out, r);  assert out.local[0] == 4
+        sub.and_reduce(out, r);  assert out.local[0] == (2 & 3 & 4)
+        sub.or_reduce(out, r);   assert out.local[0] == (2 | 3 | 4)
+        sub.xor_reduce(out, r);  assert out.local[0] == (2 ^ 3 ^ 4)
+        sub.destroy()
+    shmem.barrier_all()
+    shmem.finalize()
+    """, 4)
+
+
+def test_team_split_2d_row_col():
+    """shmem_team_split_2d: a 2x2 grid's row/col teams reduce along
+    the expected axes."""
+    run_ranks("""
+    from ompi_tpu import shmem
+    shmem.init()
+    me, n = shmem.my_pe(), shmem.n_pes()
+    world = shmem.team_world()
+    xt, yt = shmem.team_split_2d(world, 2)   # x = me % 2, y = me // 2
+    assert xt.n_pes() == 2 and yt.n_pes() == 2
+    assert xt.my_pe() == me % 2 and yt.my_pe() == me // 2
+    s = shmem.zeros(1, np.int64); s.local[:] = me + 1
+    row = shmem.zeros(1, np.int64)
+    col = shmem.zeros(1, np.int64)
+    xt.sync(); xt.sum_reduce(row, s)
+    yt.sync(); yt.sum_reduce(col, s)
+    y, x = me // 2, me % 2
+    assert row.local[0] == (2 * y + 1) + (2 * y + 2), row.local
+    assert col.local[0] == (x + 1) + (x + 3), col.local
+    xt.destroy(); yt.destroy()
+    shmem.barrier_all()
+    shmem.finalize()
+    """, 4)
