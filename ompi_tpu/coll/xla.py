@@ -84,6 +84,20 @@ _a2av_pad_var = cvar.register(
          "path and is never second-guessed). 0 disables the bound.",
     level=6)
 
+_a2av_cache_var = cvar.register(
+    "coll_xla_a2av_meta_cache", 0, int,
+    help="Cache the alltoallv pad-metadata host round per comm while "
+         "the caller's (scounts, rcounts) signature is unchanged — "
+         "an iterative MoE loop then pays ONE host round total. "
+         "OPT-IN [default 0]: enabling it is a PROMISE that count "
+         "changes touch every rank's local signature (e.g. global "
+         "capacity rebalancing); a change confined to a rank pair "
+         "while other ranks' local counts stay identical makes "
+         "cache-hit ranks skip the metadata collective that "
+         "cache-miss ranks enter — a hang. Counts that never change "
+         "should pass max_count= instead (host-free, always safe).",
+    level=6)
+
 _hier_var = cvar.register(
     "coll_xla_hier", "auto", str,
     help="hierarchical ICI x DCN execution for comms spanning slices "
@@ -319,6 +333,59 @@ def _gather_rooted(ctx, comm, x, root: int):
     return jnp.stack(parts)
 
 
+def _reduce_binomial(ctx, comm, x, opn, root: int):
+    """Binomial ppermute reduction tree for commutative non-SUM ops
+    above the rooted threshold (coll_base_reduce.c binomial, on
+    device): ceil(log2 n) rounds of disjoint (src -> dst) single-pair
+    ppermutes + a masked elementwise combine. Every rank sends its
+    partial exactly once and every round's output stays x-sized —
+    non-roots do O(bytes) traffic and never materialize the n-fold
+    allreduce result (reduce_scatter has no native lowering for these
+    ops, so the SUM path's psum_scatter program is unavailable)."""
+    global _last_rooted_plan
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ompi_tpu.parallel.collectives import _JNP_FN
+
+    n, me = ctx.n, comm.rank
+    combine = _JNP_FN[opn.name]
+    rounds = []
+    mask = 1
+    while mask < n:
+        pairs = []
+        for v in range(n):  # vrank space: v = (rank - root) mod n
+            if v % (2 * mask) == mask:  # sender this round
+                pairs.append((((v + root) % n),
+                              ((v - mask + root) % n)))
+        if pairs:
+            rounds.append(tuple(pairs))
+        mask <<= 1
+    _last_rooted_plan = {"kind": "reduce_binomial",
+                         "rounds": len(rounds),
+                         "round_out_elems": int(x.size)}
+    acc = x
+    for rnd, pairs in enumerate(rounds):
+        dsts = tuple(sorted({d for _, d in pairs}))
+
+        def build(pairs=pairs, dsts=dsts):
+            def body(a):
+                cur = a[0]
+                got = lax.ppermute(cur, AXIS, perm=list(pairs))
+                idx = lax.axis_index(AXIS)
+                recv = jnp.zeros((), bool)
+                for d in dsts:
+                    recv = recv | (idx == d)
+                return jnp.where(recv, combine(cur, got), cur)
+
+            return ctx.smap(body, out_varying=True)
+
+        fn = ctx.compiled(_key(x, "reduce_binom", opn.name, rnd,
+                               root, n), build)
+        acc = ctx.my_shard(fn(ctx.to_global(acc)))
+    return acc if me == root else None
+
+
 def reduce_dev(comm, sendbuf, op=op_mod.SUM, root: int = 0,
                deterministic: Optional[str] = None):
     if not _op_ok(op):
@@ -329,14 +396,21 @@ def reduce_dev(comm, sendbuf, op=op_mod.SUM, root: int = 0,
     nbytes = int(sendbuf.size) * np.dtype(sendbuf.dtype).itemsize
     # small buffers / deterministic modes keep the one-program full
     # reduction (the rank-order contract needs the flat schedule
-    # anyway). Non-SUM ops too: reduce_scatter has no native
-    # psum_scatter lowering for them, so the "rooted" program would
-    # still materialize the full reduction AND pay the per-source
-    # rounds on top — strictly worse than the shared program.
-    if (n == 1 or det is not None or opn.name != "MPI_SUM"
-            or not _rooted(nbytes * n)):
+    # anyway, and it is free for small buffers).
+    if n == 1 or det is not None or not _rooted(nbytes * n):
         out = allreduce_dev(comm, sendbuf, op, deterministic)
         return out if comm.rank == root else None
+    if opn.name != "MPI_SUM":
+        # non-SUM commutative: the binomial ppermute tree (O(bytes)
+        # non-roots; the SUM psum_scatter route below has no lowering
+        # for these ops)
+        from ompi_tpu.parallel.collectives import _JNP_FN
+
+        if opn.name not in _JNP_FN:
+            out = allreduce_dev(comm, sendbuf, op, deterministic)
+            return out if comm.rank == root else None
+        pvar.record("coll_xla_device")
+        return _reduce_binomial(_ctx(comm), comm, sendbuf, opn, root)
     # rooted schedule: reduce_scatter leaves each rank ONE 1/n chunk
     # (O(bytes/n) output), then the chunks ride single-pair ppermutes
     # to the root — non-roots do O(bytes) HBM/ICI total, never the
@@ -703,14 +777,27 @@ def alltoallv_dev(comm, sendbuf, scounts, rcounts, max_count=None):
         # the one host metadata round carries (max cell, payload) —
         # the global max sizes the padding, the global total bounds
         # the blowup UNIFORMLY across ranks (a per-rank decision
-        # would diverge into different collectives)
-        pairs = comm.coll.allgather_obj(
-            comm, (max(max(scounts), max(rcounts)), sum(scounts)))
-        m = max(p[0] for p in pairs)
-        factor = _a2av_pad_var.get()
-        padded_cells = comm.size * comm.size * m
-        true_cells = max(sum(p[1] for p in pairs), 1)
-        if factor > 0 and padded_cells > factor * true_cells:
+        # would diverge into different collectives). An unchanged
+        # (scounts, rcounts) signature reuses the cached outcome, so
+        # an iterative MoE loop pays the round once (r4 weak #2).
+        sig = (scounts, rcounts)
+        cached = (getattr(comm, "_coll_xla_a2av_meta", None)
+                  if _a2av_cache_var.get() else None)
+        if cached is not None and cached[0] == sig:
+            m, fell_back = cached[1]
+            pvar.record("coll_xla_a2av_meta_cached")
+        else:
+            pairs = comm.coll.allgather_obj(
+                comm, (max(max(scounts), max(rcounts)), sum(scounts)))
+            m = max(p[0] for p in pairs)
+            factor = _a2av_pad_var.get()
+            padded_cells = comm.size * comm.size * m
+            true_cells = max(sum(p[1] for p in pairs), 1)
+            fell_back = (factor > 0
+                         and padded_cells > factor * true_cells)
+            if _a2av_cache_var.get():
+                comm._coll_xla_a2av_meta = (sig, (m, fell_back))
+        if fell_back:
             # pathological skew (one hot expert): the staged path
             # moves the ragged counts without padding
             pvar.record("coll_xla_alltoallv_fallback")

@@ -212,3 +212,13 @@ FT_FAILURE = register_type(
     "ft_process_failure",
     "the failure detector declared a peer dead",
     ("rank", "reason"))
+OSC_EPOCH = register_type(
+    "osc_epoch_transition",
+    "a one-sided synchronization epoch opened or closed "
+    "(fence/start/complete/post/wait/lock/unlock)",
+    ("kind", "phase", "win", "peer"))
+IO_COLL_COMPLETE = register_type(
+    "io_collective_complete",
+    "a collective file operation finished its two-phase schedule "
+    "(fcoll plane)",
+    ("kind", "file", "nbytes"))

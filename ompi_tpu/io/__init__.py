@@ -326,6 +326,10 @@ class File:
         broadcasts so a bad seek raises on EVERY rank instead of
         stranding peers in a barrier."""
         key = self._sfp_key()
+        # entry barrier: rank 0 must not mutate the counter while a
+        # peer is still inside ITS preceding shared-fp call (the exit
+        # barrier alone lets the reset overtake a slow reader)
+        self.comm.Barrier()
         cur = tgt = None
         if self.comm.rank == 0:
             cur = rte.client().inc(key, 0)
