@@ -48,7 +48,10 @@ class NbcRequest(rq.Request):
         self._kind = getattr(gen, "__name__", "?").replace("_sched_",
                                                            "")
         frame = getattr(gen, "gi_frame", None)
-        c = frame.f_locals.get("comm") if frame is not None else None
+        c = None
+        if frame is not None:  # module-level schedules bind `comm`;
+            # bound-method schedules (Comm._sched_idup) bind `self`
+            c = frame.f_locals.get("comm") or frame.f_locals.get("self")
         self._comm_cid = getattr(c, "cid", -1)
         global _registered
         if not _registered:

@@ -150,17 +150,68 @@ class Communicator(AttrHost):
         return self.name
 
     # -- construction (collective over self) ------------------------------
-    def dup(self) -> "Communicator":
-        """MPI_Comm_dup (errhandler AND info hints propagate — MPI-4
-        §7.4.1 dups the info to the new communicator)."""
-        cid = self._agree_cid(f"dup:{self.cid}")
+    def _materialize_dup(self, cid: int) -> "Communicator":
+        """Construction tail shared by dup and Idup: errhandler, info
+        hints (MPI-4 §7.4.1) and keyval copy callbacks
+        (ompi_attr_copy_all) all propagate."""
         c = Communicator(Group(self.group.ranks), cid,
                          self.errhandler)
         c.info = self.info.dup()
-        if self.attrs:  # keyval copy callbacks (ompi_attr_copy_all)
+        if self.attrs:
             from ompi_tpu import attr as _attr
 
             _attr.copy_attrs(self, c, "comm")
+        return c
+
+    def dup(self) -> "Communicator":
+        """MPI_Comm_dup."""
+        return self._materialize_dup(self._agree_cid(f"dup:{self.cid}"))
+
+    def _sched_idup(self, out: dict):
+        """Idup rounds: rank 0 allocates the cid and ships it over
+        the object channel; construction + attribute copy callbacks
+        run at completion (MPI-4: idup copies attrs like dup)."""
+        from ompi_tpu import pml
+
+        p = pml.current()
+        tag = self.coll.next_tag()
+        if self.rank == 0:
+            cid = alloc_cid()
+            yield [p.isend_obj(self, cid, d, tag, collective=True)
+                   for d in range(1, self.size)]
+        else:
+            r = p.irecv_obj(self, 0, tag, collective=True)
+            yield [r]
+            if r.status.error:  # e.g. rank 0 died (ULFM recv sweep):
+                # surface at the request's wait, never build a
+                # cid=None communicator
+                errors.raise_mpi_error(r.status.error,
+                                       "idup cid recv failed")
+            cid = r._obj
+        out["comm"] = self._materialize_dup(cid)
+
+    def Idup(self):
+        """MPI_Comm_idup (ompi/mpi/c/comm_idup.c): nonblocking dup.
+        The new communicator is ``req.result["comm"]`` after the
+        request completes; overlap compute/p2p until then."""
+        from ompi_tpu.coll import libnbc
+
+        out: dict = {}
+        req = libnbc.NbcRequest(self._sched_idup(out))
+        req.result = out
+        return req
+
+    def create_group(self, group: Group,
+                     tag: int = 0) -> "Communicator":
+        """MPI_Comm_create_group (ompi/mpi/c/comm_create_group.c):
+        collective over GROUP members ONLY — non-members do not call
+        (unlike Comm_create, which is collective over the whole
+        comm). Distinct concurrent creations disambiguate by tag."""
+        c = comm_create_from_group(
+            group, tag=f"ccg:{self.cid}:{int(tag)}")
+        if c is not None:  # errhandler/info inherit from the parent
+            c.errhandler = self.errhandler
+            c.info = self.info.dup()
         return c
 
     def split(self, color: int, key: int = 0) -> Optional["Communicator"]:

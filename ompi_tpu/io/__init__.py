@@ -95,6 +95,7 @@ class File:
         self.errhandler = errors.ERRORS_RETURN
         self.view = FileView()
         self._pos = 0          # individual pointer, visible bytes
+        self._atomic = False   # MPI_File_set_atomicity mode
         self._lock = threading.Lock()
         # fileid keys the shared-pointer counter. Derived WITHOUT a
         # bcast: opens are collective and ordered per comm, so a
@@ -151,6 +152,19 @@ class File:
     def Sync(self) -> None:
         os.fsync(self.fd)
 
+    def Set_atomicity(self, flag: bool) -> None:
+        """MPI_File_set_atomicity (collective —
+        ompi/mpi/c/file_set_atomicity.c). The local-fs backend writes
+        with POSIX pwrite (atomic per call on one host); atomic mode
+        additionally fsyncs after every write so conflicting accesses
+        through other ranks' handles observe sequentially consistent
+        data without an explicit Sync."""
+        self._atomic = bool(flag)
+        self.comm.Barrier()
+
+    def Get_atomicity(self) -> bool:
+        return self._atomic
+
     def Get_amode(self) -> int:
         return self.amode
 
@@ -193,6 +207,11 @@ class File:
             for off, length in extents:
                 os.pwrite(self.fd, data[done:done + length], off)
                 done += length
+            if self._atomic and done:
+                os.fsync(self.fd)  # atomic mode: durable/visible
+                # before return; fsync failures (ENOSPC/EIO at
+                # writeback) route through the errhandler like any
+                # other OS failure here
         except (OSError, TypeError) as exc:
             errors.dispatch(self, errors.MPIError(
                 errors.ERR_FILE, f"{self.filename}: {exc}"))

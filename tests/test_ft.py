@@ -196,3 +196,25 @@ def test_concurrent_iagree_different_comms():
         assert ra.result == (0b11, []), ra.result
         assert rb.result == (0b10, []), rb.result
     """, 2, mca=FT, timeout=90)
+
+
+def test_idup_with_dead_root_errors():
+    """Idup's cid receive from a dead rank 0 surfaces as an error at
+    the request's wait — never a cid=None communicator."""
+    run_ranks("""
+        import os, signal, time
+        from ompi_tpu import errors
+        comm.Barrier()
+        if rank == 0:
+            os.kill(os.getpid(), signal.SIGKILL)
+        deadline = time.monotonic() + 20
+        while 0 not in comm.get_failed():
+            time.sleep(0.02)
+            assert time.monotonic() < deadline
+        req = comm.Idup()
+        try:
+            req.wait(timeout=60)
+            raise SystemExit("idup with dead root succeeded")
+        except errors.MPIError:
+            pass
+    """, 3, mca=FT, timeout=90)

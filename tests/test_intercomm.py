@@ -97,3 +97,50 @@ def test_connect_accept():
     expect = float(sum(r + 10 for r in other_side))
     np.testing.assert_array_equal(out, np.full(2, expect))
     """, 4)
+
+
+def test_comm_idup_nonblocking():
+    """MPI_Comm_idup: the dup completes on the progress engine while
+    p2p overlaps; attrs copy at completion like blocking dup."""
+    from tests.harness import run_ranks
+
+    run_ranks("""
+        log = []
+        kv = mpi.Comm_create_keyval(
+            copy_fn=lambda o, k, e, v: (log.append(v), v * 2)[1])
+        comm.Set_attr(kv, 21)
+        req = comm.Idup()
+        peer = 1 - rank
+        comm.send(("overlap", rank), dest=peer, tag=3)
+        assert comm.recv(source=peer, tag=3) == ("overlap", peer)
+        req.wait(timeout=60)
+        c2 = req.result["comm"]
+        assert c2.size == comm.size and c2.cid != comm.cid
+        assert c2.Get_attr(kv) == 42 and log == [21], (log,)
+        out = np.zeros(2)
+        c2.Allreduce(np.full(2, rank + 1.0), out)
+        assert (out == 3.0).all(), out
+        c2.free()
+    """, 2)
+
+
+def test_comm_create_group_subset_only():
+    """MPI_Comm_create_group: collective over the GROUP only — the
+    excluded rank never calls and must not be needed."""
+    from tests.harness import run_ranks
+
+    run_ranks("""
+        from ompi_tpu.comm import Group
+        sub_world = [comm.group.ranks[i] for i in (0, 2)]
+        if rank in (0, 2):
+            sub = comm.create_group(Group(sub_world), tag=7)
+            assert sub is not None and sub.size == 2
+            assert sub.errhandler == comm.errhandler
+            out = np.zeros(1)
+            sub.Allreduce(np.array([float(sub.rank + 1)]), out)
+            assert out[0] == 3.0, out
+            sub.free()
+        else:
+            pass  # rank 1 is NOT part of the creation collective
+        comm.Barrier()
+    """, 3)

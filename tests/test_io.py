@@ -342,3 +342,39 @@ def test_seek_end_visible_space_and_bad_shared_seek(tmp_path):
         comm.Barrier()  # every rank got here: nobody stranded
         f.Close()
     """, 3, timeout=120)
+
+
+def test_file_atomicity(tmp_path):
+    """MPI_File_set_atomicity: flag round-trips collectively; atomic
+    writes are immediately visible through a peer's handle without an
+    explicit Sync (file_set_atomicity.c semantics on the local-fs
+    backend)."""
+    path = str(tmp_path / "atomic.mpiio")
+    run_ranks(f"""
+        from ompi_tpu import io as io_mod
+        f = io_mod.File_open(comm, {path!r},
+                             io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+        assert f.Get_atomicity() is False
+        f.Set_atomicity(True)
+        assert f.Get_atomicity() is True
+        # the fsync hook must actually run in atomic mode (the shared
+        # page cache on one host would hide a deleted hook)
+        import os as _os
+        fsyncs = []
+        real_fsync = _os.fsync
+        _os.fsync = lambda fd: (fsyncs.append(fd), real_fsync(fd))[1]
+        try:
+            if rank == 0:
+                f.Write_at(0, np.arange(8, dtype=np.int32))
+                assert fsyncs, "atomic write did not fsync"
+        finally:
+            _os.fsync = real_fsync
+        comm.Barrier()
+        if rank == 1:
+            got = np.zeros(8, np.int32)
+            f.Read_at(0, got)
+            np.testing.assert_array_equal(got, np.arange(8,
+                                                         dtype=np.int32))
+        f.Set_atomicity(False)
+        f.Close()
+    """, 2, timeout=120)
