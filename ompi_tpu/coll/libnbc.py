@@ -101,14 +101,18 @@ class NbcRequest(rq.Request):
             # an unrelated request's wait. Complete THIS request with
             # the error instead; it re-raises at its own wait().
             # Exception: the prologue runs synchronously inside
-            # __init__ — argument errors there stay loud at the
-            # call site.
+            # __init__ — ARGUMENT errors (ValueError/TypeError/...)
+            # there stay loud at the call site. MPI errors always
+            # defer to the request's wait, even from __init__: a
+            # communication failure (e.g. a recv from a known-dead
+            # peer completing instantly) is a runtime outcome, not a
+            # caller mistake.
             _active.remove(self)
-            if self._in_init:
-                raise
-            self._exc = exc
             from ompi_tpu import errors as _errors
 
+            if self._in_init and not isinstance(exc, _errors.MPIError):
+                raise
+            self._exc = exc
             code = exc.error_class if isinstance(exc, _errors.MPIError) \
                 else _errors.ERR_OTHER
             self.complete(error=code)

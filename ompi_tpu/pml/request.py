@@ -37,6 +37,36 @@ class Status:
             return self.count
         return self.count // datatype.size
 
+    def get_elements(self, datatype=None) -> int:
+        """MPI_Get_elements (ompi/mpi/c/get_elements.c): the number of
+        complete BASIC (predefined) elements received — unlike
+        get_count, meaningful for a partial receive of a derived type
+        (a truncated struct still reports the leading fields that DID
+        arrive). Elements derive from the type's wire pattern: each
+        (unit, nbytes) segment holds nbytes/unit basic elements, in
+        pack order."""
+        nbytes = self.count
+        if datatype is None or datatype.size == 0:
+            return nbytes
+        from ompi_tpu.datatype.datatype import wire_pattern
+
+        # the pattern is ONE inner period (the packed stream repeats
+        # it); period_bytes divides datatype.size by construction, so
+        # counting in periods — not whole datatypes — handles
+        # contiguous/vector/struct-of-uniform types correctly
+        pat = wire_pattern(datatype) or [(1, datatype.size)]
+        period = sum(nb for _, nb in pat)
+        per_period = sum(nb // u for u, nb in pat)
+        full, rem = divmod(nbytes, period)
+        elems = full * per_period
+        for u, nb in pat:  # rem < period: one partial walk suffices
+            if rem <= 0:
+                break
+            take = min(nb, rem)
+            elems += take // u
+            rem -= take
+        return elems
+
     def __repr__(self) -> str:
         return (f"Status(source={self.source}, tag={self.tag}, "
                 f"count={self.count})")

@@ -234,3 +234,42 @@ def test_mpi_pack_unpack_roundtrip():
         np.testing.assert_array_equal(
             got, [0, 1, 4, 5, 8, 9])
     """, 1)
+
+
+def test_get_elements_partial_receive_semantics():
+    """MPI_Get_elements vs get_count (get_elements.c): a partial
+    receive of a derived type reports the complete BASIC elements
+    that arrived, while get_count floors to whole top-level
+    elements."""
+    from ompi_tpu.datatype import DOUBLE, INT32, create_struct
+    from ompi_tpu.pml.request import Status
+
+    pair = create_struct([1, 1], [0, 8], [DOUBLE, INT32])  # 12B/elem
+    st = Status()
+    st.count = 12 * 3
+    assert st.get_count(pair) == 3
+    assert st.get_elements(pair) == 6   # 3 doubles + 3 ints
+    st.count = 12 * 2 + 8               # 2 full pairs + one double
+    assert st.get_count(pair) == 2      # floors
+    assert st.get_elements(pair) == 5   # ...but 5 basics arrived
+    st.count = 12 * 2 + 10              # + half an int32: incomplete
+    assert st.get_elements(pair) == 5   # basics only count complete
+    st.count = 7
+    assert st.get_elements(None) == 7   # raw bytes
+    # uniform types whose wire pattern is ONE inner period must scale
+    # by periods, not whole datatypes (contiguous/vector families)
+    from ompi_tpu.datatype import contiguous, vector
+
+    c10 = contiguous(10, DOUBLE)        # size 80, period 8
+    st.count = 80
+    assert st.get_elements(c10) == 10
+    st.count = 44                       # 5 doubles + half a double
+    assert st.get_elements(c10) == 5
+    v = vector(3, 2, 4, DOUBLE)         # 6 doubles packed per elem
+    st.count = 6 * 8 + 8
+    assert st.get_elements(v) == 7
+    cp = contiguous(5, pair)            # contiguous of mixed struct
+    st.count = 5 * 12
+    assert st.get_elements(cp) == 10
+    st.count = 2 * 12 + 8
+    assert st.get_elements(cp) == 5

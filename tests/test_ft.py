@@ -211,7 +211,12 @@ def test_idup_with_dead_root_errors():
         while 0 not in comm.get_failed():
             time.sleep(0.02)
             assert time.monotonic() < deadline
-        req = comm.Idup()
+        # run the FT sweep so pml.failed is populated BEFORE Idup:
+        # even an instantly-errored cid recv must surface at wait,
+        # not escape Idup() itself
+        from ompi_tpu.core import progress
+        progress.progress()
+        req = comm.Idup()   # must NOT raise here
         try:
             req.wait(timeout=60)
             raise SystemExit("idup with dead root succeeded")
