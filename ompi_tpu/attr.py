@@ -113,7 +113,7 @@ def _predef_comm(kid: int):
         # any rank can perform IO (ompio equivalent is rank-agnostic)
         return True, True
     if kid == LASTUSEDCODE:
-        return errors.ERR_LASTCODE, True
+        return errors.last_used_code(), True
     return None, False
 
 

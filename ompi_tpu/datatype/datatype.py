@@ -163,6 +163,62 @@ def wire_pattern(d: "Datatype"):
     return None
 
 
+def _elems_of_np(dt):
+    """ONE packed element of a numpy dtype as (nbytes, nelems)
+    segments for MPI_Get_elements: a complex scalar is ONE basic
+    element (unlike the wire pattern's per-component swap units) and
+    interior/trailing padding is ZERO elements."""
+    dt = np.dtype(dt)
+    if dt.names is None:
+        if dt.subdtype is not None:
+            base, shape = dt.subdtype
+            return _elems_of_np(base) * int(np.prod(shape))
+        if dt.kind == "V":
+            return [(dt.itemsize, 0)]
+        return [(dt.itemsize, 1)]
+    segs = []
+    pos = 0
+    for name in sorted(dt.names, key=lambda k: dt.fields[k][1]):
+        fld, off = dt.fields[name][0], dt.fields[name][1]
+        if off > pos:
+            segs.append((off - pos, 0))
+        segs.extend(_elems_of_np(fld))
+        pos = off + fld.itemsize
+    if pos < dt.itemsize:
+        segs.append((dt.itemsize - pos, 0))
+    return segs
+
+
+def element_pattern(d: "Datatype"):
+    """ONE period of (nbytes, nelems) segments of ``d``'s packed
+    stream — the basic-element decomposition MPI_Get_elements counts
+    by (get_elements.c walks the typemap the same way). Derived via
+    the numpy base where one exists, else through the constructor
+    provenance; ``None`` when no decomposition is known (the caller
+    reports MPI_UNDEFINED)."""
+    if d.base is not None:
+        return _elems_of_np(d.base) if d.size else []
+    if d.combiner == "struct":
+        ints, _, types = d.cargs
+        out = []
+        for bl, t in zip(ints[1:], types):
+            if bl <= 0 or t.size == 0:
+                continue
+            p = element_pattern(t)
+            if p is None:
+                return None
+            period = sum(nb for nb, _ in p)
+            out.extend(p * ((bl * t.size) // period))
+        return out
+    if d.combiner in ("contiguous", "vector", "hvector", "indexed",
+                      "hindexed", "indexed_block", "subarray",
+                      "resized", "dup", "darray"):
+        # the packed stream repeats the old type's element
+        types = d.cargs[2]
+        return element_pattern(types[0]) if types else None
+    return None
+
+
 class Datatype(AttrHost):
     """An MPI datatype: a byte-layout description over an (N,2) span table.
 

@@ -105,6 +105,75 @@ def raise_mpi_error(error_class: int, msg: str = "") -> None:
     raise make_mpi_error(error_class, msg)
 
 
+# -- user-defined error classes/codes (ompi/mpi/c/add_error_class.c,
+# add_error_code.c, add_error_string.c over ompi/errhandler/
+# errcode.c). MPI_LASTUSEDCODE (the predefined attr) tracks the top
+# of the dynamic space.
+
+_NAMES = {v: k for k, v in list(globals().items())
+          if k.startswith("ERR_") and isinstance(v, int)}
+_user_strings: dict = {}
+_user_codes: dict = {}  # code -> its error class
+_last_used = ERR_LASTCODE
+
+
+def add_error_class() -> int:
+    """MPI_Add_error_class: a fresh error class above LASTCODE."""
+    global _last_used
+    _last_used += 1
+    _user_codes[_last_used] = _last_used  # a class is its own class
+    return _last_used
+
+
+def add_error_code(errorclass: int) -> int:
+    """MPI_Add_error_code: a fresh code within ``errorclass`` —
+    which may be predefined OR user-added (MPI-3.1 §8.5), but must
+    be a CLASS: a user-added CODE is rejected (the reference's
+    ompi_mpi_errnum_is_class check)."""
+    global _last_used
+    is_class = ((0 <= errorclass <= ERR_LASTCODE)
+                or _user_codes.get(errorclass) == errorclass)
+    if not is_class:
+        raise MPIError(ERR_ARG,
+                       f"{errorclass} is not an error class")
+    _last_used += 1
+    _user_codes[_last_used] = errorclass
+    return _last_used
+
+
+def add_error_string(code: int, string: str) -> None:
+    """MPI_Add_error_string (user-ADDED codes only — labeling the
+    predefined space or a never-allocated number is erroneous per
+    MPI-3.1 §8.5)."""
+    if code not in _user_codes:
+        raise MPIError(ERR_ARG,
+                       f"{code} is not a user-added error code")
+    _user_strings[int(code)] = str(string)
+
+
+def error_class(code: int) -> int:
+    """MPI_Error_class: the class a code belongs to (predefined codes
+    are their own class)."""
+    return _user_codes.get(code, code)
+
+
+def error_string(code: int) -> str:
+    """MPI_Error_string."""
+    got = _user_strings.get(code)
+    if got is not None:
+        return got
+    name = _NAMES.get(code)
+    if name is not None:
+        return f"MPI_{name}"
+    return f"MPI error {code}"
+
+
+def last_used_code() -> int:
+    """The live MPI_LASTUSEDCODE value (attribute_predefined.c keeps
+    the attr in sync with the dynamic code space)."""
+    return _last_used
+
+
 # errhandlers (reference: MPI_ERRORS_ARE_FATAL default on comms)
 ERRORS_ARE_FATAL = "errors_are_fatal"
 ERRORS_RETURN = "errors_return"

@@ -1195,6 +1195,11 @@ from ompi_tpu.info import (  # noqa: E402,F401
 # factory serves all three object classes, as in the reference
 from ompi_tpu.errors import (  # noqa: E402,F401
     ERRORS_ABORT, ERRORS_ARE_FATAL, ERRORS_RETURN, Errhandler,
+    add_error_class as Add_error_class,
+    add_error_code as Add_error_code,
+    add_error_string as Add_error_string,
+    error_class as Error_class,
+    error_string as Error_string,
     create_errhandler as Comm_create_errhandler,
     create_errhandler as Win_create_errhandler,
     create_errhandler as File_create_errhandler,

@@ -42,28 +42,33 @@ class Status:
         complete BASIC (predefined) elements received — unlike
         get_count, meaningful for a partial receive of a derived type
         (a truncated struct still reports the leading fields that DID
-        arrive). Elements derive from the type's wire pattern: each
-        (unit, nbytes) segment holds nbytes/unit basic elements, in
-        pack order."""
+        arrive). Complex scalars count as ONE element and padding as
+        zero (the typemap walk, via datatype.element_pattern). -1
+        (MPI_UNDEFINED) when the type has no known basic-element
+        decomposition."""
         nbytes = self.count
         if datatype is None or datatype.size == 0:
             return nbytes
-        from ompi_tpu.datatype.datatype import wire_pattern
+        from ompi_tpu.datatype.datatype import element_pattern
 
+        pat = element_pattern(datatype)
+        if pat is None:
+            return -1  # MPI_UNDEFINED
         # the pattern is ONE inner period (the packed stream repeats
-        # it); period_bytes divides datatype.size by construction, so
-        # counting in periods — not whole datatypes — handles
+        # it); counting in periods — not whole datatypes — handles
         # contiguous/vector/struct-of-uniform types correctly
-        pat = wire_pattern(datatype) or [(1, datatype.size)]
-        period = sum(nb for _, nb in pat)
-        per_period = sum(nb // u for u, nb in pat)
+        period = sum(nb for nb, _ in pat)
+        per_period = sum(ne for _, ne in pat)
         full, rem = divmod(nbytes, period)
         elems = full * per_period
-        for u, nb in pat:  # rem < period: one partial walk suffices
+        for nb, ne in pat:  # rem < period: one partial walk suffices
             if rem <= 0:
                 break
             take = min(nb, rem)
-            elems += take // u
+            if take == nb:
+                elems += ne
+            elif ne and nb % ne == 0:  # homogeneous segment: count
+                elems += take // (nb // ne)  # complete sub-elements
             rem -= take
         return elems
 

@@ -168,3 +168,33 @@ def test_win_attrs_and_callbacks():
         win.Free()                       # delete callbacks fire here
         assert log == ["cached"], log
     """, 2)
+
+
+def test_add_error_class_code_string_and_lastusedcode():
+    """MPI_Add_error_class/code/string (add_error_class.c,
+    errcode.c): a dynamic error space above LASTCODE, with the
+    LASTUSEDCODE predefined attribute tracking it live."""
+    from ompi_tpu import attr, errors, mpi
+
+    o = _Obj()
+    before = attr.get_attr(o, "comm", attr.LASTUSEDCODE)
+    cls = mpi.Add_error_class()
+    assert cls > errors.ERR_LASTCODE
+    code = mpi.Add_error_code(cls)
+    assert code == cls + 1 and mpi.Error_class(code) == cls
+    assert mpi.Error_class(cls) == cls  # a class is its own class
+    mpi.Add_error_string(code, "my library exploded")
+    assert mpi.Error_string(code) == "my library exploded"
+    assert "ERR_TRUNCATE" in mpi.Error_string(errors.ERR_TRUNCATE)
+    assert attr.get_attr(o, "comm", attr.LASTUSEDCODE) == code > before
+    with pytest.raises(errors.MPIError):
+        mpi.Add_error_string(errors.ERR_TYPE, "nope")  # predefined
+    # codes may extend PREDEFINED classes too (MPI-3.1 §8.5)
+    c2 = mpi.Add_error_code(errors.ERR_TYPE)
+    assert mpi.Error_class(c2) == errors.ERR_TYPE
+    with pytest.raises(errors.MPIError):
+        mpi.Add_error_code(10 ** 6)  # unknown dynamic class
+    with pytest.raises(errors.MPIError):
+        mpi.Add_error_code(code)  # a user CODE is not a class
+    with pytest.raises(errors.MPIError):
+        mpi.Add_error_string(10 ** 6, "never allocated")

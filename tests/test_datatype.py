@@ -273,3 +273,19 @@ def test_get_elements_partial_receive_semantics():
     assert st.get_elements(cp) == 10
     st.count = 2 * 12 + 8
     assert st.get_elements(cp) == 5
+    # padding bytes are ZERO elements and complex scalars are ONE
+    # (the wire pattern's swap units must not leak into the count)
+    import numpy as np
+
+    from ompi_tpu.datatype import COMPLEX128, from_numpy_dtype
+
+    padded = from_numpy_dtype(np.dtype([("a", "i1"), ("b", "f8")],
+                                       align=True))  # itemsize 16
+    st.count = 16
+    assert st.get_elements(padded) == 2   # i1 + f8, 7 pad bytes
+    st.count = 16 + 8                     # + a's byte, inside pad
+    assert st.get_elements(padded) == 3
+    st.count = 32
+    assert st.get_elements(COMPLEX128) == 2   # one per scalar
+    st.count = 8                          # half a complex: none whole
+    assert st.get_elements(COMPLEX128) == 0
