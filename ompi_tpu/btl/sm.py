@@ -181,12 +181,18 @@ class SmBtl(base.Btl):
             self._out[p] = _Ring(self._path(rte.rank, p),
                                  self.ring_size, create=True)
         rte.fence("btl_sm_setup")
+        from ompi_tpu.core import events as mpit_events
+
         for p in same_host:
             try:
                 self._in[p] = _Ring(self._path(p, rte.rank),
                                     self.ring_size, create=False)
             except OSError:
-                pass
+                continue
+            if mpit_events.active("btl_endpoint_connected"):
+                mpit_events.emit("btl_endpoint_connected", btl="sm",
+                                 peer=p,
+                                 addr=self._path(p, rte.rank))
         return True
 
     def _path(self, src: int, dst: int) -> str:

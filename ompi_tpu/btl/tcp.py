@@ -88,6 +88,11 @@ class TcpBtl(base.Btl):
         s.setblocking(False)
         self._send_socks[dst] = s
         self._send_q[dst] = deque()
+        from ompi_tpu.core import events as mpit_events
+
+        if mpit_events.active("btl_endpoint_connected"):
+            mpit_events.emit("btl_endpoint_connected", btl="tcp",
+                             peer=dst, addr=str(tuple(addr)))
         return s
 
     def send(self, dst: int, data: bytes) -> None:

@@ -222,3 +222,8 @@ IO_COLL_COMPLETE = register_type(
     "a collective file operation finished its two-phase schedule "
     "(fcoll plane)",
     ("kind", "file", "nbytes"))
+BTL_CONNECTED = register_type(
+    "btl_endpoint_connected",
+    "a transport endpoint established its first connection to a peer "
+    "(btl wireup)",
+    ("btl", "peer", "addr"))
