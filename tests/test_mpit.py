@@ -60,6 +60,7 @@ def test_categories_cover_frameworks():
 @pytest.mark.parametrize("example,n", [
     ("hello", 2), ("ring", 3), ("connectivity", 3),
     ("shmem_hello", 2), ("shmem_ring", 3),
+    ("library_caching", 3), ("parallel_io", 4),
 ])
 def test_examples_run(example, n):
     """The reference ships runnable examples/; ours must keep running
