@@ -43,6 +43,7 @@ SLOTS = (
     "agree",
     # neighborhood (installed when a topology is attached)
     "neighbor_allgather", "neighbor_alltoall",
+    "neighbor_allgatherv", "neighbor_alltoallv",
     # device-buffer variants (coll/accelerator staging; return new
     # device arrays — PJRT buffers are immutable)
     "allreduce_dev", "bcast_dev", "reduce_dev", "allgather_dev",

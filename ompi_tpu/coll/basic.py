@@ -70,6 +70,8 @@ class CollBasic(CollModule):
         if getattr(comm, "topo", None) is not None:
             slots["neighbor_allgather"] = neighbor_allgather_linear
             slots["neighbor_alltoall"] = neighbor_alltoall_linear
+            slots["neighbor_allgatherv"] = neighbor_allgatherv_linear
+            slots["neighbor_alltoallv"] = neighbor_alltoallv_linear
         return slots
 
 
@@ -470,6 +472,68 @@ def neighbor_alltoall_linear(comm, sendbuf, recvbuf, count, dtype):
         for i, src in enumerate(ins) if src != PROC_NULL)]
     sreqs = [_isend(comm, sb[i], count, dtype, dst, send_tag(i))
              for i, dst in enumerate(outs) if dst != PROC_NULL]
+    for q in rreqs:
+        q.wait()
+    for q in sreqs:
+        q.wait()
+
+
+def neighbor_allgatherv_linear(comm, sendbuf, recvbuf, count, dtype,
+                               rcounts, rdispls):
+    """MPI_Neighbor_allgatherv (ompi/mpi/c/neighbor_allgatherv.c):
+    the same ``count``-element send goes to every out-neighbor;
+    per-in-neighbor rcounts/rdispls (ELEMENT units) place the ragged
+    blocks in recvbuf."""
+    from ompi_tpu.pml.request import PROC_NULL
+
+    pvar.record("neighbor_allgatherv")
+    topo = comm.topo
+    ins = topo.in_neighbors(comm.rank)
+    outs = topo.out_neighbors(comm.rank)
+    send_tag, recv_tag = _nbr_tags(comm, topo)
+    sb = np.asarray(sendbuf)
+    rb = np.asarray(recvbuf).reshape(-1)
+    rreqs = [
+        _irecv(comm, rb[rdispls[i]:rdispls[i] + rcounts[i]],
+               rcounts[i], dtype, src, recv_tag(i))
+        for i, src in enumerate(ins)
+        if src != PROC_NULL and rcounts[i]]
+    # zero-count skip must be SYMMETRIC with the recv side (peers
+    # pass rcounts[i]==0 for our count==0): an unguarded send would
+    # sit unmatched in their unexpected queues forever
+    sreqs = [_isend(comm, sb, count, dtype, dst, send_tag(i))
+             for i, dst in enumerate(outs)
+             if dst != PROC_NULL and count]
+    for q in rreqs:
+        q.wait()
+    for q in sreqs:
+        q.wait()
+
+
+def neighbor_alltoallv_linear(comm, sendbuf, recvbuf, dtype, scounts,
+                              sdispls, rcounts, rdispls):
+    """MPI_Neighbor_alltoallv (ompi/mpi/c/neighbor_alltoallv.c):
+    per-out-neighbor send segments and per-in-neighbor receive
+    segments, both addressed by counts/displs in ELEMENT units."""
+    from ompi_tpu.pml.request import PROC_NULL
+
+    pvar.record("neighbor_alltoallv")
+    topo = comm.topo
+    ins = topo.in_neighbors(comm.rank)
+    outs = topo.out_neighbors(comm.rank)
+    send_tag, recv_tag = _nbr_tags(comm, topo)
+    sb = np.asarray(sendbuf).reshape(-1)
+    rb = np.asarray(recvbuf).reshape(-1)
+    rreqs = [
+        _irecv(comm, rb[rdispls[i]:rdispls[i] + rcounts[i]],
+               rcounts[i], dtype, src, recv_tag(i))
+        for i, src in enumerate(ins)
+        if src != PROC_NULL and rcounts[i]]
+    sreqs = [
+        _isend(comm, sb[sdispls[i]:sdispls[i] + scounts[i]],
+               scounts[i], dtype, dst, send_tag(i))
+        for i, dst in enumerate(outs)
+        if dst != PROC_NULL and scounts[i]]
     for q in rreqs:
         q.wait()
     for q in sreqs:

@@ -460,6 +460,16 @@ def _Pack_size(self, count: int, dtype) -> int:
     return count * dt.size
 
 
+def packed_displs(counts) -> list:
+    """The MPI default displacement layout — counts packed end to
+    end (one implementation for every v-variant's displs=None)."""
+    counts = list(counts)
+    if not counts:
+        return []
+    return np.concatenate(
+        [[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
+
+
 def _require_packed_displs(counts, displs, what: str) -> None:
     """Device v-variants slice the send buffer as PACKED segments; a
     caller-supplied send-side displacement layout would silently move
@@ -640,7 +650,7 @@ def _Allgatherv(self, sendbuf, recvbuf, counts, displs=None):
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if displs is None:
-        displs = np.concatenate([[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
+        displs = packed_displs(counts)
     self.coll.allgatherv(self, sarr, rarr, counts, displs,
                          dtype_of(sarr))
 
@@ -670,9 +680,9 @@ def _Alltoallv(self, sendbuf, recvbuf, scounts, rcounts,
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if sdispls is None:
-        sdispls = np.concatenate([[0], np.cumsum(scounts[:-1], dtype=np.intp)]).tolist()
+        sdispls = packed_displs(scounts)
     if rdispls is None:
-        rdispls = np.concatenate([[0], np.cumsum(rcounts[:-1], dtype=np.intp)]).tolist()
+        rdispls = packed_displs(rcounts)
     self.coll.alltoallv(self, sarr, rarr, scounts, sdispls, rcounts,
                         rdispls, dtype_of(sarr))
 
@@ -871,9 +881,9 @@ def _Ialltoallv(self, sendbuf, recvbuf, scounts, rcounts,
     sarr = _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if sdispls is None:
-        sdispls = np.concatenate([[0], np.cumsum(scounts[:-1], dtype=np.intp)]).tolist()
+        sdispls = packed_displs(scounts)
     if rdispls is None:
-        rdispls = np.concatenate([[0], np.cumsum(rcounts[:-1], dtype=np.intp)]).tolist()
+        rdispls = packed_displs(rcounts)
     return self.coll.ialltoallv(self, sarr, rarr, scounts, sdispls,
                                 rcounts, rdispls, dtype_of(sarr))
 

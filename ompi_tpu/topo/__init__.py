@@ -381,6 +381,56 @@ def _Neighbor_alltoall(self, sendbuf, recvbuf=None):
     self.coll.neighbor_alltoall(self, sarr, rarr, count, dt)
 
 
+def _Neighbor_allgatherv(self, sendbuf, recvbuf, rcounts,
+                         rdispls=None):
+    """MPI_Neighbor_allgatherv: ragged per-in-neighbor receive blocks
+    (counts/displs in element units; displs default to packed). Host
+    buffers only — stage device arrays with np.asarray."""
+    self.check_revoked()
+    from ompi_tpu.mpi import _is_dev, _parse_buf, _require_recvbuf
+
+    if _is_dev(sendbuf):
+        raise NotImplementedError(
+            "Neighbor_allgatherv has no device route; stage with "
+            "np.asarray (the uniform Neighbor_allgather has one)")
+    _require_recvbuf(recvbuf, "Neighbor_allgatherv")
+    sarr, count, dt = _parse_buf(sendbuf)
+    rarr, _, rdt = _parse_buf(recvbuf)
+    from ompi_tpu.mpi import packed_displs
+
+    rcounts = [int(c) for c in rcounts]
+    rdispls = (packed_displs(rcounts) if rdispls is None
+               else [int(d) for d in rdispls])
+    self.coll.neighbor_allgatherv(self, sarr, rarr, count,
+                                  dt or rdt, rcounts, rdispls)
+
+
+def _Neighbor_alltoallv(self, sendbuf, recvbuf, scounts, rcounts,
+                        sdispls=None, rdispls=None):
+    """MPI_Neighbor_alltoallv: ragged per-edge segments (element
+    units; displs default to packed). Host buffers only."""
+    self.check_revoked()
+    from ompi_tpu.mpi import _is_dev, _parse_buf, _require_recvbuf
+
+    if _is_dev(sendbuf):
+        raise NotImplementedError(
+            "Neighbor_alltoallv has no device route; stage with "
+            "np.asarray (the uniform Neighbor_alltoall has one)")
+    _require_recvbuf(recvbuf, "Neighbor_alltoallv")
+    sarr, _, dt = _parse_buf(sendbuf)
+    rarr, _, rdt = _parse_buf(recvbuf)
+    from ompi_tpu.mpi import packed_displs
+
+    scounts = [int(c) for c in scounts]
+    rcounts = [int(c) for c in rcounts]
+    sdispls = (packed_displs(scounts) if sdispls is None
+               else [int(d) for d in sdispls])
+    rdispls = (packed_displs(rcounts) if rdispls is None
+               else [int(d) for d in rdispls])
+    self.coll.neighbor_alltoallv(self, sarr, rarr, dt or rdt,
+                                 scounts, sdispls, rcounts, rdispls)
+
+
 _API = {
     "Create_cart": _Create_cart,
     "Cart_sub": _Cart_sub,
@@ -395,6 +445,8 @@ _API = {
     "Dist_graph_neighbors": _Dist_graph_neighbors,
     "Neighbor_allgather": _Neighbor_allgather,
     "Neighbor_alltoall": _Neighbor_alltoall,
+    "Neighbor_allgatherv": _Neighbor_allgatherv,
+    "Neighbor_alltoallv": _Neighbor_alltoallv,
 }
 
 for _name, _fn in _API.items():

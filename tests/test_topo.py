@@ -286,3 +286,69 @@ def test_dist_graph_create_general():
         dg.Neighbor_allgather(np.full(2, float(rank)), recv)
         assert (recv == (rank - 1) % size).all(), recv
     """, 4)
+
+
+def test_neighbor_v_variants_ragged():
+    """Neighbor_allgatherv/alltoallv (neighbor_allgatherv.c,
+    neighbor_alltoallv.c): ragged per-edge segments on a periodic
+    cart ring + a dist graph with a receive-only rank."""
+    run_ranks("""
+        cart = comm.Create_cart([size], periods=[True])
+        ins, outs = (cart.topo.in_neighbors(cart.rank),
+                     cart.topo.out_neighbors(cart.rank))
+        assert len(ins) == 2 and len(outs) == 2
+        # allgatherv: every rank sends (rank+1) elements; receives its
+        # neighbors' ragged blocks at explicit displacements
+        mine = np.full(rank + 1, 10 * rank, np.int32)
+        rcounts = [ins[i] + 1 for i in range(2)]  # src sends src+1
+        rdispls = [0, rcounts[0] + 2]            # hole between blocks
+        out = np.full(rcounts[0] + 2 + rcounts[1], -1, np.int32)
+        cart.Neighbor_allgatherv(mine, out, rcounts, rdispls)
+        a, b = ins
+        assert (out[:rcounts[0]] == 10 * a).all(), out
+        assert (out[rcounts[0]:rcounts[0] + 2] == -1).all(), out
+        assert (out[rdispls[1]:] == 10 * b).all(), out
+
+        # alltoallv on the ring: send j+1 elements to out-neighbor j
+        sb = np.concatenate([np.full(j + 1, 100 * rank + j, np.int32)
+                             for j in range(2)])
+        rcounts2 = []
+        for i, src in enumerate(ins):
+            # src's out list: which slot j am I for src?
+            j = cart.topo.out_neighbors(src).index(rank) \
+                if cart.topo.out_neighbors(src).count(rank) == 1 \
+                else i ^ 1
+            rcounts2.append(j + 1)
+        rb = np.full(sum(rcounts2), -1, np.int32)
+        cart.Neighbor_alltoallv(sb, rb, [1, 2], rcounts2)
+        pos = 0
+        for i, src in enumerate(ins):
+            j = rcounts2[i] - 1
+            seg = rb[pos:pos + rcounts2[i]]
+            assert (seg == 100 * src + j).all(), (i, src, rb)
+            pos += rcounts2[i]
+    """, 4)
+
+
+def test_neighbor_alltoallv_receive_only_rank():
+    """A dist-graph rank with out-degree 0 participates with empty
+    send counts (zero-degree ranks are legal)."""
+    run_ranks("""
+        # edges: 1->0, 2->0 (rank 0 receives only; 1,2 send only)
+        sources = {0: [1, 2], 1: [], 2: []}[rank] \
+            if rank < 3 else []
+        dests = {0: [], 1: [0], 2: [0]}[rank] if rank < 3 else []
+        g = comm.Create_dist_graph_adjacent(sources, dests)
+        if rank == 0:
+            rb = np.full(3 + 1, -1, np.int32)   # 3 from r1, 1 from r2
+            g.Neighbor_alltoallv(np.zeros(0, np.int32), rb,
+                                 [], [3, 1])
+            assert (rb[:3] == 11).all() and rb[3] == 22, rb
+        elif rank == 1:
+            g.Neighbor_alltoallv(np.full(3, 11, np.int32),
+                                 np.zeros(0, np.int32), [3], [])
+        elif rank == 2:
+            g.Neighbor_alltoallv(np.full(1, 22, np.int32),
+                                 np.zeros(0, np.int32), [1], [])
+        comm.Barrier()
+    """, 3)
