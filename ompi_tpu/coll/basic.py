@@ -434,7 +434,10 @@ def _nbr_tags(comm, topo):
     return send_tag, recv_tag
 
 
-def neighbor_allgather_linear(comm, sendbuf, recvbuf, count, dtype):
+def neighbor_allgather_reqs(comm, sendbuf, recvbuf, count, dtype):
+    """Post the allgather's isend/irecv set (one linear round); the
+    blocking form waits it, the ineighbor form yields it as a
+    schedule round."""
     from ompi_tpu.pml.request import PROC_NULL
 
     pvar.record("neighbor_allgather")
@@ -450,13 +453,10 @@ def neighbor_allgather_linear(comm, sendbuf, recvbuf, count, dtype):
         for i, src in enumerate(ins) if src != PROC_NULL)]
     sreqs = [_isend(comm, sb, count, dtype, dst, send_tag(i))
              for i, dst in enumerate(outs) if dst != PROC_NULL]
-    for q in rreqs:
-        q.wait()
-    for q in sreqs:
-        q.wait()
+    return rreqs + sreqs
 
 
-def neighbor_alltoall_linear(comm, sendbuf, recvbuf, count, dtype):
+def neighbor_alltoall_reqs(comm, sendbuf, recvbuf, count, dtype):
     from ompi_tpu.pml.request import PROC_NULL
 
     pvar.record("neighbor_alltoall")
@@ -472,14 +472,11 @@ def neighbor_alltoall_linear(comm, sendbuf, recvbuf, count, dtype):
         for i, src in enumerate(ins) if src != PROC_NULL)]
     sreqs = [_isend(comm, sb[i], count, dtype, dst, send_tag(i))
              for i, dst in enumerate(outs) if dst != PROC_NULL]
-    for q in rreqs:
-        q.wait()
-    for q in sreqs:
-        q.wait()
+    return rreqs + sreqs
 
 
-def neighbor_allgatherv_linear(comm, sendbuf, recvbuf, count, dtype,
-                               rcounts, rdispls):
+def neighbor_allgatherv_reqs(comm, sendbuf, recvbuf, count, dtype,
+                             rcounts, rdispls):
     """MPI_Neighbor_allgatherv (ompi/mpi/c/neighbor_allgatherv.c):
     the same ``count``-element send goes to every out-neighbor;
     per-in-neighbor rcounts/rdispls (ELEMENT units) place the ragged
@@ -504,14 +501,11 @@ def neighbor_allgatherv_linear(comm, sendbuf, recvbuf, count, dtype,
     sreqs = [_isend(comm, sb, count, dtype, dst, send_tag(i))
              for i, dst in enumerate(outs)
              if dst != PROC_NULL and count]
-    for q in rreqs:
-        q.wait()
-    for q in sreqs:
-        q.wait()
+    return rreqs + sreqs
 
 
-def neighbor_alltoallv_linear(comm, sendbuf, recvbuf, dtype, scounts,
-                              sdispls, rcounts, rdispls):
+def neighbor_alltoallv_reqs(comm, sendbuf, recvbuf, dtype, scounts,
+                            sdispls, rcounts, rdispls):
     """MPI_Neighbor_alltoallv (ompi/mpi/c/neighbor_alltoallv.c):
     per-out-neighbor send segments and per-in-neighbor receive
     segments, both addressed by counts/displs in ELEMENT units."""
@@ -534,7 +528,32 @@ def neighbor_alltoallv_linear(comm, sendbuf, recvbuf, dtype, scounts,
                scounts[i], dtype, dst, send_tag(i))
         for i, dst in enumerate(outs)
         if dst != PROC_NULL and scounts[i]]
-    for q in rreqs:
+    return rreqs + sreqs
+
+
+def _wait_reqs(reqs) -> None:
+    for q in reqs:
         q.wait()
-    for q in sreqs:
-        q.wait()
+
+
+def neighbor_allgather_linear(comm, sendbuf, recvbuf, count, dtype):
+    _wait_reqs(neighbor_allgather_reqs(comm, sendbuf, recvbuf, count,
+                                       dtype))
+
+
+def neighbor_alltoall_linear(comm, sendbuf, recvbuf, count, dtype):
+    _wait_reqs(neighbor_alltoall_reqs(comm, sendbuf, recvbuf, count,
+                                      dtype))
+
+
+def neighbor_allgatherv_linear(comm, sendbuf, recvbuf, count, dtype,
+                               rcounts, rdispls):
+    _wait_reqs(neighbor_allgatherv_reqs(comm, sendbuf, recvbuf, count,
+                                        dtype, rcounts, rdispls))
+
+
+def neighbor_alltoallv_linear(comm, sendbuf, recvbuf, dtype, scounts,
+                              sdispls, rcounts, rdispls):
+    _wait_reqs(neighbor_alltoallv_reqs(comm, sendbuf, recvbuf, dtype,
+                                       scounts, sdispls, rcounts,
+                                       rdispls))

@@ -446,6 +446,41 @@ class PersistentCollRequest(rq.Request):
 
 # -- component ------------------------------------------------------------
 
+# -- nonblocking neighborhood (ineighbor_allgather.c family): one
+# linear round over the topology's neighbor lists, posted at start --
+
+def _sched_neighbor(comm, reqs):
+    yield reqs
+
+
+def ineighbor_allgather(comm, sendbuf, recvbuf, count, dtype):
+    return NbcRequest(_sched_neighbor(
+        comm, B.neighbor_allgather_reqs(comm, sendbuf, recvbuf,
+                                        count, dtype)))
+
+
+def ineighbor_alltoall(comm, sendbuf, recvbuf, count, dtype):
+    return NbcRequest(_sched_neighbor(
+        comm, B.neighbor_alltoall_reqs(comm, sendbuf, recvbuf,
+                                       count, dtype)))
+
+
+def ineighbor_allgatherv(comm, sendbuf, recvbuf, count, dtype,
+                         rcounts, rdispls):
+    return NbcRequest(_sched_neighbor(
+        comm, B.neighbor_allgatherv_reqs(comm, sendbuf, recvbuf,
+                                         count, dtype, rcounts,
+                                         rdispls)))
+
+
+def ineighbor_alltoallv(comm, sendbuf, recvbuf, dtype, scounts,
+                        sdispls, rcounts, rdispls):
+    return NbcRequest(_sched_neighbor(
+        comm, B.neighbor_alltoallv_reqs(comm, sendbuf, recvbuf,
+                                        dtype, scounts, sdispls,
+                                        rcounts, rdispls)))
+
+
 def ibarrier(comm):
     return NbcRequest(_sched_barrier(comm, _tag(comm)))
 
@@ -594,6 +629,10 @@ class CollLibnbc(CollModule):
             "iexscan": iexscan,
             "ireduce_scatter": ireduce_scatter,
             "ireduce_scatter_block": ireduce_scatter_block,
+            "ineighbor_allgather": ineighbor_allgather,
+            "ineighbor_alltoall": ineighbor_alltoall,
+            "ineighbor_allgatherv": ineighbor_allgatherv,
+            "ineighbor_alltoallv": ineighbor_alltoallv,
             # MPI-4 persistent collectives
             "barrier_init": barrier_init,
             "bcast_init": bcast_init,

@@ -470,6 +470,14 @@ def packed_displs(counts) -> list:
         [[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
 
 
+def _norm_cd(counts, displs):
+    """Normalized (counts, displs) for a v-variant: plain ints,
+    displs defaulting to the packed layout."""
+    counts = [int(c) for c in counts]
+    return counts, (packed_displs(counts) if displs is None
+                    else [int(d) for d in displs])
+
+
 def _require_packed_displs(counts, displs, what: str) -> None:
     """Device v-variants slice the send buffer as PACKED segments; a
     caller-supplied send-side displacement layout would silently move
@@ -477,9 +485,8 @@ def _require_packed_displs(counts, displs, what: str) -> None:
     layout concept — device results come back packed by design)."""
     if displs is None:
         return
-    packed = np.concatenate(
-        [[0], np.cumsum(np.asarray(counts[:-1]))]).tolist()
-    if list(displs) != packed:
+    packed = packed_displs(counts)
+    if [int(d) for d in displs] != packed:
         raise ValueError(
             f"{what}: the device path requires packed send "
             f"displacements {packed}, got {list(displs)}; stage to "
@@ -594,7 +601,7 @@ def _Gatherv(self, sendbuf, recvbuf, counts, displs=None,
     sarr = _parse_buf(sendbuf)[0]
     rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
     if displs is None:
-        displs = np.concatenate([[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
+        displs = packed_displs(counts)
     self.coll.gatherv(self, sarr, rarr, counts, displs,
                       dtype_of(sarr), root)
 
@@ -627,7 +634,7 @@ def _Scatterv(self, sendbuf, recvbuf, counts, displs=None,
     rarr = _parse_buf(recvbuf)[0]
     sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
     if displs is None:
-        displs = np.concatenate([[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
+        displs = packed_displs(counts)
     self.coll.scatterv(self, sarr, rarr, counts, displs,
                        dtype_of(rarr), root)
 
@@ -839,7 +846,7 @@ def _Igatherv(self, sendbuf, recvbuf, counts, displs=None,
     sarr = _parse_buf(sendbuf)[0]
     rarr = None if recvbuf is None else _parse_buf(recvbuf)[0]
     if displs is None:
-        displs = np.concatenate([[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
+        displs = packed_displs(counts)
     return self.coll.igatherv(self, sarr, rarr, counts, displs,
                               dtype_of(sarr), root)
 
@@ -853,7 +860,7 @@ def _Iscatterv(self, sendbuf, recvbuf, counts, displs=None,
     rarr = _parse_buf(recvbuf)[0]
     sarr = None if sendbuf is None else _parse_buf(sendbuf)[0]
     if displs is None:
-        displs = np.concatenate([[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
+        displs = packed_displs(counts)
     return self.coll.iscatterv(self, sarr, rarr, counts, displs,
                                dtype_of(rarr), root)
 
@@ -865,8 +872,7 @@ def _Iallgatherv(self, sendbuf, recvbuf, counts,
     sarr = IN_PLACE if sendbuf is IN_PLACE else _parse_buf(sendbuf)[0]
     rarr = _parse_buf(recvbuf)[0]
     if displs is None:
-        displs = np.concatenate(
-            [[0], np.cumsum(counts[:-1], dtype=np.intp)]).tolist()
+        displs = packed_displs(counts)
     return self.coll.iallgatherv(self, sarr, rarr, counts, displs,
                                  dtype_of(rarr))
 

@@ -336,16 +336,10 @@ def _Dist_graph_neighbors(self):
 
 # -- neighborhood collectives (dispatch into the coll table) --------------
 
-def _Neighbor_allgather(self, sendbuf, recvbuf=None):
-    """Device path (jax sendbuf, recvbuf omitted): compiled ppermute
-    schedule on the device plane, returns a NEW (n_in, *shape) array
-    (coll/xla_neighbor; staging fallback when the plane is off)."""
-    self.check_revoked()
-    from ompi_tpu.mpi import _is_dev, _parse_buf, _require_recvbuf
+def _nbr_allgather_args(self, sendbuf, recvbuf, what):
+    from ompi_tpu.mpi import _parse_buf, _require_recvbuf
 
-    if _is_dev(sendbuf):
-        return self.coll.neighbor_allgather_dev(self, sendbuf)
-    _require_recvbuf(recvbuf, "Neighbor_allgather")
+    _require_recvbuf(recvbuf, what)
     sarr, count, dt = _parse_buf(sendbuf)
     rarr, _, rdt = _parse_buf(recvbuf)
     # a receive-only rank's sendbuf is empty: take the per-edge count
@@ -354,18 +348,13 @@ def _Neighbor_allgather(self, sendbuf, recvbuf=None):
     if count == 0 and n_in:
         count = np.asarray(rarr).size // n_in
         dt = rdt
-    self.coll.neighbor_allgather(self, sarr, rarr, count, dt)
+    return sarr, rarr, count, dt
 
 
-def _Neighbor_alltoall(self, sendbuf, recvbuf=None):
-    """Device path (jax sendbuf of shape (n_out, *blk), recvbuf
-    omitted): returns a NEW (n_in, *blk) device array."""
-    self.check_revoked()
-    from ompi_tpu.mpi import _is_dev, _parse_buf, _require_recvbuf
+def _nbr_alltoall_args(self, sendbuf, recvbuf, what):
+    from ompi_tpu.mpi import _parse_buf, _require_recvbuf
 
-    if _is_dev(sendbuf):
-        return self.coll.neighbor_alltoall_dev(self, sendbuf)
-    _require_recvbuf(recvbuf, "Neighbor_alltoall")
+    _require_recvbuf(recvbuf, what)
     sarr, _, dt = _parse_buf(sendbuf)
     rarr = _parse_buf(recvbuf)[0]
     # per-edge count: derive from whichever side has edges (a
@@ -378,7 +367,64 @@ def _Neighbor_alltoall(self, sendbuf, recvbuf=None):
         count = np.asarray(rarr).size // n_in
     else:
         count = 0
+    return sarr, rarr, count, dt
+
+
+def _Neighbor_allgather(self, sendbuf, recvbuf=None):
+    """Device path (jax sendbuf, recvbuf omitted): compiled ppermute
+    schedule on the device plane, returns a NEW (n_in, *shape) array
+    (coll/xla_neighbor; staging fallback when the plane is off)."""
+    self.check_revoked()
+    from ompi_tpu.mpi import _is_dev
+
+    if _is_dev(sendbuf):
+        return self.coll.neighbor_allgather_dev(self, sendbuf)
+    sarr, rarr, count, dt = _nbr_allgather_args(
+        self, sendbuf, recvbuf, "Neighbor_allgather")
+    self.coll.neighbor_allgather(self, sarr, rarr, count, dt)
+
+
+def _Ineighbor_allgather(self, sendbuf, recvbuf=None):
+    """MPI_Ineighbor_allgather (ompi/mpi/c/ineighbor_allgather.c):
+    nonblocking; recvbuf fills at completion."""
+    self.check_revoked()
+    sarr, rarr, count, dt = _nbr_allgather_args(
+        self, sendbuf, recvbuf, "Ineighbor_allgather")
+    return self.coll.ineighbor_allgather(self, sarr, rarr, count, dt)
+
+
+def _Neighbor_alltoall(self, sendbuf, recvbuf=None):
+    """Device path (jax sendbuf of shape (n_out, *blk), recvbuf
+    omitted): returns a NEW (n_in, *blk) device array."""
+    self.check_revoked()
+    from ompi_tpu.mpi import _is_dev
+
+    if _is_dev(sendbuf):
+        return self.coll.neighbor_alltoall_dev(self, sendbuf)
+    sarr, rarr, count, dt = _nbr_alltoall_args(
+        self, sendbuf, recvbuf, "Neighbor_alltoall")
     self.coll.neighbor_alltoall(self, sarr, rarr, count, dt)
+
+
+def _Ineighbor_alltoall(self, sendbuf, recvbuf=None):
+    """MPI_Ineighbor_alltoall (ompi/mpi/c/ineighbor_alltoall.c)."""
+    self.check_revoked()
+    sarr, rarr, count, dt = _nbr_alltoall_args(
+        self, sendbuf, recvbuf, "Ineighbor_alltoall")
+    return self.coll.ineighbor_alltoall(self, sarr, rarr, count, dt)
+
+
+def _nbr_v_common(sendbuf, recvbuf, what):
+    from ompi_tpu.mpi import _is_dev, _parse_buf, _require_recvbuf
+
+    if _is_dev(sendbuf):
+        raise NotImplementedError(
+            f"{what} has no device route; stage with np.asarray "
+            "(the uniform neighborhood forms have one)")
+    _require_recvbuf(recvbuf, what)
+    sarr, count, dt = _parse_buf(sendbuf)
+    rarr, _, rdt = _parse_buf(recvbuf)
+    return sarr, rarr, count, dt or rdt
 
 
 def _Neighbor_allgatherv(self, sendbuf, recvbuf, rcounts,
@@ -387,22 +433,26 @@ def _Neighbor_allgatherv(self, sendbuf, recvbuf, rcounts,
     (counts/displs in element units; displs default to packed). Host
     buffers only — stage device arrays with np.asarray."""
     self.check_revoked()
-    from ompi_tpu.mpi import _is_dev, _parse_buf, _require_recvbuf
+    from ompi_tpu.mpi import _norm_cd
 
-    if _is_dev(sendbuf):
-        raise NotImplementedError(
-            "Neighbor_allgatherv has no device route; stage with "
-            "np.asarray (the uniform Neighbor_allgather has one)")
-    _require_recvbuf(recvbuf, "Neighbor_allgatherv")
-    sarr, count, dt = _parse_buf(sendbuf)
-    rarr, _, rdt = _parse_buf(recvbuf)
-    from ompi_tpu.mpi import packed_displs
+    sarr, rarr, count, dt = _nbr_v_common(sendbuf, recvbuf,
+                                          "Neighbor_allgatherv")
+    rcounts, rdispls = _norm_cd(rcounts, rdispls)
+    self.coll.neighbor_allgatherv(self, sarr, rarr, count, dt,
+                                  rcounts, rdispls)
 
-    rcounts = [int(c) for c in rcounts]
-    rdispls = (packed_displs(rcounts) if rdispls is None
-               else [int(d) for d in rdispls])
-    self.coll.neighbor_allgatherv(self, sarr, rarr, count,
-                                  dt or rdt, rcounts, rdispls)
+
+def _Ineighbor_allgatherv(self, sendbuf, recvbuf, rcounts,
+                          rdispls=None):
+    """MPI_Ineighbor_allgatherv (nonblocking form)."""
+    self.check_revoked()
+    from ompi_tpu.mpi import _norm_cd
+
+    sarr, rarr, count, dt = _nbr_v_common(sendbuf, recvbuf,
+                                          "Ineighbor_allgatherv")
+    rcounts, rdispls = _norm_cd(rcounts, rdispls)
+    return self.coll.ineighbor_allgatherv(self, sarr, rarr, count,
+                                          dt, rcounts, rdispls)
 
 
 def _Neighbor_alltoallv(self, sendbuf, recvbuf, scounts, rcounts,
@@ -410,25 +460,29 @@ def _Neighbor_alltoallv(self, sendbuf, recvbuf, scounts, rcounts,
     """MPI_Neighbor_alltoallv: ragged per-edge segments (element
     units; displs default to packed). Host buffers only."""
     self.check_revoked()
-    from ompi_tpu.mpi import _is_dev, _parse_buf, _require_recvbuf
+    from ompi_tpu.mpi import _norm_cd
 
-    if _is_dev(sendbuf):
-        raise NotImplementedError(
-            "Neighbor_alltoallv has no device route; stage with "
-            "np.asarray (the uniform Neighbor_alltoall has one)")
-    _require_recvbuf(recvbuf, "Neighbor_alltoallv")
-    sarr, _, dt = _parse_buf(sendbuf)
-    rarr, _, rdt = _parse_buf(recvbuf)
-    from ompi_tpu.mpi import packed_displs
-
-    scounts = [int(c) for c in scounts]
-    rcounts = [int(c) for c in rcounts]
-    sdispls = (packed_displs(scounts) if sdispls is None
-               else [int(d) for d in sdispls])
-    rdispls = (packed_displs(rcounts) if rdispls is None
-               else [int(d) for d in rdispls])
-    self.coll.neighbor_alltoallv(self, sarr, rarr, dt or rdt,
+    sarr, rarr, _, dt = _nbr_v_common(sendbuf, recvbuf,
+                                      "Neighbor_alltoallv")
+    scounts, sdispls = _norm_cd(scounts, sdispls)
+    rcounts, rdispls = _norm_cd(rcounts, rdispls)
+    self.coll.neighbor_alltoallv(self, sarr, rarr, dt,
                                  scounts, sdispls, rcounts, rdispls)
+
+
+def _Ineighbor_alltoallv(self, sendbuf, recvbuf, scounts, rcounts,
+                         sdispls=None, rdispls=None):
+    """MPI_Ineighbor_alltoallv (nonblocking form)."""
+    self.check_revoked()
+    from ompi_tpu.mpi import _norm_cd
+
+    sarr, rarr, _, dt = _nbr_v_common(sendbuf, recvbuf,
+                                      "Ineighbor_alltoallv")
+    scounts, sdispls = _norm_cd(scounts, sdispls)
+    rcounts, rdispls = _norm_cd(rcounts, rdispls)
+    return self.coll.ineighbor_alltoallv(self, sarr, rarr, dt,
+                                         scounts, sdispls, rcounts,
+                                         rdispls)
 
 
 _API = {
@@ -447,6 +501,10 @@ _API = {
     "Neighbor_alltoall": _Neighbor_alltoall,
     "Neighbor_allgatherv": _Neighbor_allgatherv,
     "Neighbor_alltoallv": _Neighbor_alltoallv,
+    "Ineighbor_allgather": _Ineighbor_allgather,
+    "Ineighbor_alltoall": _Ineighbor_alltoall,
+    "Ineighbor_allgatherv": _Ineighbor_allgatherv,
+    "Ineighbor_alltoallv": _Ineighbor_alltoallv,
 }
 
 for _name, _fn in _API.items():

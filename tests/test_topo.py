@@ -352,3 +352,45 @@ def test_neighbor_alltoallv_receive_only_rank():
                                  np.zeros(0, np.int32), [1], [])
         comm.Barrier()
     """, 3)
+
+
+def test_ineighbor_nonblocking_overlap():
+    """MPI_Ineighbor_allgather/alltoall: one linear round as a
+    progressed schedule; unrelated p2p overlaps before wait."""
+    run_ranks("""
+        cart = comm.Create_cart([size], periods=[True])
+        ins, outs = (cart.topo.in_neighbors(cart.rank),
+                     cart.topo.out_neighbors(cart.rank))
+        mine = np.full(4, float(rank), np.float64)
+        out = np.zeros((2, 4))
+        r1 = cart.Ineighbor_allgather(mine, out)
+        sb = np.stack([np.full(3, 10 * rank + j, np.float32)
+                       for j in range(2)])
+        rb = np.zeros((2, 3), np.float32)
+        r2 = cart.Ineighbor_alltoall(sb, rb)
+        # overlap p2p on the PARENT comm while schedules progress
+        peer = (rank + 1) % size
+        comm.send(("x", rank), dest=peer, tag=77)
+        assert comm.recv(source=(rank - 1) % size, tag=77) == \
+            ("x", (rank - 1) % size)
+        # v forms compose with the same wait machinery
+        vout = np.zeros(sum(s + 1 for s in ins), np.int32)
+        r3 = cart.Ineighbor_allgatherv(
+            np.full(rank + 1, rank, np.int32), vout,
+            [s + 1 for s in ins])
+        mpi.wait_all([r1, r2, r3])
+        pos = 0
+        for i, src in enumerate(ins):
+            assert (vout[pos:pos + src + 1] == src).all(), vout
+            pos += src + 1
+        for i, src in enumerate(ins):
+            assert (out[i] == float(src)).all(), out
+        for i, src in enumerate(ins):
+            # src sent me block j where I'm src's out-neighbor j;
+            # on a ring of size>2, my in-slot i pairs with src's
+            # out-slot i^1 (the conjugate direction)
+            j = cart.topo.out_neighbors(src).index(rank) \
+                if cart.topo.out_neighbors(src).count(rank) == 1 \
+                else i ^ 1
+            assert (rb[i] == 10 * src + j).all(), (i, src, rb)
+    """, 4)
