@@ -358,13 +358,69 @@ def _Sendrecv(self, sendbuf, dest: int, recvbuf, source: int = ANY_SOURCE,
 
 def _Sendrecv_replace(self, buf, dest: int, source: int = ANY_SOURCE,
                       sendtag: int = 0, recvtag: int = ANY_TAG) -> Status:
+    return _Isendrecv_replace(self, buf, dest, source, sendtag,
+                              recvtag).wait()
+
+
+class _PairRequest(rq.Request):
+    """One request over a (recv, send) pair — MPI-4's Isendrecv
+    handle: completes when BOTH complete; status is the receive's
+    (isendrecv.c exposes exactly that)."""
+
+    def __init__(self, rreq: rq.Request, sreq: rq.Request) -> None:
+        super().__init__()
+        self._rreq = rreq
+        self._sreq = sreq
+
+    @property
+    def completed(self) -> bool:  # live view; no progress callback
+        return self._rreq.completed and self._sreq.completed
+
+    @completed.setter
+    def completed(self, v: bool) -> None:
+        pass  # base __init__ writes here; the property is derived
+
+    @property
+    def status(self) -> Status:
+        return self._rreq.status
+
+    @status.setter
+    def status(self, st) -> None:
+        pass
+
+    def wait(self, timeout=None) -> Status:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        st = self._rreq.wait(timeout=timeout)
+        rem = (None if timeout is None else
+               max(0.0, timeout - (_time.perf_counter() - t0)))
+        self._sreq.wait(timeout=rem)  # one budget for BOTH halves
+        return st
+
+
+def _Isendrecv(self, sendbuf, dest: int, recvbuf,
+               source: int = ANY_SOURCE, sendtag: int = 0,
+               recvtag: int = ANY_TAG) -> rq.Request:
+    """MPI_Isendrecv (MPI-4, ompi/mpi/c/isendrecv.c): both halves
+    post now; the returned request completes when both do."""
+    rreq = _Irecv(self, recvbuf, source, recvtag)
+    sreq = _Isend(self, sendbuf, dest, sendtag)
+    return _PairRequest(rreq, sreq)
+
+
+def _Isendrecv_replace(self, buf, dest: int, source: int = ANY_SOURCE,
+                       sendtag: int = 0,
+                       recvtag: int = ANY_TAG) -> rq.Request:
+    """MPI_Isendrecv_replace (MPI-4): the send snapshot is taken NOW
+    (the receive overwrites ``buf`` as it lands). Routed through the
+    _Irecv/_Isend wrappers so revoked-comm checks and errhandler
+    stamping apply like every other p2p entry."""
     arr, count, dt = _parse_buf(buf)
     tmp = np.array(arr, copy=True)
-    rreq = pml.current().irecv(self, arr, count, dt, source, recvtag)
-    sreq = pml.current().isend(self, tmp, count, dt, dest, sendtag)
-    st = rreq.wait()
-    sreq.wait()
-    return st
+    rreq = _Irecv(self, (arr, count, dt), source, recvtag)
+    sreq = _Isend(self, (tmp, count, dt), dest, sendtag)
+    return _PairRequest(rreq, sreq)
 
 
 # -- probe family --
@@ -1126,6 +1182,7 @@ _API = {
     "Send": _Send, "Isend": _Isend, "Ssend": _Ssend, "Issend": _Issend,
     "Rsend": _Rsend, "Bsend": _Bsend, "Recv": _Recv, "Irecv": _Irecv,
     "Sendrecv": _Sendrecv, "Sendrecv_replace": _Sendrecv_replace,
+    "Isendrecv": _Isendrecv, "Isendrecv_replace": _Isendrecv_replace,
     "Probe": _Probe, "Iprobe": _Iprobe, "Mprobe": _Mprobe,
     "Improbe": _Improbe, "Mrecv": _Mrecv,
     "Send_init": _Send_init, "Recv_init": _Recv_init,

@@ -268,3 +268,27 @@ def test_bind_to_core():
         assert "bound to" in proc.stdout
     finally:
         _os.unlink(path)
+
+
+def test_isendrecv_and_replace():
+    """MPI-4 MPI_Isendrecv / Isendrecv_replace: both halves post at
+    call time, one request completes when both do, status is the
+    receive's; the replace form snapshots the send before the
+    receive overwrites."""
+    run_ranks("""
+        peer = 1 - rank
+        sb = np.full(8, float(rank + 1), np.float64)
+        rb = np.zeros(8)
+        req = comm.Isendrecv(sb, peer, rb, source=peer,
+                             sendtag=3, recvtag=3)
+        st = req.wait(timeout=60)
+        assert (rb == peer + 1).all(), rb
+        assert st.source == peer and st.tag == 3
+        assert req.completed
+        # replace: buf swaps with the peer's
+        buf = np.full(4, 100 + rank, np.int32)
+        r2 = comm.Isendrecv_replace(buf, peer, source=peer, sendtag=4,
+                                    recvtag=4)
+        mpi.wait_all([r2])
+        assert (buf == 100 + peer).all(), buf
+    """, 2)
