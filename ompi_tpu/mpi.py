@@ -286,6 +286,66 @@ def _Rsend(self, buf, dest: int, tag: int = 0) -> None:
     _Send(self, buf, dest, tag)
 
 
+#: MPI_BSEND_OVERHEAD: per-message bookkeeping charge against an
+#: attached buffer (the reference's envelope/header share)
+BSEND_OVERHEAD = 64
+
+#: None = no buffer attached: the framework buffers IMPLICITLY and
+#: without bound (documented Pythonic extension — the copies are heap
+#: allocations, not slices of a user arena). Attaching a buffer opts
+#: into the strict MPI capacity contract.
+_bsend_capacity: Optional[int] = None
+
+
+def Buffer_attach(buf_or_size) -> None:
+    """MPI_Buffer_attach (ompi/mpi/c/buffer_attach.c): cap buffered-
+    send memory. Accepts a byte count or a buffer object (only its
+    SIZE matters here — copies are heap-allocated, not packed into
+    the arena). With a buffer attached, Bsend raises ERR_BUFFER when
+    outstanding copies would exceed the capacity."""
+    global _bsend_capacity
+    if _bsend_capacity is not None:
+        raise errors.MPIError(errors.ERR_BUFFER,
+                              "a bsend buffer is already attached")
+    import numbers
+
+    # numbers.Integral catches numpy ints too — a np.int64 exposes
+    # the buffer protocol and would otherwise attach as 8 bytes
+    size = (int(buf_or_size)
+            if isinstance(buf_or_size, numbers.Integral)
+            else memoryview(buf_or_size).nbytes)
+    if size < 0:
+        raise errors.MPIError(errors.ERR_BUFFER,
+                              f"negative buffer size {size}")
+    _bsend_capacity = size
+
+
+def Buffer_detach() -> int:
+    """MPI_Buffer_detach: BLOCKS until every outstanding buffered
+    send delivers (the MPI contract), then returns the detached
+    size."""
+    global _bsend_capacity
+    if _bsend_capacity is None:
+        raise errors.MPIError(errors.ERR_BUFFER,
+                              "no bsend buffer attached")
+    _flush_bsends()
+    size, _bsend_capacity = _bsend_capacity, None
+    return size
+
+
+def _bsend_used() -> int:
+    """Reclaim delivered copies, then report the live charge. One
+    progress sweep first: rndv completions only flip inside a sweep,
+    and MPI reclaims delivered-message space before failing a
+    Bsend."""
+    from ompi_tpu.core import progress
+
+    progress.progress()
+    live = [(r, nb) for r, nb in _pending_bsends if not r.completed]
+    _pending_bsends[:] = live
+    return sum(nb for _, nb in live)
+
+
 def _Bsend(self, buf, dest: int, tag: int = 0) -> None:
     """Buffered send: copy now, deliver in background."""
     arr, count, dt = _parse_buf(buf)
@@ -294,8 +354,16 @@ def _Bsend(self, buf, dest: int, tag: int = 0) -> None:
     else:  # raw buffer: keep byte semantics (dtype_of(bytes) would
         # infer an S-dtype and inflate the size)
         copy = np.frombuffer(bytes(arr), dtype=np.uint8).copy()
+    charge = copy.nbytes + BSEND_OVERHEAD
+    if _bsend_capacity is not None and \
+            _bsend_used() + charge > _bsend_capacity:
+        raise errors.MPIError(
+            errors.ERR_BUFFER,
+            f"bsend of {copy.nbytes} bytes exceeds the attached "
+            f"buffer ({_bsend_capacity} bytes, "
+            f"{_bsend_used()} in flight)")
     req = pml.current().isend(self, copy, count, dt, dest, tag)
-    _pending_bsends.append(req)
+    _pending_bsends.append((req, charge))
 
 
 def _Recv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
@@ -1154,11 +1222,11 @@ def _with_errhandler(fn):
     return wrapped
 
 
-_pending_bsends: List[rq.Request] = []
+_pending_bsends: List[Tuple[rq.Request, int]] = []
 
 
 def _flush_bsends() -> None:
-    for r in list(_pending_bsends):
+    for r, _ in list(_pending_bsends):
         r.wait()
     _pending_bsends.clear()
 

@@ -292,3 +292,40 @@ def test_isendrecv_and_replace():
         mpi.wait_all([r2])
         assert (buf == 100 + peer).all(), buf
     """, 2)
+
+
+def test_buffer_attach_detach_capacity():
+    """MPI_Buffer_attach/detach: with a buffer attached Bsend
+    enforces capacity (ERR_BUFFER past it) and detach blocks until
+    outstanding buffered sends deliver; without one the implicit
+    unbounded buffering extension stays."""
+    run_ranks("""
+        from ompi_tpu import errors
+        peer = 1 - rank
+        n = 1 << 20  # above the eager limit: the bsend stays IN
+        # FLIGHT (rndv waits for the receiver), holding its charge
+        cap = n + mpi.BSEND_OVERHEAD
+        if rank == 0:
+            mpi.Buffer_attach(cap)
+            try:
+                mpi.Buffer_attach(64)
+                raise SystemExit("double attach allowed")
+            except errors.MPIError:
+                pass
+            comm.Bsend(np.zeros(n, np.uint8), dest=1, tag=1)
+            try:  # capacity fully held by the in-flight rndv
+                comm.Bsend(np.zeros(4, np.uint8), dest=1, tag=2)
+                raise SystemExit("over-capacity bsend accepted")
+            except errors.MPIError as e:
+                assert e.error_class == errors.ERR_BUFFER
+            comm.Send(np.zeros(1, np.uint8), dest=1, tag=5)  # go
+            assert mpi.Buffer_detach() == cap  # blocks till delivered
+            # detached: implicit unbounded buffering again
+            comm.Bsend(np.zeros(4, np.uint8), dest=1, tag=3)
+        else:
+            comm.Recv(np.zeros(1, np.uint8), source=0, tag=5)
+            big = np.zeros(n, np.uint8)
+            comm.Recv(big, source=0, tag=1)
+            comm.Recv(np.zeros(4, np.uint8), source=0, tag=3)
+        comm.Barrier()
+    """, 2)
