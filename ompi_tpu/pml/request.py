@@ -72,6 +72,51 @@ class Status:
             rem -= take
         return elems
 
+    def set_elements(self, datatype, count: int) -> None:
+        """MPI_Status_set_elements (ompi/mpi/c/status_set_elements.c):
+        sets the opaque byte count so a later get_elements returns
+        exactly ``count`` BASIC elements (generalized-request
+        query_fns report their app-defined transfer this way). For
+        derived types the byte total walks the element decomposition,
+        so get_count floors to whole top-level elements consistently."""
+        count = int(count)
+        if datatype is None or datatype.size == 0:
+            self.count = count
+            return
+        from ompi_tpu.datatype.datatype import element_pattern
+
+        pat = element_pattern(datatype)
+        if pat is None:  # no decomposition known: one element = one
+            self.count = count * datatype.size  # datatype (best fit)
+            return
+        period = sum(nb for nb, _ in pat)
+        per_period = sum(ne for _, ne in pat) or 1
+        full, rem = divmod(count, per_period)
+        nbytes = full * period
+        for nb, ne in pat:
+            if rem <= 0:
+                break
+            if ne == 0:  # padding crossed en route to more elements
+                nbytes += nb
+                continue
+            take = min(ne, rem)
+            nbytes += take * (nb // ne)
+            rem -= take
+        self.count = nbytes
+
+    def set_cancelled(self, flag: bool) -> None:
+        """MPI_Status_set_cancelled."""
+        self.cancelled = bool(flag)
+
+    def is_cancelled(self) -> bool:
+        """MPI_Test_cancelled."""
+        return self.cancelled
+
+    # mpi4py-convention aliases (the capitalized binding names)
+    Set_elements = set_elements
+    Set_cancelled = set_cancelled
+    Is_cancelled = is_cancelled
+
     def __repr__(self) -> str:
         return (f"Status(source={self.source}, tag={self.tag}, "
                 f"count={self.count})")

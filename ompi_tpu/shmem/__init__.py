@@ -299,11 +299,15 @@ class Ctx:
     local; a program creating contexts on a subset of PEs must use
     the default context on the others or restructure."""
 
-    def __init__(self) -> None:
+    def __init__(self, comm=None) -> None:
         from ompi_tpu import osc
 
         st = _require()
-        self.win = osc.win_create(st.comm, st.heap_arr, disp_unit=1)
+        # a team-scoped context (shmem_team_create_ctx) windows over
+        # the TEAM's comm: its ops address team-relative PE numbers
+        self.win = osc.win_create(comm if comm is not None
+                                  else st.comm,
+                                  st.heap_arr, disp_unit=1)
         self.win.Lock_all()
         self._open = True
 
@@ -452,6 +456,12 @@ class Team:
     # pre-1.5 naming kept for symmetry with the world forms
     def sum_to_all(self, dest: SymArray, source: SymArray) -> None:
         self.reduce(dest, source, op_mod.SUM)
+
+    def create_ctx(self) -> "Ctx":
+        """shmem_team_create_ctx (SHMEM 1.5; COLLECTIVE over the
+        team, per this module's ctx divergence note): ops on the
+        returned context address TEAM-relative PE numbers."""
+        return Ctx(self._comm)
 
     def destroy(self) -> None:
         self._comm.free()

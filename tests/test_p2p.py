@@ -329,3 +329,35 @@ def test_buffer_attach_detach_capacity():
             comm.Recv(np.zeros(4, np.uint8), source=0, tag=3)
         comm.Barrier()
     """, 2)
+
+
+def test_status_setters_with_grequest():
+    """MPI_Status_set_elements/set_cancelled + MPI_Test_cancelled:
+    the generalized-request query_fn hook point
+    (status_set_elements.c; grequest.c query contract)."""
+    from ompi_tpu import mpi
+    from ompi_tpu.datatype import DOUBLE
+
+    def query(st):
+        st.Set_elements(DOUBLE, 3)
+
+    req = mpi.Grequest_start(query_fn=query)
+    req.complete()
+    st = req.wait()
+    assert st.get_count(DOUBLE) == 3
+    assert st.get_elements(DOUBLE) == 3
+    assert not st.Is_cancelled()
+    st.Set_cancelled(True)
+    assert st.is_cancelled()  # snake + Capitalized are one method
+    # derived type: count is BASIC elements (MPI_GET_ELEMENTS
+    # round-trips exactly; get_count floors to whole vectors)
+    from ompi_tpu.datatype import vector
+
+    v = vector(4, 1, 2, DOUBLE)  # 4 doubles packed per element
+    st2 = mpi.Status()
+    st2.set_elements(v, 12)
+    assert st2.get_elements(v) == 12
+    assert st2.get_count(v) == 3
+    st2.set_elements(v, 6)       # 1.5 vectors
+    assert st2.get_elements(v) == 6
+    assert st2.get_count(v) == 1

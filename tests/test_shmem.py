@@ -424,3 +424,33 @@ def test_team_split_2d_row_col():
     shmem.barrier_all()
     shmem.finalize()
     """, 4)
+
+
+def test_team_create_ctx_team_relative_pes():
+    """shmem_team_create_ctx: a context scoped to a sub-team
+    addresses TEAM-relative PE numbers; its quiet is independent of
+    the default context."""
+    run_ranks("""
+    from ompi_tpu import shmem
+    shmem.init()
+    me, n = shmem.my_pe(), shmem.n_pes()
+    world = shmem.team_world()
+    sub = shmem.team_split_strided(world, 0, 1, 2)  # PEs 0,1
+    d = shmem.zeros(4, np.int64)
+    if me < 2:
+        ctx = sub.create_ctx()
+        t = sub.my_pe()
+        peer = 1 - t                       # TEAM-relative target
+        ctx.put(d, 500 + t, peer, index=t)
+        ctx.quiet()
+        sub.sync()
+        # peer (team pe 1-t) wrote slot (1-t) of MY d
+        assert d.local[1 - t] == 500 + (1 - t), d.local
+        # I wrote peer's slot t: read it back remotely
+        got = ctx.get(d, peer, count=1, index=t)
+        assert got[0] == 500 + t, got
+        ctx.destroy()
+        sub.destroy()
+    shmem.barrier_all()
+    shmem.finalize()
+    """, 4)
