@@ -252,6 +252,24 @@ class Datatype(AttrHost):
         self.cargs = ((), (), ())
 
     # -- introspection (MPI_Type_size / get_extent) ----------------------
+    def Get_size(self) -> int:
+        """MPI_Type_size: significant (non-gap) bytes per element."""
+        return self.size
+
+    def Get_extent(self) -> Tuple[int, int]:
+        """MPI_Type_get_extent -> (lb, extent)."""
+        return self.lb, self.extent
+
+    def Get_true_extent(self) -> Tuple[int, int]:
+        """MPI_Type_get_true_extent -> (true_lb, true_extent): the
+        span of bytes the type ACTUALLY touches, ignoring lb/ub
+        markers and resizing (type_get_true_extent.c)."""
+        if len(self.spans) == 0:
+            return 0, 0
+        lo = int(self.spans[:, 0].min())
+        hi = int((self.spans[:, 0] + self.spans[:, 1]).max())
+        return lo, hi - lo
+
     @property
     def ub(self) -> int:
         return self.lb + self.extent

@@ -180,6 +180,16 @@ class File:
     def Get_view(self) -> Tuple[int, dt_mod.Datatype, dt_mod.Datatype]:
         return self.view.disp, self.view.etype, self.view.filetype
 
+    def Get_byte_offset(self, offset: int) -> int:
+        """MPI_File_get_byte_offset: absolute file byte of a view
+        offset (etype units) — file_get_byte_offset.c."""
+        return self.view.map(self._off_bytes(offset), 1)[0][0]
+
+    def Get_type_extent(self, datatype: dt_mod.Datatype) -> int:
+        """MPI_File_get_type_extent (native representation: memory
+        extent, file_get_type_extent.c)."""
+        return datatype.extent
+
     # -- errhandler plane (MPI_File_set_errhandler) -----------------------
     def Set_errhandler(self, eh) -> None:
         self.errhandler = eh

@@ -212,3 +212,30 @@ def test_darray_fileview_collective_io(tmp_path):
         np.testing.assert_array_equal(back.reshape(4, 4), local)
         f.Close()
     """, 4, timeout=120)
+
+
+def test_type_and_file_query_methods(tmp_path):
+    """MPI_Type_size/get_extent/get_true_extent and
+    MPI_File_get_byte_offset/get_type_extent."""
+    v = D.vector(3, 2, 4, D.FLOAT)
+    assert v.Get_size() == 24
+    assert v.Get_extent() == (0, 40)  # ub = (3-1)*16 + 8
+    assert v.Get_true_extent() == (0, 40)
+    rz = D.resized(v, -8, 64)
+    assert rz.Get_extent() == (-8, 64)
+    assert rz.Get_true_extent() == (0, 40)  # markers ignored
+
+    from ompi_tpu import io as io_mod
+    from ompi_tpu import mpi
+
+    comm = mpi.Init()
+    f = io_mod.File_open(comm, str(tmp_path / "q.bin"),
+                         io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+    ft = D.vector(4, 1, 2, D.INT32)  # every other int32
+    f.Set_view(disp=8, etype=D.INT32, filetype=ft)
+    # view offset 1 (etypes) = second visible int32 = file byte
+    # 8 (disp) + 8 (skip one 2-int32 tile stride)
+    assert f.Get_byte_offset(0) == 8
+    assert f.Get_byte_offset(1) == 16
+    assert f.Get_type_extent(ft) == ft.extent
+    f.Close()
