@@ -198,7 +198,9 @@ class AgreeRequest(_rq.Request):
                 client.close()
             self._outcome = ("ok", _decide(contribs, dead,
                                            self.comm.group.ranks))
-        except Exception as exc:  # store down == job down; surface it
+        except BaseException as exc:  # store down / job abort
+            # (SystemExit included: it must not die silently in this
+            # helper thread — it re-raises at the request's wait)
             self._outcome = ("err", exc)
 
     def _harvest(self) -> int:

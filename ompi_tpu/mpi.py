@@ -1421,6 +1421,16 @@ def Comm_create_from_group(group, tag: str = "org.ompi_tpu.default"):
     return comm_create_from_group(group, tag)
 
 
+def Abort(comm=None, errorcode: int = 1) -> None:
+    """MPI_Abort: bring the job down through the runtime — the store
+    broadcasts the abort and the launcher kills every rank (the
+    reference routes through the PRRTE daemons the same way)."""
+    from ompi_tpu.runtime import state
+
+    state.abort(errorcode,
+                f"MPI_Abort on {getattr(comm, 'name', 'the job')}")
+
+
 def Finalize() -> None:
     from ompi_tpu.runtime import state
 

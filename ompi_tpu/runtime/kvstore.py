@@ -20,8 +20,8 @@ Commands:
       negatives); base is the first world rank of the fencing world
       (FT dead-release only counts ranks in [base, base+nprocs))
   ("inc", key, amount)           -> ("val", new_value)   # atomic counter
-  ("abort", rank, reason)        -> ("ok",)  # marks job aborted
-  ("aborted?",)                  -> ("val", reason | None)
+  ("abort", rank, reason, code)  -> ("ok",)  # marks job aborted
+  ("aborted?",)                  -> ("val", (reason, code) | None)
 
 Fault tolerance (the PRRTE-daemon side of ULFM — the reference delegates
 runtime-level failure detection to PRTE, docs/features/ulfm.rst:260-262;
@@ -79,7 +79,7 @@ class Store:
         self._counters: Dict[str, int] = {}
         self._fences: Dict[str, list] = {}  # tag -> [arrived, released]
         self._cond = threading.Condition()
-        self._aborted: Optional[str] = None
+        self._aborted = None  # (reason, exit code) when aborted
         # fault state: declared-dead ranks (monotonic — once failed,
         # always failed, per ULFM semantics) + last heartbeat times
         self._dead: Dict[int, str] = {}
@@ -187,9 +187,10 @@ class Store:
                 self._counters[key] = self._counters.get(key, 0) + amount
                 return ("val", self._counters[key])
         if op == "abort":
-            _, rank, reason = msg
+            _, rank, reason = msg[:3]
+            code = int(msg[3]) if len(msg) > 3 else 1
             with self._cond:
-                self._aborted = f"rank {rank}: {reason}"
+                self._aborted = (f"rank {rank}: {reason}", code)
                 self._cond.notify_all()
             return ("ok",)
         if op == "aborted?":
@@ -309,7 +310,12 @@ class Client:
             finally:
                 self._sock.settimeout(None)
         if reply[0] == "aborted":
-            raise RuntimeError(f"job aborted: {reply[1]}")
+            # the job is going down: exit THIS rank with the abort's
+            # errorcode so every rank reports it deterministically
+            # (SystemExit unwinds try/finally — daemons still reap)
+            reason, code = (reply[1] if isinstance(reply[1], tuple)
+                            else (reply[1], 1))
+            raise SystemExit(code or 1)
         if reply[0] == "err":
             raise RuntimeError(reply[1])
         return reply
@@ -345,9 +351,9 @@ class Client:
     def inc(self, key: str, amount: int = 1) -> int:
         return self._rpc("inc", key, amount)[1]
 
-    def abort(self, rank: int, reason: str) -> None:
+    def abort(self, rank: int, reason: str, code: int = 1) -> None:
         try:
-            self._rpc("abort", rank, reason)
+            self._rpc("abort", rank, reason, int(code))
         except Exception:
             pass
 

@@ -152,6 +152,11 @@ def next_id(space: str) -> int:
 
 
 def abort(reason: str, code: int = 1) -> None:
+    """Job abort: broadcast (reason, code) via the store — peers
+    blocked in store RPCs exit with the same code — then exit. A
+    code of 0 maps to exit 1: teardown rides the nonzero-exit path
+    (the launcher kills survivors on abnormal termination), so
+    MPI_Abort(comm, 0) must still bring the job down."""
     if _client is not None:
-        _client.abort(rank, reason)
-    os._exit(code)
+        _client.abort(rank, reason, code or 1)
+    os._exit(code or 1)

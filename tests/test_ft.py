@@ -223,3 +223,34 @@ def test_idup_with_dead_root_errors():
         except errors.MPIError:
             pass
     """, 3, mca=FT, timeout=90)
+
+
+def test_mpi_abort_kills_job():
+    """MPI_Abort: one rank aborts, the whole job comes down with the
+    given code (launcher/store teardown — the mpirun contract)."""
+    import subprocess
+    import sys
+
+    import os
+    import tempfile
+
+    body = (
+        "from ompi_tpu import mpi\n"
+        "comm = mpi.Init()\n"
+        "if comm.rank == 1:\n"
+        "    mpi.Abort(comm, errorcode=7)\n"
+        "import time\n"
+        "time.sleep(30)\n"  # survivors must be torn down, not finish
+    )
+    fd, path = tempfile.mkstemp(suffix=".py", prefix="ompitpu_abort_")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            fh.write(body)
+        r = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.runtime.launcher", "-n",
+             "3", "--timeout", "25", path], capture_output=True,
+            text=True, timeout=60)
+        # the abort's errorcode propagates as the job exit code
+        assert r.returncode == 7, (r.returncode, r.stderr[-500:])
+    finally:
+        os.unlink(path)
