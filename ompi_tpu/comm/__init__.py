@@ -143,6 +143,11 @@ class Communicator(AttrHost):
     def comm_rank_of_world(self, world: int) -> int:
         return self.group._index.get(world, UNDEFINED)
 
+    def Get_group(self) -> Group:
+        """MPI_Comm_group: a NEW group handle over this comm's
+        membership (group handles are independent of the comm)."""
+        return Group(self.group.ranks)
+
     def set_name(self, name: str) -> None:
         self.name = name
 

@@ -168,6 +168,10 @@ class File:
     def Get_amode(self) -> int:
         return self.amode
 
+    def Get_group(self):
+        """MPI_File_get_group: a new group of the open's comm."""
+        return self.comm.Get_group()
+
     # -- views ------------------------------------------------------------
     def Set_view(self, disp: int = 0, etype: dt_mod.Datatype = None,
                  filetype: dt_mod.Datatype = None) -> None:

@@ -624,6 +624,19 @@ class Window(AttrHost):
         for t in targets:
             self.Flush(t)
 
+    def Sync(self) -> None:
+        """MPI_Win_sync: synchronize the window's public and private
+        copies. This window keeps ONE authoritative host copy (no
+        separate-memory shadow), so a progress sweep — delivering any
+        in-flight AM updates — is the whole operation."""
+        from ompi_tpu.core import progress
+
+        progress.progress()
+
+    def Get_group(self):
+        """MPI_Win_get_group: a new group of the window's comm."""
+        return self.comm.Get_group()
+
     # -- PSCW (active target, generalized) ------------------------------
     def Post(self, group_ranks: List[int]) -> None:
         """Expose the window to `group_ranks` (MPI_Win_post)."""

@@ -325,6 +325,22 @@ def _Create_dist_graph_adjacent(
     return _attach(sub, DistGraphTopo(sources, destinations))
 
 
+def _Cart_map(self, dims: Sequence[int],
+              periods: Optional[Sequence[bool]] = None) -> int:
+    """MPI_Cart_map: the rank this process WOULD have in the cart
+    (topo_base_cart_map.c). The host plane maps identity (reorder
+    placement is a device-plane hint), so ranks beyond the grid get
+    UNDEFINED."""
+    n = math.prod(dims) if dims else 1
+    return self.rank if self.rank < n else UNDEFINED
+
+
+def _Graph_map(self, index: Sequence[int],
+               edges: Sequence[int]) -> int:
+    """MPI_Graph_map (topo_base_graph_map.c role)."""
+    return self.rank if self.rank < len(index) else UNDEFINED
+
+
 def _Graph_neighbors(self, rank: Optional[int] = None) -> List[int]:
     return self.topo.neighbors(self.rank if rank is None else rank)
 
@@ -497,6 +513,8 @@ _API = {
     "Create_dist_graph_adjacent": _Create_dist_graph_adjacent,
     "Graph_neighbors": _Graph_neighbors,
     "Dist_graph_neighbors": _Dist_graph_neighbors,
+    "Cart_map": _Cart_map,
+    "Graph_map": _Graph_map,
     "Neighbor_allgather": _Neighbor_allgather,
     "Neighbor_alltoall": _Neighbor_alltoall,
     "Neighbor_allgatherv": _Neighbor_allgatherv,

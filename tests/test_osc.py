@@ -263,3 +263,33 @@ def test_dynamic_window_attach_detach():
     win.Fence()
     win.Free()
     """, 3)
+
+
+def test_group_queries_and_win_sync():
+    """MPI_Comm_group / Win_get_group / File_get_group return NEW
+    independent group handles; Win_sync is the one-copy no-op plus a
+    progress sweep; Cart_map/Graph_map report would-be ranks."""
+    run_ranks("""
+        import os, tempfile
+        from ompi_tpu import io as io_mod, osc
+        g = comm.Get_group()
+        assert g.ranks == comm.group.ranks and g is not comm.group
+        win = osc.win_create(comm, np.zeros(4))
+        assert win.Get_group().size == comm.size
+        win.Fence(); win.Sync(); win.Fence()
+        win.Free()
+        path = os.path.join(tempfile.gettempdir(),
+                            f"ompitpu_gq_{os.environ['OMPI_TPU_JOBID']}")
+        f = io_mod.File_open(comm, path,
+                             io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+        assert f.Get_group().size == comm.size
+        f.Close()
+        assert comm.Cart_map([size]) == rank
+        from ompi_tpu.comm import UNDEFINED
+        assert comm.Cart_map([1]) == (rank if rank < 1 else UNDEFINED)
+        assert comm.Graph_map([1], [0]) == (rank if rank < 1
+                                            else UNDEFINED)
+        if rank == 0:
+            try: os.unlink(path)
+            except OSError: pass
+    """, 2)
