@@ -143,6 +143,17 @@ class Communicator(AttrHost):
     def comm_rank_of_world(self, world: int) -> int:
         return self.group._index.get(world, UNDEFINED)
 
+    def Topo_test(self) -> str:
+        """MPI_Topo_test: the topology kind attached to this comm —
+        'cart' / 'graph' / 'dist_graph' / 'undefined'
+        (ompi/mpi/c/topo_test.c)."""
+        return getattr(self.topo, "kind", "undefined") \
+            if self.topo is not None else "undefined"
+
+    def Is_inter(self) -> bool:
+        """MPI_Comm_test_inter."""
+        return bool(getattr(self, "is_inter", False))
+
     def Get_group(self) -> Group:
         """MPI_Comm_group: a NEW group handle over this comm's
         membership (group handles are independent of the comm)."""

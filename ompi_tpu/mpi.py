@@ -1394,6 +1394,15 @@ def Init():
     return state.init()
 
 
+def Request_get_status(request) -> Tuple[bool, Status]:
+    """MPI_Request_get_status (ompi/mpi/c/request_get_status.c):
+    (flag, status) for a request. The C binding exists because
+    MPI_Test deallocates the handle; handles here are objects that
+    test() never frees, so this is the same operation with the
+    status returned alongside."""
+    return request.test(), request.retrieve_status()
+
+
 def Grequest_start(query_fn=None, free_fn=None, cancel_fn=None):
     """MPI_Grequest_start: returns a request the application completes
     with req.complete() (MPI_Grequest_complete). Works with

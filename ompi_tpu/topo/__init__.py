@@ -332,12 +332,18 @@ def _Cart_map(self, dims: Sequence[int],
     placement is a device-plane hint), so ranks beyond the grid get
     UNDEFINED."""
     n = math.prod(dims) if dims else 1
+    if n > self.size:  # same contract as _Create_cart
+        raise ValueError(
+            f"cart size {n} exceeds comm size {self.size}")
     return self.rank if self.rank < n else UNDEFINED
 
 
 def _Graph_map(self, index: Sequence[int],
                edges: Sequence[int]) -> int:
     """MPI_Graph_map (topo_base_graph_map.c role)."""
+    if len(index) > self.size:  # same contract as _Create_graph
+        raise ValueError(
+            f"graph size {len(index)} exceeds comm size {self.size}")
     return self.rank if self.rank < len(index) else UNDEFINED
 
 

@@ -394,3 +394,43 @@ def test_ineighbor_nonblocking_overlap():
                 else i ^ 1
             assert (rb[i] == 10 * src + j).all(), (i, src, rb)
     """, 4)
+
+
+def test_topo_test_is_inter_request_get_status():
+    """MPI_Topo_test / Comm_test_inter / Request_get_status."""
+    run_ranks("""
+        assert comm.Topo_test() == "undefined"
+        assert comm.Is_inter() is False
+        cart = comm.Create_cart([size])
+        assert cart.Topo_test() == "cart"
+        g = comm.Create_dist_graph_adjacent([], [])
+        assert g.Topo_test() == "dist_graph"
+        peer = 1 - rank
+        rb = np.zeros(4)
+        req = comm.Irecv(rb, source=peer, tag=2)
+        flag, st = mpi.Request_get_status(req)
+        comm.Send(np.full(4, 5.0), dest=peer, tag=2)
+        st = req.wait()
+        # get_status answers repeatedly without consuming
+        for _ in range(2):
+            flag, st2 = mpi.Request_get_status(req)
+            assert flag and st2.source == peer
+    """, 2)
+
+
+def test_cart_graph_map_oversize_rejected():
+    """Cart_map/Graph_map enforce the same size contract as the
+    constructors (MPI_ERR_DIMS analog)."""
+    run_ranks("""
+        import pytest
+        try:
+            comm.Cart_map([size + 1])
+            raise SystemExit("oversize cart accepted")
+        except ValueError:
+            pass
+        try:
+            comm.Graph_map([0] * (size + 1), [])
+            raise SystemExit("oversize graph accepted")
+        except ValueError:
+            pass
+    """, 2)
