@@ -229,6 +229,66 @@ def _bench_staging(between=None):
     return d2h, h2d, d2h_raw, d2h_chunked, between_out
 
 
+def _bench_dispatch():
+    """Dispatch-overhead microbench for the coll/xla hot path, on a
+    1-device local context (``_Ctx.local`` — a psum over one device is
+    an identity collective, so this times the pure host dispatch round
+    of a cached executable, NOT the interconnect). Two numbers:
+
+    - ``allreduce_4k_launches_per_s``: steady-state launch rate of one
+      pre-planned persistent 4 KB allreduce (the Start()+Wait() cost).
+    - ``fused_64x256k_ms`` vs ``perbuf_64x256k_ms``: one fused
+      gradient-bucket step over 64 x 256 KB buffers against the
+      per-buffer dispatch loop it replaces.
+
+    Deliberately does NOT bring up the device plane: bench runs
+    single-process, and forcing the plane would pin jax to CPU."""
+    import types
+
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu.coll import xla as cx
+
+    ctx = cx._Ctx.local()
+    comm = types.SimpleNamespace(_coll_xla_ctx=ctx)
+
+    # cached-executable launch rate, 4 KB operand
+    launcher = cx._allreduce_prep(comm, jnp.ones(1024, jnp.float32))
+    jax.block_until_ready(launcher())  # compile + warm
+    iters = 300
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = launcher()
+    jax.block_until_ready(r)
+    launches_per_s = iters / (time.perf_counter() - t0)
+
+    # fused bucket step vs the per-buffer loop it replaces
+    bufs = [jnp.full((65536,), float(i), jnp.float32)  # 64 x 256 KB
+            for i in range(64)]
+    fused = cx._allreduce_multi_prep(comm, bufs)
+    jax.block_until_ready(fused())
+    perbuf = [cx._allreduce_prep(comm, b) for b in bufs]
+    jax.block_until_ready([p() for p in perbuf])
+    reps = 10
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fused()
+    jax.block_until_ready(out)
+    fused_ms = (time.perf_counter() - t0) / reps * 1e3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        outs = [p() for p in perbuf]
+    jax.block_until_ready(outs)
+    perbuf_ms = (time.perf_counter() - t0) / reps * 1e3
+    return {
+        "allreduce_4k_launches_per_s": round(launches_per_s, 1),
+        "fused_64x256k_ms": round(fused_ms, 3),
+        "perbuf_64x256k_ms": round(perbuf_ms, 3),
+        "fused_speedup": round(perbuf_ms / fused_ms, 2),
+    }
+
+
 def main() -> None:
     t_start = time.time()
     # staging first: the train bench necessarily reads results back
@@ -255,6 +315,12 @@ def main() -> None:
     _phase(f"staging+upload done ({staging_s:.1f}s)")
     tokens_per_s, tflops, loss, compile_s, train_s = \
         _bench_train_step(prep)
+    try:
+        dispatch = _bench_dispatch()
+        _phase("dispatch microbench done")
+    except Exception as e:  # never let the microbench sink the metric
+        _phase(f"dispatch microbench skipped: {e!r}")
+        dispatch = None
 
     import jax
 
@@ -295,6 +361,7 @@ def main() -> None:
             "staging_d2h_chunked_GBs":
                 None if d2h_chunked is None else round(d2h_chunked, 2),
             "staging_h2d_GBs": None if h2d is None else round(h2d, 2),
+            "dispatch": dispatch,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),
             # wall attribution: metric quality depends only on

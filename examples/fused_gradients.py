@@ -1,0 +1,60 @@
+"""Bucketed gradient synchronization — the DDP/Horovod pattern on the
+coll/xla device path.
+
+A training step produces one gradient per parameter; syncing them with
+a per-tensor Allreduce pays a host dispatch round for every tensor.
+``Allreduce_multi`` flattens the whole gradient pytree into
+dtype-segregated flat buckets (target size: ``--mca
+coll_xla_bucket_bytes``, default 4 MiB) and launches ONE compiled
+collective per bucket. ``Allreduce_multi_init`` is the MPI-4 persistent
+form: plan + compile + operand binding happen once at init, so each
+``Start()``/``Wait()`` cycle is pure cached-executable dispatch.
+
+Run:  python -m ompi_tpu.runtime.launcher -n 4 --mca device_plane on \
+          examples/fused_gradients.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ompi_tpu import mpi
+from ompi_tpu.core import pvar
+
+comm = mpi.Init()
+rank, size = comm.rank, comm.size
+
+# a params-like pytree: many small tensors, mixed dtypes — the shape
+# of a real model's gradient set, where per-tensor dispatch dominates
+grads = {
+    "embed": jnp.full((256, 32), float(rank + 1), jnp.float32),
+    "layers": [
+        {"w": jnp.ones((64, 64), jnp.float32) * (rank + 1),
+         "b": jnp.arange(64, dtype=jnp.float32) * rank}
+        for _ in range(4)
+    ],
+    "step": jnp.array([rank], jnp.int32),
+}
+
+# one fused call replaces ~10 per-tensor Allreduces; 'linear' keeps the
+# result bit-identical to the per-tensor loop (rank-order fold)
+s = pvar.session()
+synced = comm.Allreduce_multi(grads, deterministic="linear")
+launches = s.read("coll_xla_launches")
+
+np.testing.assert_allclose(
+    np.asarray(synced["embed"])[0, 0], sum(range(1, size + 1)))
+
+# persistent form for the training loop: init once, Start each step
+preq = comm.Allreduce_multi_init(grads)
+for _ in range(3):  # the "training loop"
+    preq.start()
+    preq.wait()
+    synced = preq.array  # fresh result pytree each cycle
+preq.free()
+
+if rank == 0:
+    n_leaves = len(jax.tree.leaves(grads))
+    print(f"synced {n_leaves} gradient tensors in {launches} compiled "
+          f"launches (vs {n_leaves} per-tensor)")
+mpi.Finalize()

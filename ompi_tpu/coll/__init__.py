@@ -49,6 +49,9 @@ SLOTS = (
     "allreduce_dev", "bcast_dev", "reduce_dev", "allgather_dev",
     "alltoall_dev", "reduce_scatter_block_dev", "scatter_dev",
     "gather_dev", "scan_dev", "exscan_dev",
+    # fused (bucketed) device allreduce over a list/pytree of buffers
+    # + its MPI-4 persistent form (gradient-bucketing hot path)
+    "allreduce_multi_dev", "allreduce_multi_init_dev",
 )
 
 

@@ -56,6 +56,17 @@ def allreduce_dev(comm, sendbuf, op=op_mod.SUM, deterministic=None):
     return _stage_out(recv, sendbuf)
 
 
+def allreduce_multi_dev(comm, bufs, op=op_mod.SUM, deterministic=None):
+    """Staged fallthrough for the fused (bucketed) allreduce: a
+    per-buffer staged loop — device-side fusion buys nothing once the
+    payload crosses the host transports, so the loop keeps semantics
+    without pretending to coalesce."""
+    import jax
+
+    return jax.tree.map(
+        lambda b: allreduce_dev(comm, b, op, deterministic), bufs)
+
+
 def bcast_dev(comm, buf, root=0):
     pvar.record("coll_accelerator_staged")
     host = _stage_in(buf, writable=True)
@@ -358,6 +369,8 @@ class CollAccelerator(CollModule):
             "igatherv_dev": _istaged(gatherv_dev),
             "ialltoallv_dev": _istaged(alltoallv_dev),
             "iscatterv_dev": _istaged(scatterv_dev),
+            "allreduce_multi_dev": allreduce_multi_dev,
+            "allreduce_multi_init_dev": _pstaged(allreduce_multi_dev),
             "allreduce_init_dev": _pstaged(allreduce_dev),
             "bcast_init_dev": _pstaged(bcast_dev),
             "allgather_init_dev": _pstaged(allgather_dev),

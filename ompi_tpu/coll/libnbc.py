@@ -606,6 +606,15 @@ def alltoall_init(comm, sendbuf, recvbuf, count, dtype):
                        dtype)
 
 
+def reduce_scatter_block_init(comm, sendbuf, recvbuf, count, dtype,
+                              op):
+    # completes the host persistent table for the five collectives the
+    # device path makes persistent (mpi.py used to raise TypeError on
+    # the host form of Reduce_scatter_block_init)
+    return _persistent(_sched_reduce_scatter_block, comm, sendbuf,
+                       recvbuf, count, dtype, op)
+
+
 @framework.register
 class CollLibnbc(CollModule):
     NAME = "libnbc"
@@ -642,4 +651,5 @@ class CollLibnbc(CollModule):
             "scatter_init": scatter_init,
             "allgather_init": allgather_init,
             "alltoall_init": alltoall_init,
+            "reduce_scatter_block_init": reduce_scatter_block_init,
         }

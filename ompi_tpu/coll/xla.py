@@ -98,6 +98,18 @@ _a2av_cache_var = cvar.register(
          "should pass max_count= instead (host-free, always safe).",
     level=6)
 
+_bucket_var = cvar.register(
+    "coll_xla_bucket_bytes", 4 << 20, int,
+    help="target flat-bucket size for the fused (bucketed) device "
+         "allreduce (allreduce_multi_dev / Allreduce_multi): same-"
+         "dtype buffers coalesce into flat buckets that close once "
+         "they reach this many bytes, and each bucket runs ONE "
+         "compiled concat+reduce+split program (the NCCL/Horovod/DDP "
+         "gradient-bucketing analog). The close-at-threshold rule "
+         "bounds compiled launches to ceil(total_bytes/bucket_bytes) "
+         "+ n_dtypes. 0 fuses each dtype into a single bucket "
+         "regardless of size.", level=5)
+
 _hier_var = cvar.register(
     "coll_xla_hier", "auto", str,
     help="hierarchical ICI x DCN execution for comms spanning slices "
@@ -127,20 +139,37 @@ class _Ctx:
     reference's per-comm coll module data)."""
 
     def __init__(self, comm) -> None:
+        from ompi_tpu.runtime import device_plane
+
+        devs = [device_plane.device_for_world_rank(w)
+                for w in comm.group.ranks]
+        self._setup(devs, device_plane.my_device())
+
+    @classmethod
+    def local(cls) -> "_Ctx":
+        """A 1-device context over the local default device, no plane
+        required — the bench/diagnostic lane: a psum over one device
+        is an identity collective, so timing it isolates the pure
+        host dispatch cost of the compiled-collective hot path."""
+        import jax
+
+        obj = cls.__new__(cls)
+        dev = jax.devices()[0]
+        obj._setup([dev], dev)
+        return obj
+
+    def _setup(self, devs, my) -> None:
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-        from ompi_tpu.runtime import device_plane
-
         self.jax = jax
         self.P = P
-        devs = [device_plane.device_for_world_rank(w)
-                for w in comm.group.ranks]
         self.mesh = Mesh(np.array(devs), (AXIS,))
-        self.my = device_plane.my_device()
+        self.my = my
         self.n = len(devs)
         self.in_sharding = NamedSharding(self.mesh, P(AXIS))
         self.fns = {}  # (kind, shape, dtype, ...) -> compiled callable
+        self.plans = {}  # fused-allreduce bucket plans per signature
         # hierarchical ICI x DCN mesh (rank-major rows = slices) when
         # the comm spans slices and ranks are slice-contiguous
         self.mesh2d = None
@@ -192,9 +221,21 @@ class _Ctx:
     # -- plumbing ---------------------------------------------------------
     def to_global(self, x, sharding=None):
         """Local device array -> global array sharded (n, *shape) on
-        the comm axis/axes (rank r's contribution at index r)."""
+        the comm axis/axes (rank r's contribution at index r).
+
+        Fast path: device_put is skipped when the buffer already
+        lives on ``my`` — it runs on every collective call, and for
+        resident arrays (the steady-state training case) it only adds
+        a dispatch round."""
         jax = self.jax
-        x = jax.device_put(x, self.my)
+        try:
+            resident = x.device == self.my
+        except (AttributeError, ValueError):
+            resident = False  # numpy / multi-shard input: stage it
+        if resident:
+            pvar.record("coll_xla_device_put_skipped")
+        else:
+            x = jax.device_put(x, self.my)
         return jax.make_array_from_single_device_arrays(
             (self.n,) + x.shape, sharding or self.in_sharding,
             [x[None]])
@@ -204,19 +245,54 @@ class _Ctx:
         return out.addressable_data(0)
 
     def compiled(self, key, build):
+        """Get-or-build a compiled program. Hit/miss/size pvars make
+        cache churn (shape-varying workloads recompiling every call)
+        visible via MPI_T instead of only via wall time."""
         fn = self.fns.get(key)
         if fn is None:
+            pvar.record("coll_xla_cache_misses")
             fn = self.fns[key] = build()
+            pvar.record_hwm("coll_xla_fns_size", len(self.fns))
+        else:
+            pvar.record("coll_xla_cache_hits")
         return fn
+
+    def plan(self, key, build):
+        """Get-or-build a fused-bucket plan (same contract as
+        ``compiled`` — steady-state steps must pay zero re-planning)."""
+        p = self.plans.get(key)
+        if p is None:
+            pvar.record("coll_xla_plan_cache_misses")
+            p = self.plans[key] = build()
+            pvar.record_hwm("coll_xla_plans_size", len(self.plans))
+        else:
+            pvar.record("coll_xla_plan_cache_hits")
+        return p
+
+    def launch(self, fn, *args):
+        """Dispatch one compiled collective program. Every device-path
+        dispatch funnels through here so the launch counter is exact —
+        the fusion regression tests assert on it."""
+        pvar.record("coll_xla_launches")
+        return fn(*args)
+
+    def release(self) -> None:
+        """Drop the compiled-program and plan caches (comm destructor
+        path: long-lived jobs with shape churn must not grow these
+        invisibly after the comm is freed)."""
+        self.fns.clear()
+        self.plans.clear()
 
     def smap(self, body, out_varying: bool, mesh=None, spec=None):
         """jit(shard_map(body)) over the comm mesh (or the 2-level
         ICI x DCN mesh when passed). Body sees the local (1, *shape)
         block; out_varying selects the sharded vs replicated spec."""
+        from ompi_tpu.util import jaxcompat
+
         jax, P = self.jax, self.P
         spec = spec if spec is not None else P(AXIS)
         out_spec = spec if out_varying else P()
-        return jax.jit(jax.shard_map(
+        return jax.jit(jaxcompat.shard_map(
             body, mesh=mesh if mesh is not None else self.mesh,
             in_specs=spec, out_specs=out_spec, check_vma=False))
 
@@ -256,14 +332,14 @@ def _op_ok(op) -> bool:
 # slots — signatures match coll/accelerator's *_dev (the fallback)
 
 
-def allreduce_dev(comm, sendbuf, op=op_mod.SUM,
-                  deterministic: Optional[str] = None):
+def _allreduce_prep(comm, sendbuf, op=op_mod.SUM,
+                    deterministic: Optional[str] = None):
+    """Plan + compile + bind the allreduce NOW; returns a zero-arg
+    launcher whose every call is one cached-executable dispatch. The
+    blocking slot calls the launcher immediately; the MPI-4 persistent
+    init holds it so Start()+Wait() pays zero re-planning (jax arrays
+    are immutable, so the operand bound here never changes)."""
     det = _det(deterministic)
-    if not _op_ok(op):
-        return staging.allreduce_dev(comm, sendbuf, op)
-    pvar.record("coll_xla_device")
-    if comm.size == 1:
-        return sendbuf
     from ompi_tpu.parallel import collectives as C
 
     ctx = _ctx(comm)
@@ -283,7 +359,18 @@ def allreduce_dev(comm, sendbuf, op=op_mod.SUM,
 
     fn = ctx.compiled(_key(sendbuf, "allreduce", opn.name, det), build)
     to_g = ctx.to_global_hier if hier else ctx.to_global
-    return ctx.my_shard(fn(to_g(sendbuf)))
+    g = to_g(sendbuf)
+    return lambda: ctx.my_shard(ctx.launch(fn, g))
+
+
+def allreduce_dev(comm, sendbuf, op=op_mod.SUM,
+                  deterministic: Optional[str] = None):
+    if not _op_ok(op):
+        return staging.allreduce_dev(comm, sendbuf, op)
+    pvar.record("coll_xla_device")
+    if comm.size == 1:
+        return sendbuf
+    return _allreduce_prep(comm, sendbuf, op, deterministic)()
 
 
 #: test/diagnostic hook: the last rooted schedule's per-round,
@@ -325,7 +412,7 @@ def _gather_rooted(ctx, comm, x, root: int):
                 out_varying=True)
 
         fn = ctx.compiled(_key(x, "gather_rooted", src, root), build)
-        got = ctx.my_shard(fn(ctx.to_global(x)))
+        got = ctx.my_shard(ctx.launch(fn, ctx.to_global(x)))
         if me == root:
             parts[src] = got
     if me != root:
@@ -382,7 +469,7 @@ def _reduce_binomial(ctx, comm, x, opn, root: int):
 
         fn = ctx.compiled(_key(x, "reduce_binom", opn.name, rnd,
                                root, n), build)
-        acc = ctx.my_shard(fn(ctx.to_global(acc)))
+        acc = ctx.my_shard(ctx.launch(fn, ctx.to_global(acc)))
     return acc if me == root else None
 
 
@@ -433,17 +520,14 @@ def reduce_dev(comm, sendbuf, op=op_mod.SUM, root: int = 0,
             out_varying=True)
 
     fn = ctx.compiled(_key(flat, "reduce_rooted_rs", opn.name), build)
-    chunk = ctx.my_shard(fn(ctx.to_global(flat)))
+    chunk = ctx.my_shard(ctx.launch(fn, ctx.to_global(flat)))
     stacked = _gather_rooted(ctx, comm, chunk, root)
     if comm.rank != root:
         return None
     return stacked.reshape(-1)[:sendbuf.size].reshape(sendbuf.shape)
 
 
-def bcast_dev(comm, buf, root: int = 0):
-    pvar.record("coll_xla_device")
-    if comm.size == 1:
-        return buf
+def _bcast_prep(comm, buf, root: int = 0):
     ctx = _ctx(comm)
     hier = ctx.mesh2d is not None
 
@@ -460,7 +544,15 @@ def bcast_dev(comm, buf, root: int = 0):
 
     fn = ctx.compiled(_key(buf, "bcast", root), build)
     to_g = ctx.to_global_hier if hier else ctx.to_global
-    return ctx.my_shard(fn(to_g(buf)))
+    g = to_g(buf)
+    return lambda: ctx.my_shard(ctx.launch(fn, g))
+
+
+def bcast_dev(comm, buf, root: int = 0):
+    pvar.record("coll_xla_device")
+    if comm.size == 1:
+        return buf
+    return _bcast_prep(comm, buf, root)()
 
 
 def _bcast_body(root: int):
@@ -469,11 +561,7 @@ def _bcast_body(root: int):
     return lambda a: C.bcast(a[0], AXIS, root)
 
 
-def allgather_dev(comm, sendbuf):
-    pvar.record("coll_xla_device")
-    ctx_free = comm.size == 1
-    if ctx_free:
-        return sendbuf[None] if hasattr(sendbuf, "shape") else sendbuf
+def _allgather_prep(comm, sendbuf):
     from jax import lax
 
     ctx = _ctx(comm)
@@ -483,7 +571,15 @@ def allgather_dev(comm, sendbuf):
                         out_varying=False)
 
     fn = ctx.compiled(_key(sendbuf, "allgather"), build)
-    return ctx.my_shard(fn(ctx.to_global(sendbuf)))
+    g = ctx.to_global(sendbuf)
+    return lambda: ctx.my_shard(ctx.launch(fn, g))
+
+
+def allgather_dev(comm, sendbuf):
+    pvar.record("coll_xla_device")
+    if comm.size == 1:
+        return sendbuf[None] if hasattr(sendbuf, "shape") else sendbuf
+    return _allgather_prep(comm, sendbuf)()
 
 
 def gather_dev(comm, sendbuf, root: int = 0):
@@ -498,10 +594,7 @@ def gather_dev(comm, sendbuf, root: int = 0):
     return _gather_rooted(_ctx(comm), comm, sendbuf, root)
 
 
-def alltoall_dev(comm, sendbuf):
-    pvar.record("coll_xla_device")
-    if comm.size == 1:
-        return sendbuf
+def _alltoall_prep(comm, sendbuf):
     if sendbuf.shape[0] % comm.size:
         raise ValueError(
             f"alltoall: dim0 {sendbuf.shape[0]} not divisible by "
@@ -523,17 +616,20 @@ def alltoall_dev(comm, sendbuf):
 
     fn = ctx.compiled(_key(sendbuf, "alltoall"), build)
     to_g = ctx.to_global_hier if hier else ctx.to_global
-    return ctx.my_shard(fn(to_g(sendbuf)))
+    g = to_g(sendbuf)
+    return lambda: ctx.my_shard(ctx.launch(fn, g))
 
 
-def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
-                             deterministic: Optional[str] = None):
-    det = _det(deterministic)
-    if not _op_ok(op):
-        return staging.reduce_scatter_block_dev(comm, sendbuf, op)
+def alltoall_dev(comm, sendbuf):
     pvar.record("coll_xla_device")
     if comm.size == 1:
         return sendbuf
+    return _alltoall_prep(comm, sendbuf)()
+
+
+def _reduce_scatter_block_prep(comm, sendbuf, op=op_mod.SUM,
+                               deterministic: Optional[str] = None):
+    det = _det(deterministic)
     if sendbuf.shape[0] % comm.size:
         raise ValueError(
             f"reduce_scatter_block: dim0 {sendbuf.shape[0]} not "
@@ -550,7 +646,19 @@ def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
             out_varying=True)
 
     fn = ctx.compiled(_key(sendbuf, "rsb", opn.name, det), build)
-    return ctx.my_shard(fn(ctx.to_global(sendbuf)))
+    g = ctx.to_global(sendbuf)
+    return lambda: ctx.my_shard(ctx.launch(fn, g))
+
+
+def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
+                             deterministic: Optional[str] = None):
+    if not _op_ok(op):
+        return staging.reduce_scatter_block_dev(comm, sendbuf, op)
+    pvar.record("coll_xla_device")
+    if comm.size == 1:
+        return sendbuf
+    return _reduce_scatter_block_prep(comm, sendbuf, op,
+                                      deterministic)()
 
 
 def _scatter_meta(comm, key, root: int, root_meta):
@@ -639,7 +747,7 @@ def scatter_dev(comm, sendbuf, root: int = 0, like=None):
                         out_varying=True)
 
     fn = ctx.compiled(_key(x, "scatter", root), build)
-    return ctx.my_shard(fn(ctx.to_global(x)))
+    return ctx.my_shard(ctx.launch(fn, ctx.to_global(x)))
 
 
 def barrier_dev(comm):
@@ -703,7 +811,8 @@ def scatterv_dev(comm, sendbuf, counts, root: int = 0, like=None):
     fn = ctx.compiled(_key(x, "scatterv", counts, root), build)
     # ragged trim is per-rank-local (outside the collective program:
     # sharded outputs must be uniform across devices)
-    return ctx.my_shard(fn(ctx.to_global(x)))[:counts[comm.rank]]
+    return ctx.my_shard(
+        ctx.launch(fn, ctx.to_global(x)))[:counts[comm.rank]]
 
 
 def _nonroot_meta(comm, root, like, counts):
@@ -749,7 +858,7 @@ def allgatherv_dev(comm, sendbuf, counts):
         return ctx.smap(body, out_varying=False)
 
     fn = ctx.compiled(_key(x, "allgatherv", counts), build)
-    return ctx.my_shard(fn(ctx.to_global(x)))
+    return ctx.my_shard(ctx.launch(fn, ctx.to_global(x)))
 
 
 def gatherv_dev(comm, sendbuf, counts, root: int = 0):
@@ -827,7 +936,7 @@ def alltoallv_dev(comm, sendbuf, scounts, rcounts, max_count=None):
         return ctx.smap(body, out_varying=True)
 
     fn = ctx.compiled(_key(x, "alltoallv", m), build)
-    cells = ctx.my_shard(fn(ctx.to_global(x)))  # (n, m, *rest)
+    cells = ctx.my_shard(ctx.launch(fn, ctx.to_global(x)))  # (n, m, *rest)
     # ragged repack is per-rank-local (outside the collective program:
     # sharded outputs must be uniform across devices)
     return jnp.concatenate(
@@ -875,7 +984,7 @@ def scan_dev(comm, sendbuf, op=op_mod.SUM,
                         out_varying=True)
 
     fn = ctx.compiled(_key(sendbuf, "scan", opn.name), build)
-    return ctx.my_shard(fn(ctx.to_global(sendbuf)))
+    return ctx.my_shard(ctx.launch(fn, ctx.to_global(sendbuf)))
 
 
 def exscan_dev(comm, sendbuf, op=op_mod.SUM,
@@ -898,7 +1007,140 @@ def exscan_dev(comm, sendbuf, op=op_mod.SUM,
                         out_varying=True)
 
     fn = ctx.compiled(_key(sendbuf, "exscan", opn.name), build)
-    return ctx.my_shard(fn(ctx.to_global(sendbuf)))
+    return ctx.my_shard(ctx.launch(fn, ctx.to_global(sendbuf)))
+
+
+# ---------------------------------------------------------------------------
+# fused (bucketed) allreduce — the gradient-bucketing engine
+
+
+class _FusePlan:
+    """dtype-segregated bucket layout for one leaf signature (the
+    NCCL/Horovod/DDP gradient-bucket plan). ``buckets`` is a tuple of
+    tuples of leaf indices; a bucket closes once its byte total
+    reaches ``bucket_bytes`` (overflow allowed), which bounds compiled
+    launches at ceil(total_bytes/bucket_bytes) + n_dtypes — the
+    invariant the launch-count regression test asserts."""
+
+    __slots__ = ("buckets", "nbytes")
+
+    def __init__(self, metas, bucket_bytes: int) -> None:
+        groups: dict = {}
+        order = []
+        for i, (_shape, dtype, nb) in enumerate(metas):
+            if dtype not in groups:
+                groups[dtype] = []
+                order.append(dtype)
+            groups[dtype].append((i, nb))
+        buckets = []
+        for dt in order:
+            cur, cur_bytes = [], 0
+            for i, nb in groups[dt]:
+                cur.append(i)
+                cur_bytes += nb
+                if bucket_bytes > 0 and cur_bytes >= bucket_bytes:
+                    buckets.append(tuple(cur))
+                    cur, cur_bytes = [], 0
+            if cur:
+                buckets.append(tuple(cur))
+        self.buckets = tuple(buckets)
+        self.nbytes = sum(m[2] for m in metas)
+
+
+def _fuse_prep(ctx, comm, leaves, treedef, opn,
+               det: Optional[str]):
+    """Build (or reuse) the bucket plan and each bucket's ONE compiled
+    concat+allreduce+split program, bind the operands, and return a
+    zero-arg launcher producing the unflattened pytree.
+
+    Bit-identity: under ``deterministic='linear'`` the fold is an
+    elementwise rank-order reduction, and concatenation never changes
+    an element's per-rank fold order — fused results are bitwise
+    identical to the per-buffer loop (tested)."""
+    import jax
+
+    metas = tuple((tuple(l.shape), str(l.dtype),
+                   int(l.size) * np.dtype(l.dtype).itemsize)
+                  for l in leaves)
+    bb = int(_bucket_var.get())
+    plan = ctx.plan((metas, treedef, opn.name, det, bb),
+                    lambda: _FusePlan(metas, bb))
+    hier = det is None and ctx.mesh2d is not None
+    to_g = ctx.to_global_hier if hier else ctx.to_global
+    from ompi_tpu.parallel import collectives as C
+
+    launches = []
+    for idxs in plan.buckets:
+        sig = tuple((metas[i][0], metas[i][1]) for i in idxs)
+
+        def build(idxs=idxs):
+            def body(args):
+                import jax.numpy as jnp
+
+                flat = (jnp.concatenate(
+                    [a[0].reshape(-1) for a in args])
+                    if len(args) > 1 else args[0][0].reshape(-1))
+                if hier:
+                    from ompi_tpu.parallel import hierarchical as H
+
+                    red = H.allreduce(flat, op=opn)
+                else:
+                    red = C.allreduce(flat, AXIS, opn, det)
+                outs, off = [], 0
+                for a in args:  # static split back to member shapes
+                    n = a[0].size
+                    outs.append(red[off:off + n].reshape(a.shape[1:]))
+                    off += n
+                return tuple(outs)
+
+            if hier:
+                return ctx.smap_hier(body, out_varying=False)
+            return ctx.smap(body, out_varying=False)
+
+        fn = ctx.compiled(("fused_allreduce", sig, opn.name, det,
+                           hier), build)
+        gs = tuple(to_g(leaves[i]) for i in idxs)
+        launches.append((fn, gs, idxs))
+
+    def launch():
+        outs = [None] * len(leaves)
+        for fn, gs, idxs in launches:
+            res = ctx.launch(fn, gs)
+            for j, i in enumerate(idxs):
+                outs[i] = ctx.my_shard(res[j])
+        pvar.record("coll_xla_fused_bytes", plan.nbytes)
+        return jax.tree.unflatten(treedef, outs)
+
+    return launch
+
+
+def _allreduce_multi_prep(comm, bufs, op=op_mod.SUM,
+                          deterministic: Optional[str] = None):
+    import jax
+
+    leaves, treedef = jax.tree.flatten(bufs)
+    opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
+    return _fuse_prep(_ctx(comm), comm, leaves, treedef, opn,
+                      _det(deterministic))
+
+
+def allreduce_multi_dev(comm, bufs, op=op_mod.SUM,
+                        deterministic: Optional[str] = None):
+    """Fused allreduce over a list/pytree of device buffers: flatten
+    into dtype-segregated flat buckets (target size cvar
+    ``coll_xla_bucket_bytes``), ONE compiled psum per bucket, split
+    back — amortizing the per-buffer Python dispatch round that
+    dominates many-small-gradient steps. Returns a new pytree with
+    the input structure."""
+    if not _op_ok(op):
+        return staging.allreduce_multi_dev(comm, bufs, op,
+                                           deterministic=deterministic)
+    pvar.record("coll_xla_device")
+    import jax
+
+    if comm.size == 1 or not jax.tree.leaves(bufs):
+        return bufs
+    return _allreduce_multi_prep(comm, bufs, op, deterministic)()
 
 
 # ---------------------------------------------------------------------------
@@ -937,15 +1179,16 @@ class DeviceRequest:
         engine, which never advances a device program — so this MUST
         probe the array, not cache a flag only test()/wait() flip."""
         if not self._done:
-            try:
-                if bool(self.array.is_ready()):
+            import jax
+
+            try:  # .array may be a pytree (fused allreduce results)
+                if all(bool(a.is_ready())
+                       for a in jax.tree.leaves(self.array)):
                     self._done = True
             except AttributeError:  # backend without is_ready:
                 # readiness polling degrades to blocking (the same
                 # guarantee the pre-property test() gave) — never
                 # report completion that has not happened
-                import jax
-
                 jax.block_until_ready(self.array)
                 self._done = True
         return self._done
@@ -989,29 +1232,29 @@ def ibarrier_dev(comm):
 
     fn = ctx.compiled(("barrier",), build)
     token = ctx.jax.device_put(jnp.ones((1,), jnp.int32), ctx.my)
-    return DeviceRequest(ctx.my_shard(fn(ctx.to_global(token))))
+    return DeviceRequest(
+        ctx.my_shard(ctx.launch(fn, ctx.to_global(token))))
 
 
 class PersistentDeviceRequest:
     """MPI-4 persistent device collective (reference: the coll.h
-    *_init slot table): the operation binds its operands at init;
-    every ``start()`` re-dispatches the cached compiled program on
-    them (the compile cache makes restarts free — exactly what
-    persistence buys on the host side). jax arrays are immutable, so
-    each cycle's result is a fresh array in ``.array``."""
+    *_init slot table): init runs the FULL prep — plan, compile, and
+    operand bind (jax arrays are immutable, so the bound operand never
+    changes) — and every ``start()`` is one cached-executable launch
+    of the zero-arg launcher, zero re-planning. jax arrays are
+    immutable, so each cycle's result is a fresh array in ``.array``."""
 
-    def __init__(self, fn, args, kwargs) -> None:
+    def __init__(self, launch) -> None:
         from ompi_tpu.pml import request as rq
 
         self.id = next(rq._req_ids)
         self.status = rq.Status()
         self.persistent = True
-        self._fn, self._args, self._kwargs = fn, args, kwargs
+        self._launch = launch
         self._inner: Optional[DeviceRequest] = None
 
     def start(self) -> None:
-        self._inner = DeviceRequest(self._fn(*self._args,
-                                             **self._kwargs))
+        self._inner = DeviceRequest(self._launch())
 
     @property
     def completed(self) -> bool:
@@ -1044,12 +1287,68 @@ class PersistentDeviceRequest:
 
 
 def _pinit(fn):
-    """persistent-init variant of a device slot: bind now, dispatch
-    at every start()."""
+    """persistent-init variant of a slot WITHOUT a prep phase (the
+    staged fallback path): bind the arguments now, re-run the whole
+    slot at every start()."""
     def pslot(*args, **kwargs):
-        return PersistentDeviceRequest(fn, args, kwargs)
+        return PersistentDeviceRequest(lambda: fn(*args, **kwargs))
     pslot.__name__ = fn.__name__ + "_init"
     return pslot
+
+
+def _pprep(prep, blocking, name: str, gates=()):
+    """persistent-init slot over a prep function: everything that can
+    be hoisted out of the start/wait cycle — planning, compilation,
+    sharding construction — runs at init; start() dispatches the
+    cached executable. ``gates(comm, buf)`` returning True selects the
+    trivial bind-now path (size-1 comms, non-traceable ops), which
+    re-runs the blocking slot per start."""
+    def pslot(comm, buf, *args, **kwargs):
+        for gate in gates:
+            if gate(comm, buf, *args, **kwargs):
+                return PersistentDeviceRequest(
+                    lambda: blocking(comm, buf, *args, **kwargs))
+        return PersistentDeviceRequest(
+            prep(comm, buf, *args, **kwargs))
+    pslot.__name__ = name
+    return pslot
+
+
+def _gate_size1(comm, buf, *a, **k) -> bool:
+    return comm.size == 1
+
+
+def _gate_op(comm, buf, *args, **kwargs) -> bool:
+    op = args[0] if args else kwargs.get("op", op_mod.SUM)
+    return not _op_ok(op)
+
+
+allreduce_init_dev = _pprep(
+    _allreduce_prep, allreduce_dev, "allreduce_init_dev",
+    gates=(_gate_op, _gate_size1))
+bcast_init_dev = _pprep(
+    _bcast_prep, bcast_dev, "bcast_init_dev", gates=(_gate_size1,))
+allgather_init_dev = _pprep(
+    _allgather_prep, allgather_dev, "allgather_init_dev",
+    gates=(_gate_size1,))
+alltoall_init_dev = _pprep(
+    _alltoall_prep, alltoall_dev, "alltoall_init_dev",
+    gates=(_gate_size1,))
+reduce_scatter_block_init_dev = _pprep(
+    _reduce_scatter_block_prep, reduce_scatter_block_dev,
+    "reduce_scatter_block_init_dev", gates=(_gate_op, _gate_size1))
+
+
+def _multi_empty(comm, bufs, *a, **k) -> bool:
+    import jax
+
+    return not jax.tree.leaves(bufs)
+
+
+allreduce_multi_init_dev = _pprep(
+    _allreduce_multi_prep, allreduce_multi_dev,
+    "allreduce_multi_init_dev",
+    gates=(_gate_op, _gate_size1, _multi_empty))
 
 
 def _irequest(fn):
@@ -1101,6 +1400,9 @@ class CollXla(CollModule):
     def slots(self, comm):
         return {
             "allreduce_dev": allreduce_dev,
+            # fused gradient-bucket allreduce (+ persistent form)
+            "allreduce_multi_dev": allreduce_multi_dev,
+            "allreduce_multi_init_dev": allreduce_multi_init_dev,
             "reduce_dev": reduce_dev,
             "bcast_dev": bcast_dev,
             "allgather_dev": allgather_dev,
@@ -1134,13 +1436,15 @@ class CollXla(CollModule):
             "ialltoallv_dev": ialltoallv_dev,
             "iscatterv_dev": iscatterv_dev,
             "ireduce_scatter_dev": ireduce_scatter_dev,
-            # MPI-4 persistent device collectives (coll.h *_init)
-            "allreduce_init_dev": _pinit(allreduce_dev),
-            "bcast_init_dev": _pinit(bcast_dev),
-            "allgather_init_dev": _pinit(allgather_dev),
-            "alltoall_init_dev": _pinit(alltoall_dev),
+            # MPI-4 persistent device collectives (coll.h *_init):
+            # prep-at-init — Start()+Wait() is one cached-executable
+            # launch, zero re-planning (pvar-verified)
+            "allreduce_init_dev": allreduce_init_dev,
+            "bcast_init_dev": bcast_init_dev,
+            "allgather_init_dev": allgather_init_dev,
+            "alltoall_init_dev": alltoall_init_dev,
             "reduce_scatter_block_init_dev":
-                _pinit(reduce_scatter_block_dev),
+                reduce_scatter_block_init_dev,
             # neighborhood slots (topology comms only — coll.h:600-618)
             **_neighbor_slots(comm),
         }

@@ -289,6 +289,16 @@ class Communicator(AttrHost):
             from ompi_tpu import attr as _attr
 
             _attr.delete_attrs(self, "comm")
+        # release coll/xla per-comm state: the compiled-program and
+        # fused-plan caches hold XLA executables + device operands —
+        # long-lived jobs creating/freeing comms with shape churn must
+        # not retain them past the comm's lifetime (attribute-based so
+        # identity never imports the coll component)
+        ctx = self.__dict__.pop("_coll_xla_ctx", None)
+        if ctx is not None:
+            ctx.release()
+        self.__dict__.pop("_coll_xla_scatter_meta", None)
+        self.__dict__.pop("_coll_xla_a2av_meta", None)
         with _comms_lock:
             _comms.pop(self.cid, None)
 

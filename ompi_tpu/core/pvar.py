@@ -28,6 +28,11 @@ WELL_KNOWN = (
     "reduce_scatter", "gather", "scatter", "scan", "exscan",
     "allreduce_xla", "bcast_xla", "allgather_xla", "alltoall_xla",
     "reduce_scatter_xla",
+    # coll/xla dispatch + fusion counters (one compiled-program launch
+    # each; the fused path's regression tests assert on these)
+    "coll_xla_launches", "coll_xla_cache_hits", "coll_xla_cache_misses",
+    "coll_xla_fused_bytes", "coll_xla_plan_cache_hits",
+    "coll_xla_plan_cache_misses", "coll_xla_device_put_skipped",
     "put", "get", "accumulate", "win_lock",
     "eager", "rndv", "rget",
     "time_progress_ns",

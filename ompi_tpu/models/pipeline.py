@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ompi_tpu.util import jaxcompat
+
 from ompi_tpu.models import transformer as tfm
 
 
@@ -84,7 +86,7 @@ def pipeline_forward(params, tokens, cfg: tfm.Config, ax: tfm.Axes,
     zeros — mask downstream with `is_last_stage`).
     """
     assert ax.pp, "pipeline_forward requires a pp axis"
-    pp = lax.axis_size(ax.pp)
+    pp = jaxcompat.axis_size(ax.pp)
     stage = lax.axis_index(ax.pp)
     dt = cfg.dtype
     b, t = tokens.shape
@@ -160,7 +162,7 @@ def make_pp_train_step(cfg: tfm.Config, ax: tfm.Axes, specs,
     def step(params, tokens, labels):
         def loss_fn(p):
             logits = pipeline_forward(p, tokens, cfg, ax, n_micro)
-            pp = lax.axis_size(ax.pp)
+            pp = jaxcompat.axis_size(ax.pp)
             last = (lax.axis_index(ax.pp) == pp - 1).astype(jnp.float32)
             logz = jax.nn.logsumexp(logits, axis=-1)
             gold = jnp.take_along_axis(

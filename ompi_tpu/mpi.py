@@ -129,6 +129,10 @@ def start_all(reqs: Sequence[_PersistentRequest]) -> None:
         r.start()
 
 
+#: MPI-4 spelling (MPI_Startall) — same behavior as start_all
+Startall = start_all
+
+
 # ---------------------------------------------------------------------------
 # Communicator API methods. Defined here and attached to Communicator to
 # keep identity (comm/) separate from surface (this module), mirroring the
@@ -704,6 +708,46 @@ def _Allreduce(self, sendbuf, recvbuf=None, op=op_mod.SUM,
         self.coll.allreduce(self, sarr, rarr, count, dt, op)
 
 
+def _Allreduce_multi(self, bufs, op=op_mod.SUM, deterministic=None):
+    """Fused (bucketed) allreduce over a list/pytree of buffers —
+    the gradient-bucketing hot path. Device leaves coalesce into
+    dtype-segregated flat buckets (target size: cvar
+    coll_xla_bucket_bytes) and each bucket runs ONE compiled psum
+    (coll/xla); 'linear' determinism stays bit-identical to the
+    per-buffer loop. Host buffers (list/tuple form) loop per buffer.
+    Always returns NEW buffers with the input structure (PJRT arrays
+    are immutable; the host loop keeps the same contract)."""
+    self.check_revoked()
+    self.check_failed()
+    if isinstance(bufs, (list, tuple)) and bufs \
+            and not _is_dev(bufs[0]):
+        outs = []
+        for a in bufs:
+            arr = np.ascontiguousarray(a)
+            out = np.empty_like(arr)
+            self.coll.allreduce(self, arr, out, out.size,
+                                dtype_of(arr), op)
+            outs.append(out)
+        return type(bufs)(outs)
+    return self.coll.allreduce_multi_dev(self, bufs, op,
+                                         deterministic=deterministic)
+
+
+def _Allreduce_multi_init(self, bufs, op=op_mod.SUM) -> rq.Request:
+    """MPI-4-style persistent fused allreduce: plan + compile + bind
+    at init, every Start()+Wait() is one cached-executable launch per
+    bucket; req.array holds each cycle's result pytree. Device
+    buffers only (host lists: use per-buffer Allreduce_init)."""
+    self.check_revoked()
+    self.check_failed()
+    if isinstance(bufs, (list, tuple)) and bufs \
+            and not _is_dev(bufs[0]):
+        raise TypeError(
+            "Allreduce_multi_init: device buffers only (host "
+            "persistent form: use per-buffer Allreduce_init)")
+    return self.coll.allreduce_multi_init_dev(self, bufs, op)
+
+
 def _Gather(self, sendbuf, recvbuf=None, root: int = 0):
     self.check_revoked()
     self.check_failed()
@@ -1113,15 +1157,14 @@ def _Allgather_init(self, sendbuf, recvbuf=None) -> rq.Request:
 
 def _Reduce_scatter_block_init(self, sendbuf, recvbuf=None,
                                op=op_mod.SUM) -> rq.Request:
-    """Device persistent form only (the host libnbc table has no
-    reduce_scatter_block_init schedule yet; stage with np.asarray
-    for host buffers)."""
     if _is_dev(sendbuf):
         return self.coll.reduce_scatter_block_init_dev(self, sendbuf,
                                                        op)
-    raise TypeError(
-        "Reduce_scatter_block_init: device buffers only (host "
-        "persistent form not implemented; use Ireduce_scatter_block)")
+    sarr = _parse_buf(sendbuf)[0]
+    rarr, count, dt = _parse_buf(
+        _require_recvbuf(recvbuf, "Reduce_scatter_block_init"))
+    return self.coll.reduce_scatter_block_init(self, sarr, rarr,
+                                               count, dt, op)
 
 
 def _Alltoall_init(self, sendbuf, recvbuf=None) -> rq.Request:
@@ -1242,6 +1285,7 @@ _ERRHANDLED = (
     "Reduce", "Allreduce", "Gather", "Gatherv", "Scatter", "Scatterv",
     "Allgather", "Allgatherv", "Alltoall", "Alltoallv",
     "Reduce_scatter", "Reduce_scatter_block", "Scan", "Exscan",
+    "Allreduce_multi",
 )
 
 _API = {
@@ -1259,6 +1303,8 @@ _API = {
     "Bcast": _Bcast, "bcast": _bcast,
     "Reduce": _Reduce, "reduce": _reduce,
     "Allreduce": _Allreduce, "allreduce": _allreduce,
+    "Allreduce_multi": _Allreduce_multi,
+    "Allreduce_multi_init": _Allreduce_multi_init,
     "Gather": _Gather, "gather": _gather,
     "Gatherv": _Gatherv,
     "Scatter": _Scatter, "scatter": _scatter,

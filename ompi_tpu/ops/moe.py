@@ -20,6 +20,8 @@ from typing import NamedTuple
 import jax.numpy as jnp
 from jax import lax
 
+from ompi_tpu.util import jaxcompat
+
 
 class MoEDispatch(NamedTuple):
     combine: jnp.ndarray   # [T, E, C] combine weights (gate at slot)
@@ -53,7 +55,7 @@ def moe_ffn(x, wg, w1, w2, axis: str, capacity_factor: float = 1.25):
     w1/w2: this device's experts [E_local, D, F], [E_local, F, D].
     E_total = E_local * axis_size(axis). Returns [T, D].
     """
-    n = lax.axis_size(axis)
+    n = jaxcompat.axis_size(axis)
     t, d = x.shape
     e_local = w1.shape[0]
     e_total = e_local * n

@@ -22,6 +22,8 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import lax
 
+from ompi_tpu.util import jaxcompat
+
 from ompi_tpu.ops import attention as att
 from ompi_tpu.parallel import ring
 
@@ -34,7 +36,7 @@ def ring_attention(q, k, v, axis: str, causal: bool = True,
     concatenation over the `axis` ring in rank order. Returns the local
     output block [B, T_local, H, D].
     """
-    n = lax.axis_size(axis)
+    n = jaxcompat.axis_size(axis)
     r = lax.axis_index(axis)
     b, t, h, d = q.shape
     # accumulators in f32 (flash-attention convention) even for bf16

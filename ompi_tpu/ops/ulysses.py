@@ -28,6 +28,8 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import lax
 
+from ompi_tpu.util import jaxcompat
+
 from ompi_tpu.ops import attention as att
 
 
@@ -45,7 +47,7 @@ def ulysses_attention(q, k, v, axis: str, causal: bool = True,
 
     Requires H to be divisible by the axis size (each device owns a
     whole head subset while attending over the full sequence)."""
-    n = lax.axis_size(axis)
+    n = jaxcompat.axis_size(axis)
     h = q.shape[2]
     if h % n:
         raise ValueError(

@@ -72,7 +72,9 @@ def combine_fn(op):
 
 
 def axis_size(axis: str) -> int:
-    return lax.axis_size(axis)
+    from ompi_tpu.util import jaxcompat
+
+    return jaxcompat.axis_size(axis)
 
 
 def axis_index(axis: str):
@@ -123,7 +125,7 @@ def allreduce(x, axis: str, op=op_mod.SUM,
 def _allreduce_linear(x, axis: str, op: op_mod.Op):
     """Gather all shards, fold in rank order (statically unrolled so the
     operand order is exactly rank 0..n-1, like coll/basic)."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     fn = combine_fn(op)
     g = lax.all_gather(x, axis)  # [n, ...] new leading axis
     acc = g[0]
@@ -167,7 +169,7 @@ def reduce_scatter(x, axis: str, op=op_mod.SUM, scatter_dim: int = 0,
     # semantics as psum_scatter: tiled keeps the dim at size/n, untiled
     # squeezes a size-n dim away)
     full = allreduce(x, axis, op, deterministic)
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     idx = lax.axis_index(axis)
     if tiled:
         k = x.shape[scatter_dim] // n
@@ -196,7 +198,7 @@ def alltoall(x, axis: str, split_dim: int = 0, concat_dim: int = 0):
 
 def bcast(x, axis: str, root: int = 0):
     """MPI_Bcast: every device gets root's shard."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     # gather + static index: one all-gather, no divergence. For large
     # buffers XLA rewrites broadcast-from-one as an ICI multicast.
     g = lax.all_gather(x, axis)
@@ -207,7 +209,7 @@ def scatter(x, axis: str, root: int = 0, dim: int = 0):
     """MPI_Scatter from root's shard: every device holds x (same shape);
     device i takes chunk i of root's value."""
     full = bcast(x, axis, root)
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     k = full.shape[dim] // n
     idx = lax.axis_index(axis)
     return lax.dynamic_slice_in_dim(full, idx * k, k, axis=dim)
@@ -227,7 +229,7 @@ def ppermute(x, axis: str, perm: Sequence[Tuple[int, int]]):
 
 def shift(x, axis: str, offset: int = 1):
     """Ring shift by `offset` (MPI_Cart_shift + Sendrecv on a ring)."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + offset) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm=perm)
 
@@ -239,7 +241,7 @@ def shift(x, axis: str, offset: int = 1):
 def scan(x, axis: str, op=op_mod.SUM):
     """MPI_Scan (inclusive prefix over rank order)."""
     op = _op_of(op)
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     fn = combine_fn(op)
     g = lax.all_gather(x, axis)  # [n, ...]
     idx = lax.axis_index(axis)
@@ -257,7 +259,7 @@ def scan(x, axis: str, op=op_mod.SUM):
 def exscan(x, axis: str, op=op_mod.SUM, identity=None):
     """MPI_Exscan (exclusive prefix; rank 0 gets `identity` or zeros)."""
     op = _op_of(op)
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     fn = combine_fn(op)
     g = lax.all_gather(x, axis)
     idx = lax.axis_index(axis)

@@ -121,14 +121,14 @@ class DeviceCommunicator:
         """shard_map `fn` over the mesh: the SPMD region inside which
         this communicator's collectives execute. Compose with jax.jit
         for compilation."""
-        import jax
+        from ompi_tpu.util import jaxcompat
 
         # check_vma=False: collective results (all_gather/psum) are
         # replicated by construction, but the static varying-axes check
         # cannot see that through our op-dispatch indirection.
         kw.setdefault("check_vma", False)
-        return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
-                             out_specs=out_specs, **kw)
+        return jaxcompat.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                                   out_specs=out_specs, **kw)
 
 
 def world_comm(axis_names: Sequence[str] = ("x",),

@@ -24,6 +24,8 @@ from typing import Callable
 import jax.numpy as jnp
 from jax import lax
 
+from ompi_tpu.util import jaxcompat
+
 
 def _ring_perm(n: int, offset: int = 1):
     return [(i, (i + offset) % n) for i in range(n)]
@@ -33,7 +35,7 @@ def ring_reduce_scatter(x, axis: str, fn: Callable = jnp.add):
     """Reduce-scatter with fixed ring order: dim 0 of x (size n*k)
     shrinks to k; rank r ends with chunk r reduced in ring-visit order
     (ranks r+1, r+2, ..., r)."""
-    n = lax.axis_size(axis)
+    n = jaxcompat.axis_size(axis)
     if n == 1:
         return x
     assert x.shape[0] % n == 0, (
@@ -58,7 +60,7 @@ def ring_reduce_scatter(x, axis: str, fn: Callable = jnp.add):
 def ring_allgather(x, axis: str):
     """All-gather chunks around the ring: local [k, ...] -> [n*k, ...]
     with rank i's chunk at block i."""
-    n = lax.axis_size(axis)
+    n = jaxcompat.axis_size(axis)
     if n == 1:
         return x
     k = x.shape[0]
@@ -85,7 +87,7 @@ def ring_allreduce(x, axis: str, fn: Callable = jnp.add):
 
     Handles any dim-0 size by zero-padding to a multiple of n (pad lanes
     never mix with data lanes — reductions are elementwise)."""
-    n = lax.axis_size(axis)
+    n = jaxcompat.axis_size(axis)
     if n == 1:
         return x
     shape = x.shape
@@ -102,7 +104,7 @@ def ring_allreduce(x, axis: str, fn: Callable = jnp.add):
 def ring_rotate(block, axis: str, reverse: bool = False):
     """One ring hop: pass `block` to the next (or previous) rank.
     The ring-attention KV rotation primitive."""
-    n = lax.axis_size(axis)
+    n = jaxcompat.axis_size(axis)
     return lax.ppermute(block, axis,
                         perm=_ring_perm(n, -1 if reverse else 1))
 
@@ -117,7 +119,7 @@ def ring_scan(body: Callable, carry, block, axis: str):
     This is the schedule under ring attention and pipelined
     context-parallel ops (reference analog: segmented pipelines with
     per-segment progress, coll_base_bcast.c chain/pipeline)."""
-    n = lax.axis_size(axis)
+    n = jaxcompat.axis_size(axis)
     r = lax.axis_index(axis)
     perm = _ring_perm(n)
     carry = body(0, r, block, carry)

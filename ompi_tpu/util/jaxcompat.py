@@ -1,0 +1,41 @@
+"""jax version compatibility shims.
+
+The device plane targets the modern ``jax.shard_map`` surface
+(``check_vma=`` keyword, top-level export, jax >= 0.6). Older jax
+releases ship the same transform as ``jax.experimental.shard_map``
+with the varying-axes check spelled ``check_rep=``. Everything in
+ompi_tpu goes through :func:`shard_map` below so the rest of the tree
+can use the modern spelling unconditionally.
+"""
+
+from __future__ import annotations
+
+
+def axis_size(axis) -> int:
+    """Static size of a named mesh axis inside an SPMD region.
+
+    ``jax.lax.axis_size`` is a late addition; on older jax the psum of
+    a Python literal constant-folds at trace time to the axis size, so
+    the result is a plain int in both cases (safe in shape arithmetic).
+    """
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs, **kw):
+    """``jax.shard_map`` with fallback to the pre-0.6 experimental API.
+
+    Accepts the modern ``check_vma=`` keyword and translates it to
+    ``check_rep=`` when only the experimental entry point exists.
+    """
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

@@ -1,0 +1,316 @@
+"""coll/xla fused (bucketed) + persistent collectives.
+
+The gradient-bucketing engine: Allreduce_multi coalesces a pytree of
+device buffers into dtype-segregated flat buckets, ONE compiled psum
+per bucket (cvar coll_xla_bucket_bytes), with the bucket plan cached
+per signature; MPI-4 persistent inits prep (plan+compile+bind) at
+init so Start()+Wait() is a single cached-executable launch. The
+pvar counters (coll_xla_launches / cache hits+misses / fused_bytes /
+plan cache) make both properties assertable, so fusion and
+persistence cannot silently regress to per-buffer or per-start
+recompiles.
+"""
+
+from tests.harness import run_ranks
+
+MCA = {"device_plane": "on"}
+
+
+def test_fused_bit_identical_linear():
+    """deterministic='linear' fused must be BITWISE identical to the
+    per-buffer loop: the linear fold is elementwise over ranks, and
+    concatenation never changes an element's fold order."""
+    run_ranks("""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(7)
+    shapes = [(57,), (8, 9), (3,), (1,), (130,)]
+    bufs = []
+    for s in shapes:
+        # varied exponents make float fold order observable
+        v = (rng.standard_normal(s)
+             * 10.0 ** rng.integers(-3, 4, s)).astype(np.float32)
+        bufs.append(jnp.asarray(np.roll(v, rank)))
+    fused = comm.Allreduce_multi(bufs, deterministic="linear")
+    per = [comm.Allreduce(b, deterministic="linear") for b in bufs]
+    assert len(fused) == len(per)
+    for f, p in zip(fused, per):
+        assert f.shape == p.shape and f.dtype == p.dtype
+        np.testing.assert_array_equal(np.asarray(f), np.asarray(p))
+    """, 4, mca=MCA)
+
+
+def test_fused_pytree_mixed_dtype():
+    """dtype-segregated bucketing: a dict pytree mixing f32 and i32
+    reduces correctly and returns the input structure."""
+    run_ranks("""
+    import jax
+    import jax.numpy as jnp
+    tree = {
+        "w": jnp.arange(6, dtype=jnp.float32) + rank,
+        "b": jnp.full((3,), rank + 1, jnp.int32),
+        "nested": [jnp.ones((2, 2), jnp.float32) * (rank + 1),
+                   jnp.arange(4, dtype=jnp.int32) * (rank + 1)],
+    }
+    out = comm.Allreduce_multi(tree)
+    assert set(out) == {"w", "b", "nested"}
+    np.testing.assert_array_equal(
+        np.asarray(out["b"]), np.full(3, sum(range(1, size + 1)),
+                                      np.int32))
+    np.testing.assert_allclose(
+        np.asarray(out["w"]),
+        size * np.arange(6, dtype=np.float32) + sum(range(size)),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(out["nested"][0]),
+        np.full((2, 2), sum(range(1, size + 1)), np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(out["nested"][1]),
+        np.arange(4) * sum(range(1, size + 1)))
+    # leaves stayed on device, nothing staged
+    from ompi_tpu.core import pvar
+    assert pvar.read("coll_accelerator_staged") == 0
+    assert comm.coll.providers["allreduce_multi_dev"] == "xla"
+    """, 3, mca=MCA)
+
+
+def test_launch_count_regression():
+    """CI guard: a fused allreduce of N small buffers must issue
+    <= ceil(total_bytes/bucket_bytes) + n_dtypes compiled launches
+    (pvar-verified) — fusion cannot silently regress to per-buffer
+    dispatch. 64 small f32 buffers under the 4 MiB default => ONE
+    bucket => one launch (acceptance bound: <= 4)."""
+    run_ranks("""
+    import math
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    bufs = [jnp.full((64,), float(rank + i), jnp.float32)
+            for i in range(64)]
+    total_bytes = 64 * 64 * 4
+    comm.Allreduce_multi(bufs)  # build plan + compile out-of-band
+    s = pvar.session()
+    out = comm.Allreduce_multi(bufs)
+    bucket = 4 << 20  # coll_xla_bucket_bytes default
+    bound = math.ceil(total_bytes / bucket) + 1  # one dtype
+    launches = s.read("coll_xla_launches")
+    assert 1 <= launches <= bound, (launches, bound)
+    assert launches <= 4  # the acceptance ceiling
+    assert s.read("coll_xla_fused_bytes") == total_bytes
+    for i, o in enumerate(out):
+        np.testing.assert_allclose(
+            np.asarray(o), np.full(64, size * i + sum(
+                range(size)), np.float32))
+    """, 3, mca=MCA)
+
+
+def test_bucket_bytes_cvar_splits_buckets():
+    """A small coll_xla_bucket_bytes forces multiple buckets per
+    dtype: launches grow accordingly, results stay correct."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    # 6 x 1200-byte f32 buffers, bucket=2048: fill-until->=2048 closes
+    # a bucket every 2 buffers -> 3 buckets -> 3 launches
+    bufs = [jnp.full((300,), float(i + rank), jnp.float32)
+            for i in range(6)]
+    comm.Allreduce_multi(bufs)  # warm plan + executables
+    s = pvar.session()
+    out = comm.Allreduce_multi(bufs)
+    assert s.read("coll_xla_launches") == 3, \\
+        s.read("coll_xla_launches")
+    for i, o in enumerate(out):
+        np.testing.assert_allclose(
+            np.asarray(o),
+            np.full(300, size * i + sum(range(size)), np.float32))
+    """, 3, mca={**MCA, "coll_xla_bucket_bytes": "2048"})
+
+
+def test_plan_cache_reuse_pvar():
+    """Steady-state steps pay zero re-planning: the bucket plan and
+    the compiled programs build once per signature (pvar-asserted)."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    tree = [jnp.ones((16,), jnp.float32) * (rank + 1),
+            jnp.ones((8,), jnp.float32)]
+    s = pvar.session()
+    for _ in range(3):
+        comm.Allreduce_multi(tree)
+    assert s.read("coll_xla_plan_cache_misses") == 1
+    assert s.read("coll_xla_plan_cache_hits") == 2
+    # compiled once (one bucket), relaunched on every later call
+    assert s.read("coll_xla_cache_misses") == 1
+    assert s.read("coll_xla_launches") == 3
+    # a NEW signature builds a new plan, the old one stays cached
+    comm.Allreduce_multi([jnp.ones((32,), jnp.float32)])
+    assert s.read("coll_xla_plan_cache_misses") == 2
+    """, 3, mca=MCA)
+
+
+def test_persistent_allreduce_zero_recompiles():
+    """Acceptance: Allreduce_init + Start reuses its cached executable
+    across >= 3 starts with ZERO recompiles (the prep hoists plan +
+    compile + operand bind out of the start/wait cycle)."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    x = jnp.full((8,), float(rank + 1), jnp.float32)
+    req = comm.Allreduce_init(x)  # prep: compile + bind happen HERE
+    s = pvar.session()
+    for cycle in range(3):
+        req.start()
+        req.wait()
+        np.testing.assert_allclose(
+            np.asarray(req.array),
+            np.full(8, sum(range(1, size + 1)), np.float32))
+    assert s.read("coll_xla_cache_misses") == 0, "start() recompiled"
+    assert s.read("coll_xla_cache_hits") == 0, "start() re-planned"
+    assert s.read("coll_xla_launches") == 3
+    """, 3, mca=MCA)
+
+
+def test_persistent_fused_multi_restart():
+    """Persistent fused form: Allreduce_multi_init preps every bucket
+    at init; each start launches the cached bucket programs and
+    .array carries the result pytree."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    from ompi_tpu.pml import request as rq
+    bufs = [jnp.full((32,), float(rank + 1), jnp.float32),
+            jnp.full((5,), rank + 1, jnp.int32)]
+    req = comm.Allreduce_multi_init(bufs)
+    s = pvar.session()
+    for cycle in range(3):
+        req.start()
+        rq.wait_all([req], timeout=60)
+        f, i = req.array
+        np.testing.assert_allclose(
+            np.asarray(f), np.full(32, sum(range(1, size + 1)),
+                                   np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(i), np.full(5, sum(range(1, size + 1)),
+                                   np.int32))
+    assert s.read("coll_xla_cache_misses") == 0
+    assert s.read("coll_xla_plan_cache_misses") == 0
+    # two dtype buckets x 3 cycles
+    assert s.read("coll_xla_launches") == 6
+    """, 3, mca=MCA)
+
+
+def test_startall_over_persistent_collectives():
+    """MPI_Startall across several persistent collectives (device and
+    fused): one call starts them all, the plural waits complete them,
+    and the set restarts cleanly."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import mpi as _mpi
+    from ompi_tpu.pml import request as rq
+    reqs = [
+        comm.Allreduce_init(jnp.full((4,), float(rank + 1),
+                                     jnp.float32)),
+        comm.Allgather_init(jnp.full((2,), float(rank), jnp.float32)),
+        comm.Bcast_init(jnp.arange(6, dtype=jnp.float32)
+                        * (1.0 if rank == 0 else 0.0), 0),
+        comm.Allreduce_multi_init(
+            [jnp.ones((3,), jnp.float32) * (rank + 1)]),
+    ]
+    for cycle in range(2):
+        _mpi.Startall(reqs)
+        rq.wait_all(reqs, timeout=60)
+        np.testing.assert_allclose(
+            np.asarray(reqs[0].array),
+            np.full(4, sum(range(1, size + 1)), np.float32))
+        assert np.asarray(reqs[1].array).shape == (size, 2)
+        np.testing.assert_allclose(np.asarray(reqs[2].array),
+                                   np.arange(6, dtype=np.float32))
+        np.testing.assert_allclose(
+            np.asarray(reqs[3].array[0]),
+            np.full(3, sum(range(1, size + 1)), np.float32))
+    """, 3, mca=MCA)
+
+
+def test_to_global_skips_resident_device_put():
+    """Satellite: to_global must not device_put a buffer already
+    resident on ctx.my (it runs on every collective call)."""
+    run_ranks("""
+    import jax
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    comm.Allreduce(jnp.ones(4, jnp.float32))  # builds the ctx
+    my = comm._coll_xla_ctx.my
+    x = jax.device_put(jnp.full((16,), float(rank), jnp.float32), my)
+    s = pvar.session()
+    comm.Allreduce(x)
+    assert s.read("coll_xla_device_put_skipped") >= 1
+    """, 3, mca=MCA)
+
+
+def test_comm_free_releases_ctx_caches():
+    """Satellite: freeing a comm drops its compiled-program and plan
+    caches (long-lived jobs with comm churn must not leak XLA
+    executables + bound device operands)."""
+    run_ranks("""
+    import jax.numpy as jnp
+    sub = comm.split(color=0, key=rank)
+    sub.Allreduce(jnp.ones(4, jnp.float32) * (rank + 1))
+    sub.Allreduce_multi([jnp.ones(2, jnp.float32)])
+    ctx = sub._coll_xla_ctx
+    assert ctx.fns and ctx.plans
+    sub.free()
+    assert "_coll_xla_ctx" not in sub.__dict__
+    assert not ctx.fns and not ctx.plans
+    """, 3, mca=MCA)
+
+
+def test_host_multi_fallthrough():
+    """Host-buffer form: Allreduce_multi loops per buffer on the host
+    path and returns new arrays; no device plane required."""
+    run_ranks("""
+    bufs = [np.arange(5, dtype=np.float64) + rank,
+            np.full(3, rank + 1, np.int64)]
+    out = comm.Allreduce_multi(bufs)
+    np.testing.assert_allclose(
+        out[0], size * np.arange(5, dtype=np.float64)
+        + sum(range(size)))
+    np.testing.assert_array_equal(
+        out[1], np.full(3, sum(range(1, size + 1))))
+    # inputs untouched (the contract returns NEW buffers)
+    np.testing.assert_allclose(bufs[0],
+                               np.arange(5, dtype=np.float64) + rank)
+    """, 3)
+
+
+def test_staged_multi_fallthrough_without_plane():
+    """Device buffers with the plane off fall through to the staged
+    per-buffer loop (coll/accelerator) with correct results."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    assert comm.coll.providers["allreduce_multi_dev"] == "accelerator"
+    s = pvar.session()
+    out = comm.Allreduce_multi([jnp.ones(4, jnp.float32) * (rank + 1),
+                                jnp.arange(3, dtype=jnp.float32)])
+    assert s.read("coll_accelerator_staged") == 2
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.full(4, sum(range(1, size + 1)),
+                                    np.float32))
+    np.testing.assert_allclose(np.asarray(out[1]),
+                               size * np.arange(3, dtype=np.float32))
+    """, 3)
+
+
+def test_host_reduce_scatter_block_init():
+    """The host persistent table now covers reduce_scatter_block
+    (libnbc schedule engine) — the five persistent collectives exist
+    on both the device and the host path."""
+    run_ranks("""
+    send = np.ones(size * 2, np.float32) * (rank + 1)
+    recv = np.zeros(2, np.float32)
+    req = comm.Reduce_scatter_block_init(send, recv)
+    for cycle in range(2):
+        req.start()
+        req.wait()
+        np.testing.assert_allclose(
+            recv, np.full(2, sum(range(1, size + 1)), np.float32))
+        recv[:] = 0
+    """, 3)

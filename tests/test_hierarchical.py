@@ -12,6 +12,7 @@ jax = pytest.importorskip("jax")
 
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
+from ompi_tpu.util import jaxcompat  # noqa: E402
 from ompi_tpu.parallel import collectives as C  # noqa: E402
 from ompi_tpu.parallel import hierarchical as H  # noqa: E402
 
@@ -22,7 +23,7 @@ def _mesh():
 
 def _smap(mesh, body, out_varying=True):
     spec = P(("dcn", "ici")) if out_varying else P()
-    return jax.jit(jax.shard_map(
+    return jax.jit(jaxcompat.shard_map(
         body, mesh=mesh, in_specs=P(("dcn", "ici")), out_specs=spec,
         check_vma=False))
 

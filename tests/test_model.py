@@ -15,6 +15,7 @@ jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
+from ompi_tpu.util import jaxcompat  # noqa: E402
 from ompi_tpu.models import transformer as tfm  # noqa: E402
 from ompi_tpu.parallel import make_mesh  # noqa: E402
 
@@ -40,7 +41,7 @@ def _sharded_step(cfg, ax, mesh, data_spec, params, tokens, labels,
                   lr=1e-2):
     specs = tfm.param_specs(cfg, ax)
     step = tfm.make_train_step(cfg, ax, specs, lr=lr)
-    smapped = jax.shard_map(
+    smapped = jaxcompat.shard_map(
         step, mesh=mesh,
         in_specs=(specs, data_spec, data_spec),
         out_specs=(specs, P()), check_vma=False)
@@ -119,7 +120,7 @@ def test_moe_ep_training_decreases_loss():
     ax = tfm.Axes(ep="ep")
     specs = tfm.param_specs(cfg, ax)
     step = tfm.make_train_step(cfg, ax, specs, lr=1e-1)
-    smapped = jax.jit(jax.shard_map(
+    smapped = jax.jit(jaxcompat.shard_map(
         step, mesh=mesh,
         in_specs=(specs, P("ep"), P("ep")),
         out_specs=(specs, P()), check_vma=False))
@@ -142,7 +143,7 @@ def test_moe_tp_ep_runs():
     ax = tfm.Axes(ep="ep", tp="tp")
     specs = tfm.param_specs(cfg, ax)
     step = tfm.make_train_step(cfg, ax, specs, lr=1e-1)
-    smapped = jax.jit(jax.shard_map(
+    smapped = jax.jit(jaxcompat.shard_map(
         step, mesh=mesh,
         in_specs=(specs, P("ep"), P("ep")),
         out_specs=(specs, P()), check_vma=False))

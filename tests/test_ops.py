@@ -8,6 +8,7 @@ jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
+from ompi_tpu.util import jaxcompat  # noqa: E402
 from ompi_tpu.ops import attention as att  # noqa: E402
 from ompi_tpu.ops import moe as moe_mod  # noqa: E402
 from ompi_tpu.ops.ring_attention import ring_attention  # noqa: E402
@@ -34,7 +35,7 @@ def test_ring_attention_matches_mha(mesh, causal):
     ref = np.asarray(att.mha(jnp.array(q), jnp.array(k), jnp.array(v),
                              causal=causal))
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(jaxcompat.shard_map(
         lambda a, b, c: ring_attention(a, b, c, "sp", causal=causal),
         mesh=mesh,
         in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
@@ -93,7 +94,7 @@ def test_moe_ffn_matches_oracle(mesh):
 
     cap = max(int(1.25 * T_local / e_total), 1)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(jaxcompat.shard_map(
         lambda xx, ww1, ww2: moe_mod.moe_ffn(
             xx, jnp.array(wg), ww1, ww2, "sp"),
         mesh=mesh, in_specs=(P("sp"), P("sp"), P("sp")),
@@ -122,7 +123,7 @@ def test_ulysses_attention_matches_mha(mesh, causal):
 
     ref = np.asarray(att.mha(jnp.array(q), jnp.array(k), jnp.array(v),
                              causal=causal))
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(jaxcompat.shard_map(
         lambda a, b, c: ulysses_attention(a, b, c, "sp", causal=causal),
         mesh=mesh,
         in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
@@ -141,7 +142,7 @@ def test_ulysses_ring_agree(mesh):
                for _ in range(3))
     outs = []
     for fn in (ulysses_attention, ring_attention):
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(jaxcompat.shard_map(
             lambda a, b, c, fn=fn: fn(a, b, c, "sp", causal=True),
             mesh=mesh,
             in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),

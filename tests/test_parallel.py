@@ -13,6 +13,7 @@ jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
+from ompi_tpu.util import jaxcompat  # noqa: E402
 from ompi_tpu import op as op_mod  # noqa: E402
 from ompi_tpu.parallel import (  # noqa: E402
     DeviceCommunicator, collectives as C, make_mesh, ring, world_comm,
@@ -216,7 +217,7 @@ def test_2d_mesh_subcomms():
     def fn(a):
         return dp.Allreduce(a), tp.Allreduce(a), world.Allreduce(a)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(jaxcompat.shard_map(
         fn, mesh=mesh, in_specs=P("dp", "tp"),
         out_specs=(P("dp", "tp"),) * 3))
     odp, otp, ow = map(np.asarray, f(x))

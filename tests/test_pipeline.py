@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from ompi_tpu.util import jaxcompat  # noqa: E402
 from ompi_tpu.models import pipeline as pl
 from ompi_tpu.models import transformer as tfm
 from ompi_tpu.parallel import make_mesh
@@ -45,13 +46,13 @@ def test_pipeline_forward_matches_layer_loop():
     mesh = _mesh_pp(2)
     stacked = pl.stack_layers(params)
     specs = pl.stacked_param_specs(cfg, ax)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(jaxcompat.shard_map(
         lambda p, tk: pl.pipeline_forward(p, tk, cfg, ax, n_micro=2),
         mesh=mesh, in_specs=(specs, P()), out_specs=P(),
         check_vma=False))
     # out_specs P() replicates — but only the last stage's logits are
     # real; shard_map P() takes device 0's value, so fetch per-shard
-    fn2 = jax.jit(jax.shard_map(
+    fn2 = jax.jit(jaxcompat.shard_map(
         lambda p, tk: pl.pipeline_forward(p, tk, cfg, ax,
                                           n_micro=2)[None],
         mesh=mesh, in_specs=(specs, P()), out_specs=P("pp"),
@@ -79,7 +80,7 @@ def test_pp_train_step_runs_and_matches_dense():
     mesh = _mesh_pp(2)
     stacked = pl.stack_layers(params)
     specs = pl.stacked_param_specs(cfg, ax)
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(jaxcompat.shard_map(
         pl.make_pp_train_step(cfg, ax, specs, n_micro=2, lr=0.1),
         mesh=mesh, in_specs=(specs, P(), P()), out_specs=(specs, P()),
         check_vma=False))
@@ -119,7 +120,7 @@ def test_pp_moe_with_tp_grad_sync():
 
     stacked = pl.stack_layers(params)
     specs = pl.stacked_param_specs(cfg, ax)
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(jaxcompat.shard_map(
         pl.make_pp_train_step(cfg, ax, specs, n_micro=2, lr=0.1),
         mesh=mesh, in_specs=(specs, P(), P()), out_specs=(specs, P()),
         check_vma=False))
@@ -149,7 +150,7 @@ def test_pp_with_tp_and_sp():
 
     stacked = pl.stack_layers(params)
     specs = pl.stacked_param_specs(cfg, ax)
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(jaxcompat.shard_map(
         pl.make_pp_train_step(cfg, ax, specs, n_micro=2, lr=0.1),
         mesh=mesh, in_specs=(specs, P(), P()), out_specs=(specs, P()),
         check_vma=False))
