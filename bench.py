@@ -289,6 +289,68 @@ def _bench_dispatch():
     }
 
 
+def _bench_overlap():
+    """Partitioned vs all-at-Start fused allreduce on the 1-device
+    local context (identity collective — pure dispatch cost; the
+    overlap WIN needs real wire time, so on TPU the partitioned wall
+    time dropping below fused+backward is the cross-round number to
+    watch). Measures a 32 x 256 KB f32 gradient set (2 buckets at the
+    default 4 MiB target): per-cycle wall time of Start + per-leaf
+    Pready + Wait against the all-at-once fused launcher, plus launch
+    and overlap-flush counts per cycle from the pvars."""
+    import types
+
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu import op as op_mod
+    from ompi_tpu.coll import xla as cx
+    from ompi_tpu.core import pvar
+
+    ctx = cx._Ctx.local()
+    comm = types.SimpleNamespace(_coll_xla_ctx=ctx)
+    bufs = [jnp.full((65536,), float(i), jnp.float32)  # 32 x 256 KB
+            for i in range(32)]
+    n = len(bufs)
+
+    fused = cx._allreduce_multi_prep(comm, bufs)
+    jax.block_until_ready(jax.tree.leaves(fused()))  # compile + warm
+    leaves, treedef = jax.tree.flatten(bufs)
+    preq = cx.PartitionedAllreduceRequest(ctx, leaves, treedef,
+                                          op_mod.SUM, None)
+    preq.start()
+    preq.Pready_range(0, n - 1)
+    preq.wait()  # warm
+
+    reps = 20
+    s = pvar.session()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fused()
+    jax.block_until_ready(jax.tree.leaves(out))
+    fused_ms = (time.perf_counter() - t0) / reps * 1e3
+    fused_launches = s.read("coll_xla_launches") / reps
+
+    s = pvar.session()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        preq.start()
+        for i in range(n):  # the "backward pass" handing leaves over
+            preq.Pready(i)
+        preq.wait()
+    part_ms = (time.perf_counter() - t0) / reps * 1e3
+    return {
+        "fused_32x256k_ms": round(fused_ms, 3),
+        "partitioned_32x256k_ms": round(part_ms, 3),
+        "launches_per_cycle": s.read("coll_xla_launches") / reps,
+        "fused_launches_per_cycle": fused_launches,
+        "overlap_flushes_per_cycle":
+            s.read("part_overlap_flushes") / reps,
+        "pready_overhead_us_per_leaf": round(
+            (part_ms - fused_ms) / n * 1e3, 2),
+    }
+
+
 def main() -> None:
     t_start = time.time()
     # staging first: the train bench necessarily reads results back
@@ -321,6 +383,12 @@ def main() -> None:
     except Exception as e:  # never let the microbench sink the metric
         _phase(f"dispatch microbench skipped: {e!r}")
         dispatch = None
+    try:
+        overlap = _bench_overlap()
+        _phase("overlap microbench done")
+    except Exception as e:
+        _phase(f"overlap microbench skipped: {e!r}")
+        overlap = None
 
     import jax
 
@@ -362,6 +430,7 @@ def main() -> None:
                 None if d2h_chunked is None else round(d2h_chunked, 2),
             "staging_h2d_GBs": None if h2d is None else round(h2d, 2),
             "dispatch": dispatch,
+            "overlap": overlap,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),
             # wall attribution: metric quality depends only on

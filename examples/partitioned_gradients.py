@@ -1,0 +1,71 @@
+"""Backward-overlap gradient sync — MPI-4 partitioned collectives as
+the DDP/Horovod hook pattern.
+
+``Allreduce_multi`` (examples/fused_gradients.py) launches every
+gradient bucket at one point: after the whole backward pass. But a
+backward pass produces gradients LAST layer FIRST — by the time the
+first layer's gradient exists, the last layers' buckets could already
+be on the wire. ``Pallreduce_init`` (the part/ subsystem) expresses
+exactly that: the gradient pytree is bound once, each training step
+``start()``-s a cycle, and every leaf is handed over with ``Pready``
+the moment the backward produces it; a dtype bucket's single compiled
+psum dispatches as soon as its LAST member leaf arrives, overlapping
+early buckets' communication with the rest of the backward.
+``GradientSync`` wraps the key-path bookkeeping.
+
+Run:  python -m ompi_tpu.runtime.launcher -n 4 --mca device_plane on \
+          --mca coll_xla_bucket_bytes 16384 \
+          examples/partitioned_gradients.py
+
+(The small bucket target splits this toy model into several buckets
+so the mid-backward flushes are visible in ``part_overlap_flushes``;
+real models exceed the 4 MiB default many times over.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ompi_tpu import mpi
+from ompi_tpu.core import pvar
+from ompi_tpu.part import GradientSync
+
+comm = mpi.Init()
+rank, size = comm.rank, comm.size
+
+# the gradient template: shapes/dtypes fixed across steps (what the
+# compiled buckets specialize on); values rebind every step
+grads = {
+    "embed": jnp.zeros((256, 32), jnp.float32),
+    "layers": [
+        {"w": jnp.zeros((64, 64), jnp.float32),
+         "b": jnp.zeros((64,), jnp.float32)}
+        for _ in range(4)
+    ],
+}
+
+sync = GradientSync(comm, grads, deterministic="linear")
+paths = [jax.tree_util.keystr(p) for p, _ in
+         jax.tree_util.tree_flatten_with_path(grads)[0]]
+
+leaves = jax.tree.leaves(grads)
+s = pvar.session()
+for step in range(3):
+    sync.start()
+    # "backward pass": produce gradients in reverse-layer order and
+    # hand each one over immediately — buckets flush mid-backward
+    for key in reversed(paths):
+        i = sync.index_of(key)
+        g = jnp.full(leaves[i].shape, float(rank + 1), leaves[i].dtype)
+        sync.push(key, g)
+    synced = sync.finish()
+
+np.testing.assert_allclose(
+    np.asarray(synced["embed"])[0, 0], size * (size + 1) / 2)
+
+if rank == 0:
+    print(f"3 steps: {s.read('part_bucket_flushes')} bucket flushes, "
+          f"{s.read('part_overlap_flushes')} launched before the "
+          f"final Pready (overlapped), "
+          f"{s.read('coll_xla_cache_misses')} recompiles after init")
+mpi.Finalize()

@@ -52,6 +52,9 @@ SLOTS = (
     # fused (bucketed) device allreduce over a list/pytree of buffers
     # + its MPI-4 persistent form (gradient-bucketing hot path)
     "allreduce_multi_dev", "allreduce_multi_init_dev",
+    # MPI-4 partitioned fused allreduce (part/ subsystem device
+    # payoff): per-leaf Pready, bucket flushes on last-member ready
+    "pallreduce_init_dev",
 )
 
 

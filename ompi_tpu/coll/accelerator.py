@@ -321,6 +321,16 @@ def ibarrier_dev(comm):
     return DeviceRequest(None)
 
 
+def pallreduce_init_dev(comm, bufs, op=op_mod.SUM, deterministic=None):
+    """Partitioned fused allreduce over the staged path: full MPI-4
+    Pready/Parrived bookkeeping with the reduction deferred to wait()
+    (no device-plane overlap — coll/xla owns that payoff)."""
+    from ompi_tpu.coll import xla as _xla
+
+    return _xla._TrivialPartitionedAllreduce(comm, bufs, op,
+                                             deterministic)
+
+
 @framework.register
 class CollAccelerator(CollModule):
     NAME = "accelerator"
@@ -371,6 +381,7 @@ class CollAccelerator(CollModule):
             "iscatterv_dev": _istaged(scatterv_dev),
             "allreduce_multi_dev": allreduce_multi_dev,
             "allreduce_multi_init_dev": _pstaged(allreduce_multi_dev),
+            "pallreduce_init_dev": pallreduce_init_dev,
             "allreduce_init_dev": _pstaged(allreduce_dev),
             "bcast_init_dev": _pstaged(bcast_dev),
             "allgather_init_dev": _pstaged(allgather_dev),

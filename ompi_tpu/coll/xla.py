@@ -33,7 +33,7 @@ from typing import Optional
 
 import numpy as np
 
-from ompi_tpu import op as op_mod
+from ompi_tpu import errors, op as op_mod
 from ompi_tpu.coll import CollModule, accelerator as staging, framework
 from ompi_tpu.core import cvar, output, pvar
 
@@ -109,6 +109,18 @@ _bucket_var = cvar.register(
          "bounds compiled launches to ceil(total_bytes/bucket_bytes) "
          "+ n_dtypes. 0 fuses each dtype into a single bucket "
          "regardless of size.", level=5)
+
+_cache_max_var = cvar.register(
+    "coll_xla_cache_max", 0, int,
+    help="LRU bound on the per-comm compiled-program and bucket-plan "
+         "caches (each of _Ctx.fns / _Ctx.plans independently): under "
+         "shape churn these otherwise grow without bound "
+         "(coll_xla_fns_size / coll_xla_plans_size pvars are the "
+         "monitor). 0 [default] = unbounded. Eviction drops only the "
+         "cache entry — handles that already hold the compiled "
+         "launcher (persistent/partitioned inits, in-flight requests) "
+         "keep working; the next cold call recompiles. Evictions "
+         "count in the coll_xla_cache_evictions pvar.", level=6)
 
 _hier_var = cvar.register(
     "coll_xla_hier", "auto", str,
@@ -247,14 +259,18 @@ class _Ctx:
     def compiled(self, key, build):
         """Get-or-build a compiled program. Hit/miss/size pvars make
         cache churn (shape-varying workloads recompiling every call)
-        visible via MPI_T instead of only via wall time."""
+        visible via MPI_T instead of only via wall time. Bounded LRU
+        when cvar coll_xla_cache_max > 0 (insertion order IS recency:
+        hits reinsert)."""
         fn = self.fns.get(key)
         if fn is None:
             pvar.record("coll_xla_cache_misses")
             fn = self.fns[key] = build()
             pvar.record_hwm("coll_xla_fns_size", len(self.fns))
+            self._evict(self.fns)
         else:
             pvar.record("coll_xla_cache_hits")
+            self.fns[key] = self.fns.pop(key)  # LRU touch
         return fn
 
     def plan(self, key, build):
@@ -265,9 +281,18 @@ class _Ctx:
             pvar.record("coll_xla_plan_cache_misses")
             p = self.plans[key] = build()
             pvar.record_hwm("coll_xla_plans_size", len(self.plans))
+            self._evict(self.plans)
         else:
             pvar.record("coll_xla_plan_cache_hits")
+            self.plans[key] = self.plans.pop(key)  # LRU touch
         return p
+
+    @staticmethod
+    def _evict(cache) -> None:
+        mx = int(_cache_max_var.get())
+        while mx > 0 and len(cache) > mx:
+            cache.pop(next(iter(cache)))  # oldest-touched first
+            pvar.record("coll_xla_cache_evictions")
 
     def launch(self, fn, *args):
         """Dispatch one compiled collective program. Every device-path
@@ -1047,6 +1072,56 @@ class _FusePlan:
         self.nbytes = sum(m[2] for m in metas)
 
 
+def _fuse_metas(leaves):
+    return tuple((tuple(l.shape), str(l.dtype),
+                  int(l.size) * np.dtype(l.dtype).itemsize)
+                 for l in leaves)
+
+
+def _fuse_plan(ctx, metas, treedef, opn, det):
+    bb = int(_bucket_var.get())
+    return ctx.plan((metas, treedef, opn.name, det, bb),
+                    lambda: _FusePlan(metas, bb))
+
+
+def _bucket_fn(ctx, metas, idxs, opn, det: Optional[str], hier: bool):
+    """ONE compiled concat+allreduce+split program for a bucket. The
+    cache key depends only on (member signature, op, mode) — the
+    all-at-Start fused path and the partitioned path resolve to the
+    SAME executable, which is what makes Pallreduce_init bit-identical
+    to Allreduce_multi by construction."""
+    from ompi_tpu.parallel import collectives as C
+
+    sig = tuple((metas[i][0], metas[i][1]) for i in idxs)
+
+    def build():
+        def body(args):
+            import jax.numpy as jnp
+
+            flat = (jnp.concatenate(
+                [a[0].reshape(-1) for a in args])
+                if len(args) > 1 else args[0][0].reshape(-1))
+            if hier:
+                from ompi_tpu.parallel import hierarchical as H
+
+                red = H.allreduce(flat, op=opn)
+            else:
+                red = C.allreduce(flat, AXIS, opn, det)
+            outs, off = [], 0
+            for a in args:  # static split back to member shapes
+                n = a[0].size
+                outs.append(red[off:off + n].reshape(a.shape[1:]))
+                off += n
+            return tuple(outs)
+
+        if hier:
+            return ctx.smap_hier(body, out_varying=False)
+        return ctx.smap(body, out_varying=False)
+
+    return ctx.compiled(("fused_allreduce", sig, opn.name, det, hier),
+                        build)
+
+
 def _fuse_prep(ctx, comm, leaves, treedef, opn,
                det: Optional[str]):
     """Build (or reuse) the bucket plan and each bucket's ONE compiled
@@ -1059,46 +1134,14 @@ def _fuse_prep(ctx, comm, leaves, treedef, opn,
     identical to the per-buffer loop (tested)."""
     import jax
 
-    metas = tuple((tuple(l.shape), str(l.dtype),
-                   int(l.size) * np.dtype(l.dtype).itemsize)
-                  for l in leaves)
-    bb = int(_bucket_var.get())
-    plan = ctx.plan((metas, treedef, opn.name, det, bb),
-                    lambda: _FusePlan(metas, bb))
+    metas = _fuse_metas(leaves)
+    plan = _fuse_plan(ctx, metas, treedef, opn, det)
     hier = det is None and ctx.mesh2d is not None
     to_g = ctx.to_global_hier if hier else ctx.to_global
-    from ompi_tpu.parallel import collectives as C
 
     launches = []
     for idxs in plan.buckets:
-        sig = tuple((metas[i][0], metas[i][1]) for i in idxs)
-
-        def build(idxs=idxs):
-            def body(args):
-                import jax.numpy as jnp
-
-                flat = (jnp.concatenate(
-                    [a[0].reshape(-1) for a in args])
-                    if len(args) > 1 else args[0][0].reshape(-1))
-                if hier:
-                    from ompi_tpu.parallel import hierarchical as H
-
-                    red = H.allreduce(flat, op=opn)
-                else:
-                    red = C.allreduce(flat, AXIS, opn, det)
-                outs, off = [], 0
-                for a in args:  # static split back to member shapes
-                    n = a[0].size
-                    outs.append(red[off:off + n].reshape(a.shape[1:]))
-                    off += n
-                return tuple(outs)
-
-            if hier:
-                return ctx.smap_hier(body, out_varying=False)
-            return ctx.smap(body, out_varying=False)
-
-        fn = ctx.compiled(("fused_allreduce", sig, opn.name, det,
-                           hier), build)
+        fn = _bucket_fn(ctx, metas, idxs, opn, det, hier)
         gs = tuple(to_g(leaves[i]) for i in idxs)
         launches.append((fn, gs, idxs))
 
@@ -1257,6 +1300,12 @@ class PersistentDeviceRequest:
         self._inner = DeviceRequest(self._launch())
 
     @property
+    def active(self) -> bool:
+        """A started cycle whose result is not yet ready (start_all
+        refuses to restart these — MPI calls it erroneous)."""
+        return self._inner is not None and not self._inner.test()
+
+    @property
     def completed(self) -> bool:
         """Live view over the in-flight cycle, so the plural wait/test
         helpers (which poll .completed) see device completion; an
@@ -1351,6 +1400,316 @@ allreduce_multi_init_dev = _pprep(
     gates=(_gate_op, _gate_size1, _multi_empty))
 
 
+# ---------------------------------------------------------------------------
+# partitioned fused allreduce (MPI-4 part/ subsystem, device payoff)
+
+
+class PartitionedAllreduceRequest:
+    """MPI-4 partitioned fused allreduce handle (Pallreduce_init —
+    the part/ subsystem's device-path payoff).
+
+    Partitions are the leaves of the bound pytree in jax.tree.flatten
+    order. Init does the full prep: the _FusePlan dtype-bucket layout
+    and each bucket's ONE compiled concat+reduce+split program are
+    resolved through the SAME _Ctx caches and keys as Allreduce_multi
+    (shared executables -> bit-identical under 'linear', zero
+    recompiles after init — pvar-verified). start() opens a cycle;
+    Pready(i[, value]) marks leaf i ready — optionally rebinding this
+    cycle's fresh value — and the moment a bucket's LAST member leaf
+    is ready its compiled psum dispatches (PJRT-async), so early
+    buckets' communication overlaps production of later gradients
+    (the DDP/Horovod backward-hook overlap, through a standard MPI-4
+    surface). wait() drains the tail and assembles ``.array``.
+
+    Duck-types the request contract (completed/test/wait/free);
+    inactive reads as complete, per MPI."""
+
+    def __init__(self, ctx, leaves, treedef, opn,
+                 det: Optional[str]) -> None:
+        from ompi_tpu.pml import request as rq
+
+        self.id = next(rq._req_ids)
+        self.status = rq.Status()
+        self.persistent = True
+        self._ctx = ctx
+        self._treedef = treedef
+        self._n = len(leaves)
+        metas = _fuse_metas(leaves)
+        plan = _fuse_plan(ctx, metas, treedef, opn, det)
+        self.nbytes = plan.nbytes
+        hier = det is None and ctx.mesh2d is not None
+        self._to_g = ctx.to_global_hier if hier else ctx.to_global
+        self._metas = metas
+        self._buckets = tuple(
+            (_bucket_fn(ctx, metas, idxs, opn, det, hier), idxs)
+            for idxs in plan.buckets)
+        self._leaf_bucket = {i: b
+                             for b, (_fn, idxs)
+                             in enumerate(self._buckets)
+                             for i in idxs}
+        # template operands bound now: a Pready without a fresh value
+        # (static tensors, tests) reuses them — jax arrays are
+        # immutable, so rebinding is per-cycle state, not mutation
+        self._bound = [self._to_g(l) for l in leaves]
+        self._ready = None  # None = inactive
+        self._arr = None
+
+    @property
+    def active(self) -> bool:
+        return self._ready is not None
+
+    @property
+    def array(self):
+        """The synced pytree of the last completed cycle."""
+        return self._arr
+
+    def start(self) -> None:
+        if self.active:
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                "Pallreduce start: previous cycle still active — "
+                "wait() it to completion first (starting an active "
+                "request is erroneous)")
+        self._ready = [False] * self._n
+        self._n_ready = 0
+        self._pending = [len(idxs) for _fn, idxs in self._buckets]
+        self._results = [None] * len(self._buckets)
+
+    def Pready(self, idx: int, value=None) -> None:
+        if self._ready is None:
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                f"Pready({idx}): request inactive — call start() "
+                "before marking partitions ready")
+        if self._ready[idx]:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                f"Pready({idx}): partition already marked ready "
+                "this cycle (double-Pready is erroneous)")
+        if value is not None:
+            shape, dtype, _nb = self._metas[idx]
+            if tuple(value.shape) != shape or str(value.dtype) != dtype:
+                raise ValueError(
+                    f"Pready({idx}): value {tuple(value.shape)}/"
+                    f"{value.dtype} does not match the bound template "
+                    f"leaf {shape}/{dtype} (compiled programs are "
+                    "shape-specialized; re-init for a new signature)")
+            self._bound[idx] = self._to_g(value)
+        self._ready[idx] = True
+        self._n_ready += 1
+        pvar.record("part_pready")
+        b = self._leaf_bucket[idx]
+        self._pending[b] -= 1
+        if self._pending[b] == 0:
+            self._flush(b)
+
+    def Pready_range(self, lo: int, hi: int) -> None:
+        for i in range(lo, hi + 1):
+            self.Pready(i)
+
+    def Pready_list(self, idxs) -> None:
+        for i in idxs:
+            self.Pready(i)
+
+    def _flush(self, b: int) -> None:
+        fn, idxs = self._buckets[b]
+        self._results[b] = self._ctx.launch(
+            fn, tuple(self._bound[i] for i in idxs))
+        pvar.record("part_bucket_flushes")
+        if self._n_ready < self._n:
+            # dispatched while later partitions are still pending:
+            # this bucket's wire time is hidden behind the producer
+            pvar.record("part_overlap_flushes")
+
+    @property
+    def completed(self) -> bool:
+        """Live view for the plural wait/test helpers; inactive is
+        complete (MPI). An active cycle with unready partitions is
+        incomplete — only wait() raises on it (a poll is not a
+        completion demand)."""
+        if self._ready is None:
+            return True
+        if self._n_ready < self._n:
+            return False
+        import jax
+
+        try:
+            return all(bool(a.is_ready())
+                       for r in self._results
+                       for a in jax.tree.leaves(r))
+        except AttributeError:  # backend without is_ready
+            jax.block_until_ready(self._results)
+            return True
+
+    def test(self) -> bool:
+        return self.completed
+
+    def _finalize(self) -> None:
+        """Close the cycle: split the bucket results back into leaf
+        shards, block, publish ``.array``, go inactive."""
+        import jax
+
+        outs = [None] * self._n
+        for b, (_fn, idxs) in enumerate(self._buckets):
+            res = self._results[b]
+            for j, i in enumerate(idxs):
+                outs[i] = self._ctx.my_shard(res[j])
+        jax.block_until_ready(outs)
+        pvar.record("coll_xla_fused_bytes", self.nbytes)
+        self._arr = jax.tree.unflatten(self._treedef, outs)
+        self._ready = None  # cycle closed: back to inactive
+
+    def wait(self, timeout=None):
+        if self._ready is None:
+            return self.status  # inactive: immediately complete
+        if self._n_ready < self._n:
+            missing = [i for i, r in enumerate(self._ready) if not r]
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                f"Pallreduce wait: partitions {missing} never marked "
+                "ready — the bucket collective cannot launch and the "
+                "wait would deadlock every rank")
+        self._finalize()
+        return self.status
+
+    def retrieve_status(self):
+        # the plural helpers (rq.wait_all/test_all) complete a request
+        # via completed + retrieve_status, never wait(): a fully-ready
+        # cycle must finalize here too or .array would stay stale
+        if self._ready is not None and self._n_ready == self._n:
+            self._finalize()
+        return self.status
+
+    def cancel(self) -> None:  # dispatched programs not cancelable
+        pass
+
+    def free(self) -> None:
+        pass
+
+
+class _TrivialPartitionedAllreduce:
+    """Degenerate Pallreduce handle for the gated cases (size-1 comm,
+    non-traceable op, empty pytree): full partitioned bookkeeping —
+    identical Pready/start/wait semantics and errors — with the
+    reduction itself deferred to wait() through the comm's
+    allreduce_multi slot. Correct, no overlap."""
+
+    def __init__(self, comm, bufs, op, deterministic) -> None:
+        import jax
+
+        from ompi_tpu.pml import request as rq
+
+        self.id = next(rq._req_ids)
+        self.status = rq.Status()
+        self.persistent = True
+        self._comm = comm
+        self._op = op
+        self._det = deterministic
+        leaves, self._treedef = jax.tree.flatten(bufs)
+        self._bound = list(leaves)
+        self._n = len(leaves)
+        self._ready = None
+        self._arr = None
+
+    @property
+    def active(self) -> bool:
+        return self._ready is not None
+
+    @property
+    def array(self):
+        return self._arr
+
+    @property
+    def completed(self) -> bool:
+        return self._ready is None or self._n_ready == self._n
+
+    def start(self) -> None:
+        if self.active:
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                "Pallreduce start: previous cycle still active")
+        self._ready = [False] * self._n
+        self._n_ready = 0
+
+    def Pready(self, idx: int, value=None) -> None:
+        if self._ready is None:
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                f"Pready({idx}): request inactive — call start() "
+                "before marking partitions ready")
+        if self._ready[idx]:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                f"Pready({idx}): partition already marked ready "
+                "this cycle (double-Pready is erroneous)")
+        if value is not None:
+            self._bound[idx] = value
+        self._ready[idx] = True
+        self._n_ready += 1
+        pvar.record("part_pready")
+
+    def Pready_range(self, lo: int, hi: int) -> None:
+        for i in range(lo, hi + 1):
+            self.Pready(i)
+
+    def Pready_list(self, idxs) -> None:
+        for i in idxs:
+            self.Pready(i)
+
+    def test(self) -> bool:
+        return self.completed
+
+    def _finalize(self) -> None:
+        import jax
+
+        tree = jax.tree.unflatten(self._treedef, self._bound)
+        self._arr = self._comm.coll.allreduce_multi_dev(
+            self._comm, tree, self._op, deterministic=self._det)
+        self._ready = None
+
+    def wait(self, timeout=None):
+        if self._ready is None:
+            return self.status
+        if self._n_ready < self._n:
+            missing = [i for i, r in enumerate(self._ready) if not r]
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                f"Pallreduce wait: partitions {missing} never marked "
+                "ready")
+        self._finalize()
+        return self.status
+
+    def retrieve_status(self):
+        if self._ready is not None and self._n_ready == self._n:
+            self._finalize()
+        return self.status
+
+    def cancel(self) -> None:
+        pass
+
+    def free(self) -> None:
+        pass
+
+
+def pallreduce_init_dev(comm, bufs, op=op_mod.SUM,
+                        deterministic: Optional[str] = None):
+    """Partitioned fused allreduce init (MPI-4 part/ on the device
+    plane): one partition per pytree leaf; each dtype bucket's single
+    compiled psum launches the moment its last member leaf is
+    Pready'd, overlapping early buckets' communication with late
+    gradients' production. Shares plan + executable caches with
+    allreduce_multi_dev."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(bufs)
+    if not _op_ok(op) or comm.size == 1 or not leaves:
+        return _TrivialPartitionedAllreduce(comm, bufs, op,
+                                            deterministic)
+    opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
+    return PartitionedAllreduceRequest(_ctx(comm), leaves, treedef,
+                                       opn, _det(deterministic))
+
+
 def _irequest(fn):
     """i-variant of a device slot: same dispatch, no block — the
     blocking slots already return un-awaited futures, so the i-form
@@ -1403,6 +1762,8 @@ class CollXla(CollModule):
             # fused gradient-bucket allreduce (+ persistent form)
             "allreduce_multi_dev": allreduce_multi_dev,
             "allreduce_multi_init_dev": allreduce_multi_init_dev,
+            # MPI-4 partitioned fused allreduce (part/ device payoff)
+            "pallreduce_init_dev": pallreduce_init_dev,
             "reduce_dev": reduce_dev,
             "bcast_dev": bcast_dev,
             "allgather_dev": allgather_dev,

@@ -299,6 +299,8 @@ class Communicator(AttrHost):
             ctx.release()
         self.__dict__.pop("_coll_xla_scatter_meta", None)
         self.__dict__.pop("_coll_xla_a2av_meta", None)
+        # partitioned-p2p pairing epochs (part/host) die with the cid
+        self.__dict__.pop("_part_epochs", None)
         with _comms_lock:
             _comms.pop(self.cid, None)
 

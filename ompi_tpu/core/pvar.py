@@ -33,6 +33,14 @@ WELL_KNOWN = (
     "coll_xla_launches", "coll_xla_cache_hits", "coll_xla_cache_misses",
     "coll_xla_fused_bytes", "coll_xla_plan_cache_hits",
     "coll_xla_plan_cache_misses", "coll_xla_device_put_skipped",
+    "coll_xla_cache_evictions",
+    # part/ (MPI-4 partitioned communication): host p2p epoch starts +
+    # Pready/Parrived traffic; device Pallreduce bucket flushes, with
+    # overlap_flushes counting buckets dispatched BEFORE the cycle's
+    # final Pready (the overlap the subsystem exists for — the
+    # partitioned regression tests assert on these)
+    "part_send_start", "part_recv_start", "part_pready",
+    "part_parrived", "part_bucket_flushes", "part_overlap_flushes",
     "put", "get", "accumulate", "win_lock",
     "eager", "rndv", "rget",
     "time_progress_ns",

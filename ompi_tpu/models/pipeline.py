@@ -20,6 +20,15 @@ in-flight tick, the same bound 1F1B targets.
 Constraints: homogeneous layers (all dense or all MoE — stacking
 requires one pytree structure), n_layers % pp == 0, global batch
 divisible by n_micro.
+
+The host-plane face of the same idea lives at the bottom of this
+module: :func:`stage_handoff_send` / :func:`stage_handoff_recv` wrap
+the part/ subsystem's Psend_init/Precv_init with one partition per
+microbatch, for pipelines whose stages run as separate MPI ranks
+(heterogeneous stages the stacked scan cannot express) — the producer
+``Pready``-s microbatch i the moment its stage compute finishes, the
+consumer ``Parrived``-polls and starts on it while later microbatches
+are still in flight.
 """
 
 from __future__ import annotations
@@ -202,3 +211,42 @@ def make_pp_train_step(cfg: tfm.Config, ax: tfm.Axes, specs,
         return new_params, loss
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# host-plane stage handoff via partitioned p2p (ompi_tpu.part)
+
+
+def stage_handoff_send(comm, acts, n_micro: int, dest: int,
+                       tag: int = 11):
+    """Partitioned send of a stacked microbatch activation buffer
+    [n_micro, ...] to the next pipeline stage: one partition per
+    microbatch. Returns the STARTED PartitionedSendRequest — call
+    ``req.Pready(i)`` as each microbatch's stage compute completes
+    (its transfer then overlaps microbatch i+1's compute) and
+    ``req.wait()`` at the end of the pipeline tick. The request is
+    persistent: re-``start()`` it next tick, same pairing."""
+    acts = np.ascontiguousarray(acts)
+    if acts.shape[0] != n_micro:
+        raise ValueError(
+            f"stage_handoff_send: leading dim {acts.shape[0]} must "
+            f"be n_micro={n_micro} (one partition per microbatch)")
+    req = comm.Psend_init(acts, n_micro, dest, tag)
+    req.start()
+    return req
+
+
+def stage_handoff_recv(comm, buf, n_micro: int, source: int,
+                       tag: int = 11):
+    """Receiving side of :func:`stage_handoff_send`: posts all
+    microbatch partition receives into ``buf`` ([n_micro, ...],
+    C-contiguous — partitions alias it) and returns the STARTED
+    PartitionedRecvRequest. Poll ``req.Parrived(i)`` and start this
+    stage's compute on microbatch i without waiting for the rest."""
+    if buf.shape[0] != n_micro:
+        raise ValueError(
+            f"stage_handoff_recv: leading dim {buf.shape[0]} must "
+            f"be n_micro={n_micro} (one partition per microbatch)")
+    req = comm.Precv_init(buf, n_micro, source, tag)
+    req.start()
+    return req

@@ -111,6 +111,12 @@ class _PersistentRequest(rq.Request):
             buf, count, dt, src, tag = self.args
             self._live = p.irecv(self.comm, buf, count, dt, src, tag)
 
+    @property
+    def active(self) -> bool:
+        """A started operation not yet known complete (start_all
+        refuses to restart these — MPI calls it erroneous)."""
+        return self._live is not None and not self._live.completed
+
     def test(self) -> bool:
         if not self.completed:
             from ompi_tpu.core import progress
@@ -124,7 +130,28 @@ class _PersistentRequest(rq.Request):
         return self._live.wait(timeout=timeout)
 
 
-def start_all(reqs: Sequence[_PersistentRequest]) -> None:
+def start_all(reqs: Sequence[rq.Request]) -> None:
+    """MPI_Startall over any mix of persistent and partitioned
+    requests (Send_init/Recv_init, the *_init collectives,
+    Psend_init/Precv_init, Pallreduce_init). The whole set is
+    validated BEFORE any request starts (all-or-nothing): a
+    non-startable entry raises TypeError, and a request whose
+    previous cycle is still active raises MPIError(ERR_REQUEST) —
+    MPI 4.0 §4.2 calls starting an active request erroneous, and the
+    old silent re-start orphaned the in-flight cycle."""
+    for r in reqs:
+        if not getattr(r, "persistent", False) \
+                or not callable(getattr(r, "start", None)):
+            raise TypeError(
+                f"start_all: request {getattr(r, 'id', r)!r} is not "
+                "a startable (persistent/partitioned) request")
+    for r in reqs:
+        if getattr(r, "active", False):
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                f"start_all: request {getattr(r, 'id', '?')} is "
+                "still active — wait/test it to completion before "
+                "restarting (no request was started)")
     for r in reqs:
         r.start()
 
@@ -748,6 +775,29 @@ def _Allreduce_multi_init(self, bufs, op=op_mod.SUM) -> rq.Request:
     return self.coll.allreduce_multi_init_dev(self, bufs, op)
 
 
+def _Pallreduce_init(self, bufs, op=op_mod.SUM,
+                     deterministic=None) -> rq.Request:
+    """MPI-4 partitioned fused allreduce (the part/ subsystem's
+    device-path payoff): one partition per pytree leaf. Start() opens
+    a cycle; Pready(i[, value]) hands over leaf i — optionally with
+    this cycle's fresh gradient — and a dtype bucket's single
+    compiled psum launches the moment its LAST member leaf is ready,
+    so early buckets' communication overlaps production of later
+    gradients (the DDP backward-hook pattern through a standard MPI
+    surface); Wait() drains the tail into req.array. Shares bucket
+    plans and compiled programs with Allreduce_multi ('linear' stays
+    bit-identical). Device buffers only."""
+    self.check_revoked()
+    self.check_failed()
+    if isinstance(bufs, (list, tuple)) and bufs \
+            and not _is_dev(bufs[0]):
+        raise TypeError(
+            "Pallreduce_init: device buffers only (host partitioned "
+            "transfers: use Psend_init/Precv_init)")
+    return self.coll.pallreduce_init_dev(self, bufs, op,
+                                         deterministic=deterministic)
+
+
 def _Gather(self, sendbuf, recvbuf=None, root: int = 0):
     self.check_revoked()
     self.check_failed()
@@ -1305,6 +1355,7 @@ _API = {
     "Allreduce": _Allreduce, "allreduce": _allreduce,
     "Allreduce_multi": _Allreduce_multi,
     "Allreduce_multi_init": _Allreduce_multi_init,
+    "Pallreduce_init": _Pallreduce_init,
     "Gather": _Gather, "gather": _gather,
     "Gatherv": _Gatherv,
     "Scatter": _Scatter, "scatter": _scatter,
@@ -1343,8 +1394,9 @@ for _name, _fn in _API.items():
 # Communicator methods at import (ompi/mca/topo equivalent)
 from ompi_tpu import topo as _topo  # noqa: E402,F401
 
-# partitioned p2p (MPI-4 Psend_init/Precv_init — ompi/mca/part equiv)
-from ompi_tpu.pml import part as _part  # noqa: E402,F401
+# partitioned communication subsystem (MPI-4 Psend_init/Precv_init +
+# Pallreduce_init — ompi/mca/part equivalent)
+from ompi_tpu import part as _part  # noqa: E402,F401
 
 # intercommunicators + dynamic processes (ompi/communicator + dpm)
 from ompi_tpu.comm.intercomm import (  # noqa: E402,F401

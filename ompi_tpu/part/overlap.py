@@ -1,0 +1,70 @@
+"""Backward-overlap gradient sync — the DDP/Horovod hook pattern over
+MPI-4 partitioned collectives.
+
+PyTorch DDP and Horovod register per-parameter backward hooks that
+feed gradients into buckets and launch a bucket's allreduce the
+moment it fills, overlapping communication with the rest of the
+backward pass. :class:`GradientSync` is that pattern expressed through
+the standard MPI-4 partitioned API instead of ad-hoc hooks: the
+gradient pytree is bound once to a ``Comm.Pallreduce_init`` request
+(one partition per leaf), each training step opens a cycle with
+``start()``, the backward pushes leaves in ANY order via ``push``,
+and every dtype bucket's single compiled psum dispatches as soon as
+its last member leaf arrives; ``finish()`` drains the tail and
+returns the synced pytree.
+
+Leaves are addressed either by flatten index or by the jax key-path
+string of the template (``keystr`` form, e.g. ``"['layers'][0]['w']"``)
+— the string form is what a per-parameter hook naturally has in hand.
+"""
+
+from __future__ import annotations
+
+from ompi_tpu import op as op_mod
+
+
+class GradientSync:
+    """Bind a gradient-pytree template once; per step: ``start()``,
+    ``push(key, grad)`` per leaf as the backward produces it,
+    ``finish()`` -> synced pytree. Push order is free — buckets flush
+    themselves (pvar ``part_overlap_flushes`` counts flushes that
+    beat the final push)."""
+
+    def __init__(self, comm, template, op=op_mod.SUM,
+                 deterministic=None) -> None:
+        import jax
+
+        paths, _ = jax.tree_util.tree_flatten_with_path(template)
+        self._index = {jax.tree_util.keystr(p): i
+                       for i, (p, _leaf) in enumerate(paths)}
+        self.n_leaves = len(paths)
+        self._req = comm.Pallreduce_init(template, op,
+                                         deterministic=deterministic)
+
+    def index_of(self, key) -> int:
+        """Flatten index for a key-path string (or pass-through int)."""
+        return key if isinstance(key, int) else self._index[key]
+
+    def start(self) -> None:
+        """Open a sync cycle (call once per training step, before the
+        backward starts producing gradients)."""
+        self._req.start()
+
+    def push(self, key, grad=None) -> None:
+        """Mark leaf ``key`` ready, optionally rebinding this step's
+        fresh gradient value (same shape/dtype as the template leaf).
+        The leaf's bucket dispatches when its last member arrives."""
+        self._req.Pready(self.index_of(key), grad)
+
+    def finish(self):
+        """Drain remaining buckets and return the synced pytree."""
+        self._req.wait()
+        return self._req.array
+
+    @property
+    def request(self):
+        """The underlying partitioned request (for Startall mixing)."""
+        return self._req
+
+    def free(self) -> None:
+        self._req.free()

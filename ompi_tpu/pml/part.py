@@ -1,174 +1,10 @@
-"""Partitioned point-to-point (MPI-4 Psend/Precv).
+"""Compat shim — partitioned point-to-point moved to the dedicated
+MPI-4 subsystem :mod:`ompi_tpu.part` (host path: ``part.host``; the
+device-path partitioned fused allreduce lives in coll/xla as
+``Pallreduce_init``). Importing this module keeps attaching
+``Comm.Psend_init`` / ``Precv_init`` exactly as before."""
 
-Reference: ompi/mca/part/part.h:124-185 + part/persist (2,261 LoC): a
-partitioned send is a persistent request whose buffer is split into P
-partitions the application marks ready one by one (``Pready``); each
-ready partition moves independently, so fine-grained producers (e.g.
-per-microbatch pipeline stages — SURVEY.md §2.10 maps this machinery to
-pipeline parallelism, the device-plane version of which is
-models/pipeline.py) overlap communication with computation.
-
-Transport: each partition rides the regular PML as an independent
-message on a framework-internal (negative) tag that encodes
-(user tag, pairing epoch, partition index). Pairing follows MPI
-matching rules: Psend_init/Precv_init calls on the same (comm, peer,
-tag) pair up in call order (the per-(peer,tag) epoch counter on both
-sides tracks this without any wire traffic).
-
-Limits (documented, checked): partitions <= 4096, user tag < 1024,
-256 in-flight pairings per (peer, tag) — sized so every encoded tag
-fits the int32 wire field (|PART_BASE| + (1023<<8|255)*4096 + 4095
-< 2^31).
-"""
-
-from __future__ import annotations
-
-from typing import List, Optional
-
-import numpy as np
-
-from ompi_tpu import pml
-from ompi_tpu.pml import request as rq
-
-_PART_BASE = -(1 << 24)  # below any other framework-internal tag
-MAX_PARTITIONS = 4096
-MAX_TAG = 1024  # keeps the encoded tag within int32 (see module doc)
-
-
-def _part_tag(user_tag: int, epoch: int, idx: int) -> int:
-    if not 0 <= user_tag < MAX_TAG:
-        raise ValueError(f"partitioned tag must be in [0,{MAX_TAG})")
-    return _PART_BASE - (((user_tag << 8) | (epoch & 0xFF))
-                         * MAX_PARTITIONS + idx)
-
-
-def _epoch(comm, peer: int, tag: int, side: str) -> int:
-    key = ("part_epoch", side, peer, tag)
-    n = comm.attrs.get(key, 0)
-    comm.attrs[key] = n + 1
-    return n
-
-
-class _PartitionedBase(rq.Request):
-    def __init__(self, comm, buf, partitions: int, peer: int,
-                 tag: int) -> None:
-        super().__init__()
-        if partitions < 1 or partitions > MAX_PARTITIONS:
-            raise ValueError(f"partitions must be in [1,{MAX_PARTITIONS}]")
-        arr = np.asarray(buf)
-        if not arr.flags.c_contiguous:
-            # reshape(-1) would copy: partition views must alias the
-            # user's buffer (recv data lands in them; send reads them
-            # at Pready time) — same contract the Convertor enforces
-            raise ValueError(
-                "partitioned buffers must be C-contiguous")
-        flat = arr.reshape(-1)
-        if flat.size % partitions:
-            raise ValueError(
-                f"buffer of {flat.size} elements not divisible into "
-                f"{partitions} partitions")
-        self.persistent = True
-        self.comm = comm
-        self.peer = peer
-        self.tag = tag
-        self.partitions = partitions
-        self._chunks = np.split(flat, partitions)  # views
-        self.completed = True  # inactive until start()
-
-    def _chunk_reqs(self) -> List[Optional[rq.Request]]:
-        return [None] * self.partitions
-
-
-class PartitionedSendRequest(_PartitionedBase):
-    """MPI_Psend_init handle: Start() activates an epoch, Pready(i)
-    launches partition i, completion = every partition sent."""
-
-    def start(self) -> None:
-        self._ep = _epoch(self.comm, self.peer, self.tag, "send")
-        self._reqs = self._chunk_reqs()
-        self._ready = [False] * self.partitions
-        self.completed = False
-
-    def Pready(self, idx: int) -> None:
-        if self.completed or self._ready[idx]:
-            raise RuntimeError(
-                f"Pready({idx}): partition already ready or request "
-                "inactive (MPI_ERR_ARG)")
-        self._ready[idx] = True
-        chunk = self._chunks[idx]
-        self._reqs[idx] = pml.current().isend(
-            self.comm, chunk, chunk.size, None, self.peer,
-            _part_tag(self.tag, self._ep, idx))
-
-    def Pready_range(self, lo: int, hi: int) -> None:
-        for i in range(lo, hi + 1):
-            self.Pready(i)
-
-    def Pready_list(self, idxs) -> None:
-        for i in idxs:
-            self.Pready(i)
-
-    def test(self) -> bool:
-        if self.completed:
-            return True
-        if all(self._ready) and all(r.test() for r in self._reqs):
-            self.completed = True
-        return self.completed
-
-    def wait(self, timeout=None):
-        from ompi_tpu.core import progress
-
-        progress.wait_until(self.test)
-        return self.status
-
-
-class PartitionedRecvRequest(_PartitionedBase):
-    """MPI_Precv_init handle: Start() posts all partition receives,
-    Parrived(i) polls one, completion = all arrived."""
-
-    def start(self) -> None:
-        ep = _epoch(self.comm, self.peer, self.tag, "recv")
-        p = pml.current()
-        self._reqs = [
-            p.irecv(self.comm, self._chunks[i], self._chunks[i].size,
-                    None, self.peer, _part_tag(self.tag, ep, i))
-            for i in range(self.partitions)]
-        self.completed = False
-
-    def Parrived(self, idx: int) -> bool:
-        if self.completed:
-            return True
-        return self._reqs[idx].test()
-
-    def test(self) -> bool:
-        if self.completed:
-            return True
-        if all(r.test() for r in self._reqs):
-            self.completed = True
-        return self.completed
-
-    def wait(self, timeout=None):
-        from ompi_tpu.core import progress
-
-        progress.wait_until(self.test)
-        return self.status
-
-
-def _Psend_init(self, buf, partitions: int, dest: int,
-                tag: int = 0) -> PartitionedSendRequest:
-    return PartitionedSendRequest(self, buf, partitions, dest, tag)
-
-
-def _Precv_init(self, buf, partitions: int, source: int,
-                tag: int = 0) -> PartitionedRecvRequest:
-    return PartitionedRecvRequest(self, buf, partitions, source, tag)
-
-
-def attach() -> None:
-    from ompi_tpu.comm import Communicator
-
-    Communicator.Psend_init = _Psend_init
-    Communicator.Precv_init = _Precv_init
-
-
-attach()
+from ompi_tpu.part.host import (  # noqa: F401
+    MAX_PARTITIONS, MAX_TAG, PartitionedRecvRequest,
+    PartitionedSendRequest, _Precv_init, _Psend_init, attach,
+)

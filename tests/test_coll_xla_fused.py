@@ -314,3 +314,32 @@ def test_host_reduce_scatter_block_init():
             recv, np.full(2, sum(range(1, size + 1)), np.float32))
         recv[:] = 0
     """, 3)
+
+
+def test_cache_lru_eviction():
+    """cvar coll_xla_cache_max bounds _Ctx.fns with LRU order:
+    hits refresh recency, inserts evict the oldest-touched entry,
+    and the coll_xla_cache_evictions pvar counts the drops."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    shapes = {"a": 8, "b": 12, "c": 16}
+    x = {k: jnp.full((n,), float(rank + 1), jnp.float32)
+         for k, n in shapes.items()}
+    s = pvar.session()
+    comm.Allreduce(x["a"])           # miss          fns: a
+    comm.Allreduce(x["b"])           # miss          fns: a b
+    comm.Allreduce(x["a"])           # hit, refresh  fns: b a
+    comm.Allreduce(x["c"])           # miss, evict b fns: a c
+    assert s.read("coll_xla_cache_evictions") == 1
+    comm.Allreduce(x["a"])           # still cached (LRU refresh)
+    assert s.read("coll_xla_cache_hits") == 2
+    comm.Allreduce(x["b"])           # evicted above: recompiles
+    assert s.read("coll_xla_cache_misses") == 4
+    assert s.read("coll_xla_cache_evictions") == 2
+    assert len(comm._coll_xla_ctx.fns) == 2
+    # results stay correct through eviction/recompile churn
+    np.testing.assert_allclose(
+        np.asarray(comm.Allreduce(x["c"])),
+        np.full(16, sum(range(1, size + 1)), np.float32))
+    """, 3, mca={"device_plane": "on", "coll_xla_cache_max": "2"})

@@ -1,4 +1,7 @@
-"""Partitioned p2p (MPI-4 Psend/Precv over the persistent machinery)."""
+"""Partitioned p2p (the MPI-4 part/ subsystem, host path) —
+Psend/Precv over the persistent machinery, erroneous-call semantics,
+Startall over mixed request kinds, and the pipeline stage-handoff
+helpers built on top."""
 
 from tests.harness import run_ranks
 
@@ -82,12 +85,123 @@ def test_partitioned_restart_epochs():
 
 
 def test_partitioned_pready_errors():
+    """MPI 4.0 §4.2 erroneous calls raise MPIError: Pready before
+    Start, double-Pready of one partition, Parrived on a
+    never-started request, and restarting an active request."""
     run_ranks("""
+    from ompi_tpu import errors
     buf = np.zeros(8, np.float32)
     req = comm.Psend_init(buf, 4, dest=0, tag=1)
     try:
         req.Pready(0)   # not started
-        raise SystemExit("expected RuntimeError")
-    except RuntimeError:
-        pass
+        raise SystemExit("expected MPIError (Pready before start)")
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_REQUEST
+
+    rreq = comm.Precv_init(np.zeros(8, np.float32), 4, source=0,
+                           tag=1)
+    try:
+        rreq.Parrived(0)  # never started: nothing is posted
+        raise SystemExit("expected MPIError (Parrived inactive)")
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_REQUEST
+
+    req.start(); rreq.start()
+    req.Pready(2)
+    try:
+        req.Pready(2)   # double-Pready
+        raise SystemExit("expected MPIError (double Pready)")
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_ARG
+    try:
+        req.start()     # restart while the epoch is in flight
+        raise SystemExit("expected MPIError (restart active)")
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_REQUEST
+    assert req.active and rreq.active
+    req.Pready_list([0, 1, 3])
+    req.wait(); rreq.wait()
+    assert not req.active and rreq.Parrived(0)  # complete: True
     """, 1)
+
+
+def test_startall_mixed_and_active_error():
+    """start_all/Startall takes a MIX of persistent p2p and
+    partitioned requests, validates before starting anything, and
+    refuses to restart an active request with MPIError instead of
+    silently re-posting."""
+    run_ranks("""
+    from ompi_tpu import errors
+    n_part, k = 4, 64
+    if rank == 0:
+        pbuf = np.arange(n_part * k, dtype=np.float32)
+        sbuf = np.full(16, 7.0, np.float32)
+        preq = comm.Psend_init(pbuf, n_part, dest=1, tag=2)
+        sreq = comm.Send_init(sbuf, 1, tag=3)
+        mpi.Startall([preq, sreq])        # mixed kinds, one call
+        preq.Pready_range(0, n_part - 2)  # hold the last one back
+        try:
+            mpi.start_all([sreq, preq])   # preq epoch still open
+            raise SystemExit("expected MPIError (active restart)")
+        except errors.MPIError as e:
+            assert e.error_class == errors.ERR_REQUEST
+        try:
+            mpi.start_all([sreq, object()])
+            raise SystemExit("expected TypeError (non-startable)")
+        except TypeError:
+            pass
+        preq.Pready(n_part - 1)
+        mpi.wait_all([preq, sreq])
+    else:
+        pbuf = np.zeros(n_part * k, np.float32)
+        rbuf = np.zeros(16, np.float32)
+        preq = comm.Precv_init(pbuf, n_part, source=0, tag=2)
+        rreq = comm.Recv_init(rbuf, 0, tag=3)
+        mpi.Startall([preq, rreq])
+        mpi.wait_all([preq, rreq])
+        np.testing.assert_array_equal(
+            pbuf, np.arange(n_part * k, dtype=np.float32))
+        np.testing.assert_array_equal(rbuf, np.full(16, 7.0,
+                                                    np.float32))
+    """, 2)
+
+
+def test_pipeline_stage_handoff():
+    """models/pipeline stage_handoff_send/recv: one partition per
+    microbatch; the consumer starts on microbatch i as it arrives
+    (Parrived) while later ones are still in flight."""
+    run_ranks("""
+    from ompi_tpu.models.pipeline import (stage_handoff_recv,
+                                          stage_handoff_send)
+    from ompi_tpu.core import progress
+    n_micro, mb = 4, 32
+    acts = np.arange(n_micro * mb, dtype=np.float32).reshape(
+        n_micro, mb)
+    for tick in range(2):  # persistent across pipeline ticks
+        if rank == 0:
+            if tick == 0:
+                sreq = stage_handoff_send(comm, acts, n_micro, dest=1)
+            else:
+                sreq.start()
+            for i in range(n_micro):   # "stage compute" finishes i
+                sreq.Pready(i)
+            sreq.wait()
+        else:
+            buf = np.zeros((n_micro, mb), np.float32)
+            if tick == 0:
+                rreq = stage_handoff_recv(comm, buf, n_micro,
+                                          source=0)
+                bound = buf
+            else:
+                bound[:] = 0
+                rreq.start()
+            done = set()
+            while len(done) < n_micro:
+                progress.progress()
+                for i in range(n_micro):
+                    if i not in done and rreq.Parrived(i):
+                        np.testing.assert_array_equal(
+                            bound[i], acts[i])
+                        done.add(i)
+            rreq.wait()
+    """, 2)

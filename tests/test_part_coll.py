@@ -1,0 +1,236 @@
+"""Partitioned fused allreduce (Pallreduce_init — the part/
+subsystem's device-path payoff on coll/xla).
+
+The acceptance contract, pvar-asserted: bit-identical to
+Allreduce_multi under deterministic='linear' (shared bucket programs
+by construction), each bucket's compiled psum launches EXACTLY once
+per Start/Wait cycle with ZERO recompiles after init, and a bucket
+flushes BEFORE the final Pready whenever earlier buckets fill first
+(the backward-overlap the subsystem exists for).
+"""
+
+from tests.harness import run_ranks
+
+MCA = {"device_plane": "on"}
+# small bucket target -> multiple buckets from small test tensors
+# (same pool signature as the fused-collective bucket tests)
+MCA_SMALL = {"device_plane": "on", "coll_xla_bucket_bytes": "2048"}
+
+
+def test_pallreduce_bit_identical_linear():
+    """Leaves Pready'd out of order, fresh values each cycle: result
+    must be BITWISE identical to Allreduce_multi('linear') — the two
+    paths resolve to the same compiled bucket programs."""
+    run_ranks("""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(11)
+    shapes = [(57,), (8, 9), (3,), (130,)]
+    vals = []
+    for s in shapes:
+        v = (rng.standard_normal(s)
+             * 10.0 ** rng.integers(-3, 4, s)).astype(np.float32)
+        vals.append(jnp.asarray(np.roll(v, rank)))
+    preq = comm.Pallreduce_init(vals, deterministic="linear")
+    preq.start()
+    for i in (2, 0, 3, 1):          # out of order
+        preq.Pready(i)
+    preq.wait()
+    fused = comm.Allreduce_multi(vals, deterministic="linear")
+    for f, p in zip(fused, preq.array):
+        np.testing.assert_array_equal(np.asarray(f), np.asarray(p))
+
+    # rebinding fresh per-cycle values must track, not replay, the
+    # init-time bind
+    fresh = [v * 2 for v in vals]
+    preq.start()
+    for i in (1, 3, 0, 2):
+        preq.Pready(i, fresh[i])
+    preq.wait()
+    fused2 = comm.Allreduce_multi(fresh, deterministic="linear")
+    for f, p in zip(fused2, preq.array):
+        np.testing.assert_array_equal(np.asarray(f), np.asarray(p))
+    """, 3, mca=MCA)
+
+
+def test_pallreduce_zero_recompiles_launch_once_per_bucket():
+    """Regression guard: after init, 3 Start/Pready*/Wait cycles run
+    with zero compile-cache or plan-cache misses and exactly
+    n_buckets launches per cycle."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    # 4 x 1200 B f32 leaves under a 2048 B target -> 2 buckets
+    bufs = [jnp.full((300,), float(rank + i), jnp.float32)
+            for i in range(4)]
+    preq = comm.Pallreduce_init(bufs, deterministic="linear")
+    # 1200 B leaves close a 2048 B bucket in pairs: (0,1) and (2,3)
+    n_buckets = 2
+    s = pvar.session()
+    for cycle in range(3):
+        preq.start()
+        for i in (3, 1, 0, 2):
+            preq.Pready(i)
+        preq.wait()
+    assert s.read("coll_xla_cache_misses") == 0, "recompile after init"
+    assert s.read("coll_xla_plan_cache_misses") == 0
+    assert s.read("coll_xla_launches") == 3 * n_buckets, \\
+        s.read("coll_xla_launches")
+    assert s.read("part_bucket_flushes") == 3 * n_buckets
+    expect = sum(
+        np.full((300,), float(r + 0), np.float32) for r in range(size))
+    np.testing.assert_array_equal(np.asarray(preq.array[0]), expect)
+    """, 3, mca=MCA_SMALL)
+
+
+def test_pallreduce_flush_before_final_pready():
+    """The overlap property itself: with two buckets, completing
+    bucket 0's partitions launches its psum BEFORE the final Pready
+    of the cycle (pvar-visible mid-cycle), and the overlap counter
+    records it."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    bufs = [jnp.full((300,), float(rank + i), jnp.float32)
+            for i in range(4)]
+    preq = comm.Pallreduce_init(bufs)
+    # 1200 B leaves close a 2048 B bucket in pairs: (0,1) and (2,3)
+    buckets = ((0, 1), (2, 3))
+    s = pvar.session()
+    preq.start()
+    for i in buckets[0]:            # fill the first bucket only
+        preq.Pready(i)
+    # mid-cycle: bucket 0 is on the wire, bucket 1 leaves unready
+    assert s.read("part_bucket_flushes") == 1
+    assert s.read("coll_xla_launches") == 1
+    assert s.read("part_overlap_flushes") == 1
+    for i in buckets[1]:
+        preq.Pready(i)
+    preq.wait()
+    assert s.read("part_bucket_flushes") == 2
+    # the LAST bucket's flush coincides with the final Pready, so it
+    # is not an overlapped flush
+    assert s.read("part_overlap_flushes") == 1
+    """, 3, mca=MCA_SMALL)
+
+
+def test_pallreduce_semantics_errors():
+    """Partitioned erroneous calls on the device path: Pready before
+    start, double-Pready, wait with unready partitions, restart of an
+    active cycle (incl. via start_all), shape-mismatched rebind."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import errors
+    # shapes chosen to not collide with other pooled tests' plan
+    # signatures (Pallreduce_init shares plan/compile keys with
+    # Allreduce_multi by design)
+    bufs = [jnp.ones((17,), jnp.float32), jnp.ones((9,), jnp.float32)]
+    preq = comm.Pallreduce_init(bufs)
+    try:
+        preq.Pready(0)
+        raise SystemExit("expected MPIError (inactive)")
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_REQUEST
+    preq.start()
+    preq.Pready(0)
+    try:
+        preq.Pready(0)
+        raise SystemExit("expected MPIError (double Pready)")
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_ARG
+    try:
+        preq.wait()
+        raise SystemExit("expected MPIError (unready wait)")
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_REQUEST
+    try:
+        mpi.start_all([preq])
+        raise SystemExit("expected MPIError (active restart)")
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_REQUEST
+    try:
+        preq.Pready(1, jnp.ones((10,), jnp.float32))
+        raise SystemExit("expected ValueError (shape mismatch)")
+    except ValueError:
+        pass
+    preq.Pready(1)
+    preq.wait()
+    assert not preq.active
+    np.testing.assert_allclose(np.asarray(preq.array[0]),
+                               np.full(17, float(size), np.float32))
+    """, 3, mca=MCA)
+
+
+def test_startall_mixed_device_partitioned():
+    """One Startall over a persistent fused collective AND a
+    partitioned allreduce; partitions stream in afterwards."""
+    run_ranks("""
+    import jax.numpy as jnp
+    bufs = [jnp.full((32,), float(rank + 1), jnp.float32),
+            jnp.arange(16, dtype=jnp.float32)]
+    pers = comm.Allreduce_init(jnp.ones((8,), jnp.float32))
+    part = comm.Pallreduce_init(bufs)
+    mpi.Startall([pers, part])
+    part.Pready_list([1, 0])
+    mpi.wait_all([pers, part])
+    np.testing.assert_allclose(np.asarray(pers.array),
+                               np.full(8, float(size), np.float32))
+    np.testing.assert_allclose(
+        np.asarray(part.array[0]),
+        np.full(32, sum(range(1, size + 1)), np.float32))
+    """, 3, mca=MCA)
+
+
+def test_gradient_sync_overlap_wrapper():
+    """part.GradientSync: key-path pushes in reverse-production
+    order, values rebound each step, synced pytree out — with zero
+    recompiles across steps."""
+    run_ranks("""
+    import jax
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    from ompi_tpu.part import GradientSync
+    template = {"embed": jnp.zeros((300,), jnp.float32),
+                "layers": [{"w": jnp.zeros((300,), jnp.float32)}
+                           for _ in range(3)]}
+    sync = GradientSync(comm, template, deterministic="linear")
+    paths = [jax.tree_util.keystr(p) for p, _ in
+             jax.tree_util.tree_flatten_with_path(template)[0]]
+    s = pvar.session()
+    for step in range(2):
+        sync.start()
+        for key in reversed(paths):     # backward production order
+            i = sync.index_of(key)
+            sync.push(key, jnp.full((300,), float(rank + i + step),
+                                    jnp.float32))
+        out = sync.finish()
+    assert s.read("coll_xla_cache_misses") == 0
+    expect = sum(float(r + 0 + 1) for r in range(size))
+    np.testing.assert_allclose(np.asarray(out["embed"]),
+                               np.full(300, expect, np.float32))
+    """, 3, mca=MCA_SMALL)
+
+
+def test_pallreduce_size1_and_empty_trivial():
+    """Gated degenerate handles keep full partitioned semantics on a
+    size-1 comm (COMM_SELF) and an empty pytree."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import errors
+    selfc = mpi.COMM_SELF
+    bufs = [jnp.arange(4, dtype=jnp.float32)]
+    preq = selfc.Pallreduce_init(bufs)
+    preq.start()
+    try:
+        preq.wait()
+        raise SystemExit("expected MPIError (unready wait)")
+    except errors.MPIError:
+        pass
+    preq.Pready(0)
+    preq.wait()
+    np.testing.assert_array_equal(np.asarray(preq.array[0]),
+                                  np.arange(4, dtype=np.float32))
+    empty = comm.Pallreduce_init([])
+    empty.start()
+    empty.wait()
+    assert empty.array == []
+    """, 3, mca=MCA)
