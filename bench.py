@@ -339,6 +339,12 @@ def _bench_overlap():
             preq.Pready(i)
         preq.wait()
     part_ms = (time.perf_counter() - t0) / reps * 1e3
+    # flush-latency distribution from the trace histogram plane
+    # (populated only under --trace — the log2 pvar histogram the
+    # part_bucket_flush spans feed); None when tracing is off
+    from ompi_tpu.trace import export as trace_export
+
+    pc = trace_export.percentiles("part_bucket_flush", (0.5, 0.99))
     return {
         "fused_32x256k_ms": round(fused_ms, 3),
         "partitioned_32x256k_ms": round(part_ms, 3),
@@ -348,11 +354,33 @@ def _bench_overlap():
             s.read("part_overlap_flushes") / reps,
         "pready_overhead_us_per_leaf": round(
             (part_ms - fused_ms) / n * 1e3, 2),
+        "flush_p50_us": None if pc is None else round(pc[0] / 1e3, 2),
+        "flush_p99_us": None if pc is None else round(pc[1] / 1e3, 2),
     }
+
+
+def _trace_api_smoke():
+    """A few real MPI calls inside the traced region so the exported
+    timeline shows api-layer spans (via the PMPI interposition hook
+    the recorder installs) next to the microbenches' coll_xla/part
+    spans. Single-process singleton init — the CI smoke lane."""
+    from ompi_tpu import mpi
+
+    world = mpi.Init()
+    world.Barrier()
+    world.bcast({"bench_trace": True})
+    world.Barrier()
 
 
 def main() -> None:
     t_start = time.time()
+    trace_path = None
+    if "--trace" in sys.argv:
+        i = sys.argv.index("--trace")
+        if i + 1 >= len(sys.argv):
+            print("bench.py: --trace requires a path", file=sys.stderr)
+            sys.exit(2)
+        trace_path = sys.argv[i + 1]
     # staging first: the train bench necessarily reads results back
     # (loss), and the first D2H degrades this platform's uplink (see
     # _bench_staging) — h2d must be measured before any read
@@ -375,6 +403,13 @@ def main() -> None:
         prep = _prep_cached()
     staging_s = time.time() - t_start
     _phase(f"staging+upload done ({staging_s:.1f}s)")
+    if trace_path is not None:
+        # recorder on around the measured region: train step +
+        # dispatch/overlap microbenches + the api smoke below
+        from ompi_tpu.trace import recorder as trace_rec
+
+        trace_rec.enable()
+        _phase("trace recorder enabled")
     tokens_per_s, tflops, loss, compile_s, train_s = \
         _bench_train_step(prep)
     try:
@@ -389,6 +424,23 @@ def main() -> None:
     except Exception as e:
         _phase(f"overlap microbench skipped: {e!r}")
         overlap = None
+    if trace_path is not None:
+        from ompi_tpu.trace import export as trace_export
+        from ompi_tpu.trace import recorder as trace_rec
+
+        try:
+            _trace_api_smoke()
+        except Exception as e:
+            _phase(f"trace api smoke skipped: {e!r}")
+        rec = trace_rec.disable()
+        if rec is not None:
+            doc = trace_export.write(trace_path, rec)
+            n_spans = sum(1 for ev in doc["traceEvents"]
+                          if ev.get("ph") == "X")
+            subsys = sorted({ev["cat"] for ev in doc["traceEvents"]
+                             if ev.get("ph") == "X"})
+            _phase(f"trace written: {trace_path} ({n_spans} spans, "
+                   f"subsystems {subsys})")
 
     import jax
 

@@ -12,6 +12,7 @@ from typing import Callable, Dict, List, Optional
 
 from ompi_tpu.core import cvar, progress, registry
 from ompi_tpu.runtime import rte
+from ompi_tpu.trace import recorder as _trace
 
 framework = registry.framework("btl")
 
@@ -86,6 +87,19 @@ class Bml:
                     f"rank {rte.rank}: no BTL reaches peer {peer}")
             self.endpoints[peer] = ep
         return ep
+
+    def send(self, peer: int, data: bytes) -> None:
+        """Endpoint lookup + send — the PML's framed-message exit
+        point, so btl-layer spans cover every wire handoff."""
+        ep = self.endpoint(peer)
+        rec = _trace.RECORDER
+        if rec is None:
+            ep.send(peer, data)
+            return
+        t0 = _trace.now()
+        ep.send(peer, data)
+        rec.record("send", "btl", t0, _trace.now(),
+                   {"peer": peer, "nbytes": len(data), "btl": ep.NAME})
 
     def finalize(self) -> None:
         for btl in self.btls:

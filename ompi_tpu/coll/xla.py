@@ -36,6 +36,7 @@ import numpy as np
 from ompi_tpu import errors, op as op_mod
 from ompi_tpu.coll import CollModule, accelerator as staging, framework
 from ompi_tpu.core import cvar, output, pvar
+from ompi_tpu.trace import recorder as _trace
 
 _out = output.stream("coll_xla")
 
@@ -263,28 +264,48 @@ class _Ctx:
         when cvar coll_xla_cache_max > 0 (insertion order IS recency:
         hits reinsert)."""
         fn = self.fns.get(key)
+        rec = _trace.RECORDER
         if fn is None:
             pvar.record("coll_xla_cache_misses")
-            fn = self.fns[key] = build()
+            if rec is None:
+                fn = self.fns[key] = build()
+            else:
+                t0 = _trace.now()
+                fn = self.fns[key] = build()
+                rec.record("compile", "coll_xla", t0, _trace.now(),
+                           {"cache": "miss", "key": repr(key)[:160]})
             pvar.record_hwm("coll_xla_fns_size", len(self.fns))
             self._evict(self.fns)
         else:
             pvar.record("coll_xla_cache_hits")
             self.fns[key] = self.fns.pop(key)  # LRU touch
+            if rec is not None:
+                rec.instant("cache_hit", "coll_xla",
+                            {"key": repr(key)[:160]})
         return fn
 
     def plan(self, key, build):
         """Get-or-build a fused-bucket plan (same contract as
         ``compiled`` — steady-state steps must pay zero re-planning)."""
         p = self.plans.get(key)
+        rec = _trace.RECORDER
         if p is None:
             pvar.record("coll_xla_plan_cache_misses")
-            p = self.plans[key] = build()
+            if rec is None:
+                p = self.plans[key] = build()
+            else:
+                t0 = _trace.now()
+                p = self.plans[key] = build()
+                rec.record("plan_build", "coll_xla", t0, _trace.now(),
+                           {"cache": "miss", "key": repr(key)[:160]})
             pvar.record_hwm("coll_xla_plans_size", len(self.plans))
             self._evict(self.plans)
         else:
             pvar.record("coll_xla_plan_cache_hits")
             self.plans[key] = self.plans.pop(key)  # LRU touch
+            if rec is not None:
+                rec.instant("plan_cache_hit", "coll_xla",
+                            {"key": repr(key)[:160]})
         return p
 
     @staticmethod
@@ -297,9 +318,18 @@ class _Ctx:
     def launch(self, fn, *args):
         """Dispatch one compiled collective program. Every device-path
         dispatch funnels through here so the launch counter is exact —
-        the fusion regression tests assert on it."""
+        the fusion regression tests assert on it. Tracing disabled
+        costs exactly one extra branch here (no span construction);
+        enabled, the span covers DISPATCH time only — PJRT execution
+        is asynchronous."""
         pvar.record("coll_xla_launches")
-        return fn(*args)
+        rec = _trace.RECORDER
+        if rec is None:
+            return fn(*args)
+        t0 = _trace.now()
+        out = fn(*args)
+        rec.record("launch", "coll_xla", t0, _trace.now())
+        return out
 
     def release(self) -> None:
         """Drop the compiled-program and plan caches (comm destructor
@@ -1498,10 +1528,13 @@ class PartitionedAllreduceRequest:
         self._ready[idx] = True
         self._n_ready += 1
         pvar.record("part_pready")
+        rec = _trace.RECORDER
+        if rec is not None:
+            rec.instant("pready", "part", {"partition": idx})
         b = self._leaf_bucket[idx]
         self._pending[b] -= 1
         if self._pending[b] == 0:
-            self._flush(b)
+            self._flush(b, idx)
 
     def Pready_range(self, lo: int, hi: int) -> None:
         for i in range(lo, hi + 1):
@@ -1511,12 +1544,29 @@ class PartitionedAllreduceRequest:
         for i in idxs:
             self.Pready(i)
 
-    def _flush(self, b: int) -> None:
+    def _flush(self, b: int, trigger: Optional[int] = None) -> None:
         fn, idxs = self._buckets[b]
-        self._results[b] = self._ctx.launch(
-            fn, tuple(self._bound[i] for i in idxs))
+        overlap = self._n_ready < self._n
+        rec = _trace.RECORDER
+        if rec is None:
+            self._results[b] = self._ctx.launch(
+                fn, tuple(self._bound[i] for i in idxs))
+        else:
+            # the flush span carries the Pready that triggered it, so
+            # a timeline shows WHICH partition released each bucket
+            # (the Pready -> flush causality the overlap design rests
+            # on) and whether the dispatch overlapped the producer
+            t0 = _trace.now()
+            self._results[b] = self._ctx.launch(
+                fn, tuple(self._bound[i] for i in idxs))
+            t1 = _trace.now()
+            nb = sum(self._metas[i][2] for i in idxs)
+            rec.record("part_bucket_flush", "part", t0, t1,
+                       {"bucket": b, "trigger_partition": trigger,
+                        "overlap": overlap, "nbytes": nb})
+            _trace.hist("part_bucket_flush", nb, t1 - t0)
         pvar.record("part_bucket_flushes")
-        if self._n_ready < self._n:
+        if overlap:
             # dispatched while later partitions are still pending:
             # this bucket's wire time is hidden behind the producer
             pvar.record("part_overlap_flushes")

@@ -108,8 +108,12 @@ class EventHandle:
     """MPI_T_event_handle: binds a tool to an event type. Either a
     synchronous callback (event_register_callback) or a bounded
     buffer drained with :meth:`read` — overflow drops the newest
-    instance and fires the dropped handler with the running count
-    (event_set_dropped_handler semantics)."""
+    instance and counts it (thread-safe: concurrent emitters on one
+    handle account every drop exactly once). The dropped handler
+    fires ONCE per not-dropping -> dropping transition with the
+    running drop count; draining the buffer with read() re-arms it
+    (event_set_dropped_handler semantics — the tool is told the
+    buffer overflowed, not spammed once per lost instance)."""
 
     def __init__(self, etype: EventType,
                  callback: Optional[Callable] = None,
@@ -118,6 +122,8 @@ class EventHandle:
         self._cb = callback
         self._buf: List[EventInstance] = []
         self._cap = int(buffer_size)
+        self._buf_lock = threading.Lock()
+        self._dropping = False
         self.dropped = 0
         self._dropped_cb: Optional[Callable[[int], None]] = None
         with _lock:
@@ -133,22 +139,36 @@ class EventHandle:
         if self._cb is not None:
             self._cb(inst)
             return
-        if len(self._buf) >= self._cap:
+        with self._buf_lock:
+            if len(self._buf) < self._cap:
+                self._buf.append(inst)
+                return
             self.dropped += 1
-            if self._dropped_cb is not None:
-                self._dropped_cb(self.dropped)
-            return
-        self._buf.append(inst)
+            fire = not self._dropping
+            self._dropping = True
+            count = self.dropped
+            cb = self._dropped_cb
+        if fire and cb is not None:
+            # outside the lock: the handler may read()/free() the
+            # handle without deadlocking
+            cb(count)
 
     def read(self) -> Optional[EventInstance]:
-        """Drain the oldest buffered instance (buffered mode)."""
-        return self._buf.pop(0) if self._buf else None
+        """Drain the oldest buffered instance (buffered mode).
+        Freeing a slot re-arms the dropped-handler transition."""
+        with self._buf_lock:
+            if not self._buf:
+                return None
+            self._dropping = False
+            return self._buf.pop(0)
 
     def free(self) -> None:
         with _lock:
             if self in self._type.handles:
                 self._type.handles.remove(self)
-        self._buf.clear()
+        with self._buf_lock:
+            self._buf.clear()
+            self._dropping = False
 
 
 def emit(name: str, **data) -> None:

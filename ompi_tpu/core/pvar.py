@@ -44,6 +44,14 @@ WELL_KNOWN = (
     "put", "get", "accumulate", "win_lock",
     "eager", "rndv", "rget",
     "time_progress_ns",
+    # trace/ plane: spans lost to ring-buffer overflow; per-(op,
+    # size-bin) log2 latency histograms ride dynamic names
+    # (trace_hist_<op>_sz<s>_lat<l>, decoded by trace.export)
+    "trace_dropped",
+    # pml/monitoring per-context traffic (combined monitoring_msgs/
+    # monitoring_bytes stay alongside)
+    "monitoring_p2p_msgs", "monitoring_p2p_bytes",
+    "monitoring_coll_msgs", "monitoring_coll_bytes",
 )
 
 

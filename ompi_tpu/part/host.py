@@ -38,6 +38,7 @@ import numpy as np
 from ompi_tpu import errors, pml
 from ompi_tpu.core import pvar
 from ompi_tpu.pml import request as rq
+from ompi_tpu.trace import recorder as _trace
 
 _PART_BASE = -(1 << 24)  # below any other framework-internal tag
 MAX_PARTITIONS = 4096
@@ -152,9 +153,19 @@ class PartitionedSendRequest(_PartitionedBase):
         self._ready[idx] = True
         pvar.record("part_pready")
         chunk = self._chunks[idx]
+        rec = _trace.RECORDER
+        if rec is None:
+            self._reqs[idx] = pml.current().isend(
+                self.comm, chunk, chunk.size, None, self.peer,
+                _part_tag(self.tag, self._ep, idx))
+            return
+        t0 = _trace.now()
         self._reqs[idx] = pml.current().isend(
             self.comm, chunk, chunk.size, None, self.peer,
             _part_tag(self.tag, self._ep, idx))
+        rec.record("psend_pready", "part", t0, _trace.now(),
+                   {"partition": idx, "peer": self.peer,
+                    "tag": self.tag, "nbytes": int(chunk.nbytes)})
 
     def Pready_range(self, lo: int, hi: int) -> None:
         for i in range(lo, hi + 1):
@@ -185,10 +196,16 @@ class PartitionedRecvRequest(_PartitionedBase):
         self._check_start()
         ep = _epoch(self.comm, self.peer, self.tag, "recv")
         p = pml.current()
+        rec = _trace.RECORDER
+        t0 = _trace.now() if rec is not None else 0
         self._reqs = [
             p.irecv(self.comm, self._chunks[i], self._chunks[i].size,
                     None, self.peer, _part_tag(self.tag, ep, i))
             for i in range(self.partitions)]
+        if rec is not None:
+            rec.record("precv_start", "part", t0, _trace.now(),
+                       {"partitions": self.partitions,
+                        "peer": self.peer, "tag": self.tag})
         self._started = True
         self.completed = False
         pvar.record("part_recv_start")

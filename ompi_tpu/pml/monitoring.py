@@ -59,6 +59,12 @@ class MonitoringPml:
         cell[1] += nbytes
         pvar.record("monitoring_msgs")
         pvar.record("monitoring_bytes", nbytes)
+        # per-context counters (reference common/monitoring splits its
+        # counting by p2p vs collective the same way); the combined
+        # pair above stays for compatibility
+        kind = "coll" if collective else "p2p"
+        pvar.record(f"monitoring_{kind}_msgs")
+        pvar.record(f"monitoring_{kind}_bytes", nbytes)
 
     @staticmethod
     def _nbytes(buf, count, dtype) -> int:

@@ -41,6 +41,7 @@ from ompi_tpu.datatype.convertor import dtype_of
 from ompi_tpu.pml import custommatch, peruse
 from ompi_tpu.pml import request as rq
 from ompi_tpu.runtime import rte
+from ompi_tpu.trace import recorder as _trace
 
 HDR_MATCH = 1
 HDR_RNDV = 2
@@ -238,6 +239,8 @@ class Ob1:
     def isend(self, comm, buf, count, dtype, dst: int, tag: int,
               sync: bool = False, obj=NO_OBJ,
               collective: bool = False) -> SendRequest:
+        rec = _trace.RECORDER
+        t_send = _trace.now() if rec is not None else 0
         req = SendRequest()
         if dst == rq.PROC_NULL:
             req.complete()
@@ -296,9 +299,9 @@ class Ob1:
             pvar.record("eager")
             if sync:
                 self.pending_ack[msgid] = req
-                self.bml.endpoint(dst_world).send(dst_world, hdr + payload)
+                self.bml.send(dst_world, hdr + payload)
             else:
-                self.bml.endpoint(dst_world).send(dst_world, hdr + payload)
+                self.bml.send(dst_world, hdr + payload)
                 req.complete()
         else:
             sc = self._expose_single_copy(req, dst_world)
@@ -311,7 +314,14 @@ class Ob1:
                                   size, flags, msgid)
                 pvar.record("rndv")
             self.pending_ack[msgid] = req
-            self.bml.endpoint(dst_world).send(dst_world, hdr)
+            self.bml.send(dst_world, hdr)
+        if rec is not None:
+            # span covers pack + protocol selection + first fragment
+            # handoff to the BTL (an RNDV transfer continues under
+            # progress after this returns)
+            rec.record("isend", "pml", t_send, _trace.now(),
+                       {"dst": dst_world, "tag": tag, "size": size,
+                        "path": "eager" if size <= eager else "rndv"})
         return req
 
     def _expose_single_copy(self, req: SendRequest,
@@ -393,6 +403,9 @@ class Ob1:
             req.complete(err)
             return req
         self._post(req)
+        rec = _trace.RECORDER
+        if rec is not None:
+            rec.instant("irecv_post", "pml", {"src": src, "tag": tag})
         return req
 
     def irecv_obj(self, comm, src: int, tag: int,
@@ -700,7 +713,7 @@ class Ob1:
             req.status.count = take
             if flags & FLAG_SYNC:
                 ack = _ACK.pack(HDR_ACK, msgid, 0)
-                self.bml.endpoint(src_world).send(src_world, ack)
+                self.bml.send(src_world, ack)
             self._finish_recv(req)
             return
         if typ == HDR_RNDV_SC and self._try_single_copy(
@@ -712,7 +725,7 @@ class Ob1:
         req.src_msgid = msgid
         self.active_recv[req.recv_id] = req
         ack = _ACK.pack(HDR_ACK, msgid, req.recv_id)
-        self.bml.endpoint(src_world).send(src_world, ack)
+        self.bml.send(src_world, ack)
 
     def _try_single_copy(self, req: RecvRequest, payload: bytes,
                          size: int, msgid: int,
@@ -749,8 +762,7 @@ class Ob1:
             smsc.disqualify(f"runtime read from pid {pid}: {exc}")
             return False
         req.status.count = take
-        self.bml.endpoint(src_world).send(
-            src_world, _SCFIN.pack(HDR_SC_FIN, msgid))
+        self.bml.send(src_world, _SCFIN.pack(HDR_SC_FIN, msgid))
         self._finish_recv(req)
         return True
 
@@ -817,8 +829,16 @@ class Ob1:
                 offset = conv.position
                 data = conv.pack(max_bytes=frag_size)
                 pvar.record("rndv_frag")
-                ep.send(req.dst_world,
-                        _FRAG.pack(HDR_FRAG, req.recv_id, offset) + data)
+                frame = _FRAG.pack(HDR_FRAG, req.recv_id, offset) + data
+                rec = _trace.RECORDER
+                if rec is None:
+                    ep.send(req.dst_world, frame)
+                else:
+                    t0 = _trace.now()
+                    ep.send(req.dst_world, frame)
+                    rec.record("send", "btl", t0, _trace.now(),
+                               {"peer": req.dst_world,
+                                "nbytes": len(frame), "btl": ep.NAME})
         finally:
             req.pumping = False
         if conv.done and not req.completed:
@@ -851,7 +871,7 @@ class Ob1:
         # relative to frag_size and keeps the pipe full)
         end = offset + len(data)
         fack = _FRAGACK.pack(HDR_FRAG_ACK, req.src_msgid, end)
-        self.bml.endpoint(req.src_world).send(req.src_world, fack)
+        self.bml.send(req.src_world, fack)
         # completion when the sender's full size has streamed past us
         if end >= req.total:
             req.status.count = min(req.total, req.conv.packed_size)

@@ -89,7 +89,14 @@ def detach_tool(handle: int) -> None:
 
 @contextmanager
 def timing(names: Optional[list] = None):
-    """Collect per-call counts and wall-clock seconds."""
+    """Collect per-call counts and wall-clock seconds.
+
+    Also publishes each call into the pvar plane as
+    ``profile_<op>_calls`` / ``profile_<op>_ns``, so an MPI_T session
+    can read tool overhead without holding the stats dict (the
+    reference's test/monitoring/test_overhead.c harness pattern)."""
+    from ompi_tpu.core import pvar
+
     stats: Dict[str, list] = {}
     stack: Dict[int, float] = {}
 
@@ -100,9 +107,12 @@ def timing(names: Optional[list] = None):
         t0 = stack.pop((id(comm), name), None)
         if t0 is None:
             return
+        dt = time.perf_counter() - t0
         cell = stats.setdefault(name, [0, 0.0])
         cell[0] += 1
-        cell[1] += time.perf_counter() - t0
+        cell[1] += dt
+        pvar.record(f"profile_{name}_calls")
+        pvar.record(f"profile_{name}_ns", int(dt * 1e9))
 
     handle = attach_tool(pre, post, names)
     try:

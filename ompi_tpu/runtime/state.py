@@ -82,6 +82,18 @@ def init_instance() -> None:
         from ompi_tpu.tools import msgq as _msgq
 
         _msgq.install_signal_dump()
+        # tracing plane (cvar trace_enable / OMPI_TPU_TRACE): bring
+        # the span recorder up before any traffic flows and exchange
+        # wall-vs-monotonic clock offsets through the store so merged
+        # per-rank timelines share rank 0's timebase
+        from ompi_tpu.trace import recorder as _trace_rec
+
+        if _trace_rec.requested():
+            try:
+                _trace_rec.enable(rank=rte.rank)
+                _trace_rec.sync_clock()
+            except Exception as exc:  # tracing must never sink init
+                _out.verbose(0, "trace enable failed: %r", exc)
         _instance_up = True
         atexit.register(_atexit_finalize)
 

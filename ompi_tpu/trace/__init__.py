@@ -1,0 +1,21 @@
+"""trace/ — span-structured distributed tracing over the MPI_T planes.
+
+The fourth observability plane (after cvars, pvars/SPC, and MPI-4
+events): a per-process bounded ring-buffer span recorder
+(:mod:`~ompi_tpu.trace.recorder`) instrumented at every layer a
+training step touches — MPI API entry/exit (through the PMPI
+interposition chain), coll/xla plan/compile/launch, part/ Pready ->
+bucket-flush causality, and pml/btl send/recv. Export is Chrome
+trace-event JSON loadable in Perfetto
+(:mod:`~ompi_tpu.trace.export`), per-rank files merge into one
+timeline with ``python -m ompi_tpu.trace merge``
+(:mod:`~ompi_tpu.trace.merge`), and log2-binned latency histograms
+ride the pvar plane so ``mpit`` sessions can read them.
+
+Cost model: one attribute load + one branch per instrumented site
+while disabled (``recorder.RECORDER is None`` — no span objects are
+ever constructed); enable with cvar ``trace_enable``, env
+``OMPI_TPU_TRACE``, or :func:`recorder.enable`.
+"""
+
+from ompi_tpu.trace import export, merge, recorder  # noqa: F401

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Trace smoke lane: run the CPU bench with the recorder on, verify the
+# exported Chrome trace is Perfetto-shaped (traceEvents list, ph:"X"
+# spans from the api/coll_xla/part layers, monotone per-tid
+# timestamps), and exercise the merge CLI on it. The JSON stays on
+# disk for the CI artifact upload.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${1:-bench_trace.json}"
+JAX_PLATFORMS=cpu python bench.py --trace "$out"
+
+python - "$out" <<'EOF'
+import json
+import sys
+
+path = sys.argv[1]
+doc = json.load(open(path))
+evs = doc["traceEvents"]
+assert isinstance(evs, list) and evs, "empty traceEvents"
+spans = [e for e in evs if e.get("ph") == "X"]
+subsys = {e["cat"] for e in spans}
+missing = {"api", "coll_xla", "part"} - subsys
+assert not missing, f"missing subsystems: {missing} (have {subsys})"
+by_tid = {}
+for e in spans:
+    by_tid.setdefault((e["pid"], e["tid"]), []).append(e["ts"])
+for tid, ts in by_tid.items():
+    assert ts == sorted(ts), f"non-monotone ts on tid {tid}"
+print(f"trace smoke OK: {len(spans)} spans, subsystems "
+      f"{sorted(subsys)}")
+EOF
+
+python -m ompi_tpu.trace merge -o "${out%.json}_merged.json" "$out"
+python -m ompi_tpu.trace report "$out"

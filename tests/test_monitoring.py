@@ -34,6 +34,54 @@ def test_pml_monitoring_traffic_matrix():
     """, 3, mca={"pml_monitoring": "1"}, timeout=120)
 
 
+def test_monitoring_context_pvars():
+    """The per-context split also reaches the pvar plane:
+    monitoring_p2p_* vs monitoring_coll_* (combined counters stay)."""
+    run_ranks("""
+        from ompi_tpu.core import pvar
+        from ompi_tpu.pml import monitoring
+        assert monitoring.installed() is not None
+        s = pvar.session()
+        nxt = (rank + 1) % size
+        prv = (rank - 1) % size
+        data = np.ones(128, dtype=np.float64)  # 1024 bytes
+        if rank % 2 == 0:
+            comm.Send(data, dest=nxt, tag=5)
+            comm.Recv(data, source=prv, tag=5)
+        else:
+            comm.Recv(data, source=prv, tag=5)
+            comm.Send(data, dest=nxt, tag=5)
+        assert s.read("monitoring_p2p_msgs") == 1
+        assert s.read("monitoring_p2p_bytes") == 1024
+        assert s.read("monitoring_coll_msgs") == 0
+        out = np.zeros(4)
+        comm.Allreduce(np.ones(4), out)
+        assert s.read("monitoring_p2p_msgs") == 1  # unchanged
+        assert s.read("monitoring_coll_msgs") > 0
+        # combined counters cover both contexts
+        assert s.read("monitoring_msgs") == \\
+            s.read("monitoring_p2p_msgs") + s.read("monitoring_coll_msgs")
+    """, 2, mca={"pml_monitoring": "1"}, timeout=120)
+
+
+def test_profile_timing_publishes_pvars():
+    """profile.timing() mirrors its per-call stats into
+    profile_<op>_calls / profile_<op>_ns (MPI_T-readable overhead)."""
+    run_ranks("""
+        from ompi_tpu import profile
+        from ompi_tpu.core import pvar
+        s = pvar.session()
+        with profile.timing() as stats:
+            comm.Barrier()
+            comm.Barrier()
+        assert stats["Barrier"][0] == 2
+        assert s.read("profile_Barrier_calls") == 2
+        assert s.read("profile_Barrier_ns") > 0
+        comm.Barrier()  # outside timing(): not recorded
+        assert s.read("profile_Barrier_calls") == 2
+    """, 2, timeout=120)
+
+
 def test_profile_hooks_and_timing():
     run_ranks("""
         from ompi_tpu import profile

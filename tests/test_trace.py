@@ -1,0 +1,325 @@
+"""trace/ subsystem tests: ring bounding + drop accounting, log2
+histogram binning, Pready -> flush span attribution, Chrome export
+shape, cross-rank merge, the zero-cost disabled guard, and the
+events-plane concurrent drop accounting the recorder builds on."""
+
+import json
+import threading
+import types
+
+import pytest
+
+from ompi_tpu.core import events, pvar
+from ompi_tpu.trace import export, merge, recorder
+from ompi_tpu.trace import __main__ as trace_cli
+from tests.harness import run_ranks
+
+
+@pytest.fixture
+def no_recorder():
+    """Guarantee the global recorder is off before and after."""
+    recorder.disable()
+    yield
+    recorder.disable()
+
+
+# -- ring buffer + drop accounting ---------------------------------------
+
+def test_ring_buffer_bounds_and_trace_dropped(no_recorder):
+    rec = recorder.Recorder(capacity=8, rank=0)
+    s = pvar.session()
+    for i in range(20):
+        t = recorder.now()
+        rec.record(f"s{i}", "test", t, t + 10)
+    spans = rec.spans()
+    assert len(spans) == 8
+    # oldest overwritten: only the last capacity spans survive
+    assert [sp.name for sp in spans] == [f"s{i}" for i in range(12, 20)]
+    assert s.read("trace_dropped") == 12
+
+
+def test_ring_thread_safety_exact_accounting(no_recorder):
+    rec = recorder.Recorder(capacity=16, rank=0)
+    s = pvar.session()
+    n_threads, per = 4, 100
+    start = threading.Barrier(n_threads)
+
+    def emitter(k):
+        start.wait()
+        for i in range(per):
+            t = recorder.now()
+            rec.record(f"t{k}_{i}", "test", t, t)
+
+    ts = [threading.Thread(target=emitter, args=(k,))
+          for k in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(rec.spans()) == 16
+    assert s.read("trace_dropped") == n_threads * per - 16
+
+
+def test_disabled_guard_constructs_nothing(monkeypatch, no_recorder):
+    """Default-off tracing must not build span objects anywhere on
+    the coll/xla hot path — the one-branch guard contract the fused
+    pvar regression tests depend on."""
+    import jax.numpy as jnp
+
+    from ompi_tpu.coll import xla as cx
+
+    assert recorder.RECORDER is None
+
+    def boom(*a, **k):
+        raise AssertionError("Span constructed while tracing disabled")
+
+    monkeypatch.setattr(recorder, "Span", boom)
+    ctx = cx._Ctx.local()
+    comm = types.SimpleNamespace(_coll_xla_ctx=ctx)
+    s = pvar.session()
+    launcher = cx._allreduce_prep(comm, jnp.ones(16, jnp.float32))
+    launcher()
+    launcher()
+    assert s.read("coll_xla_launches") >= 2  # the path really ran
+
+
+# -- log2 histogram ------------------------------------------------------
+
+def test_histogram_binning(no_recorder):
+    s = pvar.session()
+    recorder.hist("t_binop", 1000, 5000)
+    # bit_length bins: 1000 -> 10, 5000 -> 13
+    assert s.read("trace_hist_t_binop_sz10_lat13") == 1
+    recorder.hist("t_binop", 0, 0)
+    assert s.read("trace_hist_t_binop_sz0_lat0") == 1
+    h = export.histograms(s.snapshot())["t_binop"]
+    assert h[(10, 13)] == 1 and h[(0, 0)] == 1
+
+
+def test_histogram_percentiles(no_recorder):
+    for _ in range(10):
+        recorder.hist("t_pctop", 64, 100)     # lat bin 7
+    recorder.hist("t_pctop", 64, 100000)      # lat bin 17
+    pc = export.percentiles("t_pctop", (0.5, 0.99))
+    assert pc is not None
+    assert pc[0] == 3.0 * 2 ** 5     # midpoint of bin 7 = 96 ns
+    assert pc[1] == 3.0 * 2 ** 15    # midpoint of bin 17
+    assert export.percentiles("t_no_such_op") is None
+
+
+# -- Pready -> flush attribution ----------------------------------------
+
+def test_pready_flush_span_attribution(no_recorder):
+    """Flush spans carry the Pready that released the bucket and
+    whether the dispatch overlapped pending partitions; the flush
+    latency lands in the part_bucket_flush histogram."""
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu import op as op_mod
+    from ompi_tpu.coll import xla as cx
+
+    ctx = cx._Ctx.local()
+    # two dtype-segregated buckets: f32 leaves {0,1}, i32 leaves {2,3}
+    bufs = [jnp.ones(64, jnp.float32), jnp.ones(64, jnp.float32),
+            jnp.ones(64, jnp.int32), jnp.ones(64, jnp.int32)]
+    leaves, treedef = jax.tree.flatten(bufs)
+    preq = cx.PartitionedAllreduceRequest(ctx, leaves, treedef,
+                                          op_mod.SUM, None)
+    rec = recorder.enable(capacity=1024, api_spans=False)
+    s = pvar.session()
+    try:
+        preq.start()
+        # f32 bucket completes FIRST (out of order: 1 then 0), while
+        # the i32 leaves are still pending -> overlap flush
+        preq.Pready(1)
+        preq.Pready(0)
+        preq.Pready(2)
+        preq.Pready(3)
+        preq.wait()
+    finally:
+        recorder.disable()
+    flushes = [sp for sp in rec.spans()
+               if sp.name == "part_bucket_flush"]
+    assert len(flushes) == 2, rec.spans()
+    by_trigger = {sp.args["trigger_partition"]: sp for sp in flushes}
+    assert set(by_trigger) == {0, 3}, by_trigger
+    assert by_trigger[0].args["overlap"] is True
+    assert by_trigger[3].args["overlap"] is False
+    assert all(sp.args["nbytes"] == 2 * 64 * 4 for sp in flushes)
+    assert all(sp.subsys == "part" for sp in flushes)
+    # the Pready markers are on the timeline too
+    preadys = [sp.args["partition"] for sp in rec.spans()
+               if sp.name == "pready"]
+    assert preadys == [1, 0, 2, 3]
+    # and each flush fed the latency histogram
+    hist = export.histograms(s.snapshot())
+    assert sum(hist.get("part_bucket_flush", {}).values()) == 2
+    # launch spans from the coll_xla layer under the flushes
+    assert sum(1 for sp in rec.spans()
+               if sp.name == "launch" and sp.subsys == "coll_xla") == 2
+
+
+# -- Chrome export + merge ----------------------------------------------
+
+def _fake_recorder(rank, t_base=1_000_000):
+    rec = recorder.Recorder(capacity=64, rank=rank)
+    rec.record("alpha", "api", t_base, t_base + 5_000)
+    rec.record("beta", "pml", t_base + 1_000, t_base + 2_000)
+    rec.record("gamma", "api", t_base + 6_000, t_base + 9_000)
+    return rec
+
+
+def test_export_chrome_shape(no_recorder):
+    doc = export.to_chrome(_fake_recorder(0))
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list)
+    spans = [e for e in evs if e["ph"] == "X"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert len(spans) == 3
+    assert {e["name"] for e in metas} == {"process_name",
+                                          "thread_name"}
+    assert all(e["pid"] == 0 for e in spans)
+    # per-tid timestamps are monotone
+    by_tid = {}
+    for e in spans:
+        by_tid.setdefault(e["tid"], []).append(e["ts"])
+    for ts in by_tid.values():
+        assert ts == sorted(ts)
+    # ts/dur are microseconds
+    alpha = next(e for e in spans if e["name"] == "alpha")
+    assert alpha["dur"] == 5.0
+    assert doc["metadata"]["rank"] == 0
+
+
+def test_export_requires_a_recorder(no_recorder):
+    with pytest.raises(RuntimeError):
+        export.to_chrome()
+
+
+def test_merge_two_ranks_distinct_pids(tmp_path, no_recorder):
+    p0 = str(tmp_path / "r0.json")
+    p1 = str(tmp_path / "r1.json")
+    export.write(p0, _fake_recorder(0))
+    export.write(p1, _fake_recorder(1, t_base=1_500_000))
+    doc = merge.merge([p0, p1])
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in spans} == {0, 1}
+    assert doc["metadata"]["ranks"] == [0, 1]
+    # metadata events lead, spans are globally ts-sorted
+    ph = [e["ph"] for e in doc["traceEvents"]]
+    assert ph == sorted(ph, key=lambda p: 0 if p == "M" else 1)
+    ts = [e["ts"] for e in spans]
+    assert ts == sorted(ts)
+
+
+def test_merge_pid_collision_bumps(tmp_path, no_recorder):
+    p0 = str(tmp_path / "a.json")
+    p1 = str(tmp_path / "b.json")
+    export.write(p0, _fake_recorder(0))
+    export.write(p1, _fake_recorder(0))
+    doc = merge.merge([p0, p1])
+    assert doc["metadata"]["ranks"] == [0, 1]  # second file bumped
+
+
+def test_merge_cli(tmp_path, capsys, no_recorder):
+    p0 = str(tmp_path / "r0.json")
+    p1 = str(tmp_path / "r1.json")
+    recorder.hist("t_cliop", 64, 100)
+    export.write(p0, _fake_recorder(0))
+    export.write(p1, _fake_recorder(1))
+    out = str(tmp_path / "merged.json")
+    assert trace_cli.main(["merge", "-o", out, p0, p1]) == 0
+    doc = json.load(open(out))
+    assert {e["pid"] for e in doc["traceEvents"]} == {0, 1}
+    assert trace_cli.main(["report", p0]) == 0
+    text = capsys.readouterr().out
+    assert "api" in text and "hist t_cliop" in text
+
+
+# -- events plane: concurrent drop accounting (satellite) ----------------
+
+def test_event_drops_concurrent_emitters_exact():
+    events.register_type("t_trace_drops", "test type", ("i",))
+    fired = []
+    h = events.handle_alloc("t_trace_drops", buffer_size=4)
+    h.set_dropped_handler(lambda n: fired.append(n))
+    try:
+        n_threads, per = 4, 50
+        start = threading.Barrier(n_threads)
+
+        def emitter():
+            start.wait()
+            for i in range(per):
+                events.emit("t_trace_drops", i=i)
+
+        ts = [threading.Thread(target=emitter)
+              for _ in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # overflow from >= 2 concurrent emitters counts EXACTLY
+        assert h.dropped == n_threads * per - 4, h.dropped
+        # handler fired once for the whole dropping episode
+        assert len(fired) == 1, fired
+        # draining re-arms the transition
+        assert h.read() is not None
+        events.emit("t_trace_drops", i=-1)   # refills the free slot
+        assert h.dropped == n_threads * per - 4
+        events.emit("t_trace_drops", i=-2)   # overflows again
+        assert h.dropped == n_threads * per - 3
+        assert len(fired) == 2, fired
+    finally:
+        h.free()
+
+
+def test_event_dropped_handler_single_thread_transitions():
+    events.register_type("t_trace_drops2", "test type", ("i",))
+    fired = []
+    h = events.handle_alloc("t_trace_drops2", buffer_size=2)
+    h.set_dropped_handler(lambda n: fired.append(n))
+    try:
+        for i in range(6):
+            events.emit("t_trace_drops2", i=i)
+        assert h.dropped == 4
+        assert fired == [1], fired  # once, at the transition
+    finally:
+        h.free()
+
+
+# -- end to end: init-time enable + cross-rank clock sync ---------------
+
+def test_trace_enabled_two_ranks_end_to_end():
+    """cvar trace_enable turns the recorder on at instance init,
+    clock offsets sync through the store, per-rank exports merge into
+    one timeline with distinct pids and api+pml spans."""
+    run_ranks("""
+        import json
+        from ompi_tpu.trace import export, merge, recorder
+        rec = recorder.RECORDER
+        assert rec is not None, "trace_enable should enable at init"
+        assert rec.rank == rank
+        data = np.ones(64, np.float32)
+        if rank == 0:
+            comm.Send(data, dest=1, tag=3)
+        else:
+            comm.Recv(data, source=0, tag=3)
+        comm.Barrier()
+        path = f"/tmp/ompi_tpu_trace_e2e_r{rank}.json"
+        export.write(path, rec)
+        comm.Barrier()
+        if rank == 0:
+            paths = [f"/tmp/ompi_tpu_trace_e2e_r{r}.json"
+                     for r in range(size)]
+            doc = merge.merge(paths)
+            spans = [e for e in doc["traceEvents"]
+                     if e.get("ph") == "X"]
+            assert {e["pid"] for e in spans} == {0, 1}
+            bases = [json.load(open(p))["metadata"]["clock_base_ns"]
+                     for p in paths]
+            assert bases[0] == bases[1], bases  # synced to rank 0
+            cats = {e["cat"] for e in spans}
+            assert "api" in cats and "pml" in cats, cats
+        comm.Barrier()
+    """, 2, mca={"trace_enable": "1"}, timeout=120)
