@@ -359,6 +359,84 @@ def _bench_overlap():
     }
 
 
+def _bench_telemetry():
+    """Overhead of being watched (the telemetry plane's cost card):
+    flight-recorder enter/exit ns per op, one sampler cycle (pvar
+    snapshot + OpenMetrics render) in ms + rendered page size, one
+    watchdog sweep in ms — all in-process with injected no-op
+    collaborators (no store RPCs), so the numbers isolate the plane's
+    CPU cost from any RPC wall time."""
+    from ompi_tpu.telemetry import flight, sampler, watchdog
+
+    fl = flight.FlightRecorder(rank=0)
+    iters = 20000
+    t0 = time.perf_counter_ns()
+    for _ in range(iters):
+        fl.exit(fl.enter("bench", 0, 0))
+    enter_exit_ns = (time.perf_counter_ns() - t0) / iters
+
+    smp = sampler.Sampler(rank=0, jobid="bench", size=1,
+                          interval=3600, port=0, path="",
+                          rollup=False)
+    text = smp.sample()  # warm
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        text = smp.sample()
+    sample_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    wd = watchdog.Watchdog(rank=0, jobid="bench", world=range(1),
+                           flight_rec=fl, dead_fn=lambda: {},
+                           timeout=3600.0, period=3600.0)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        wd.sweep()
+    sweep_ms = (time.perf_counter() - t0) / reps * 1e3
+    return {
+        "flight_enter_exit_ns": round(enter_exit_ns, 1),
+        "sampler_cycle_ms": round(sample_ms, 3),
+        "watchdog_sweep_ms": round(sweep_ms, 4),
+        "openmetrics_page_bytes": len(text),
+    }
+
+
+#: microbench extras compared across rounds once a TPU round records
+#: them in bench_baseline.json: (section, key, higher_is_better)
+_EXTRA_BASELINE_KEYS = (
+    ("dispatch", "allreduce_4k_launches_per_s", True),
+    ("dispatch", "fused_64x256k_ms", False),
+    ("dispatch", "fused_speedup", True),
+    ("overlap", "partitioned_32x256k_ms", False),
+    ("overlap", "overlap_flushes_per_cycle", True),
+    ("overlap", "pready_overhead_us_per_leaf", False),
+)
+
+
+def _vs_extras(base_extra, extra):
+    """Cross-round comparison of the dispatch/overlap microbench
+    extras (the ROADMAP item the primary vs_baseline never covered):
+    each comparable key becomes a ratio normalized so > 1.0 reads as
+    an improvement over the recorded baseline. Returns None when the
+    baseline predates extras (pre-round-4 files) or nothing is
+    comparable — the primary metric comparison is unaffected."""
+    if not isinstance(base_extra, dict):
+        return None
+    out = {}
+    for section, key, higher in _EXTRA_BASELINE_KEYS:
+        bsec, csec = base_extra.get(section), extra.get(section)
+        if not isinstance(bsec, dict) or not isinstance(csec, dict):
+            continue
+        try:
+            b = float(bsec[key])
+            c = float(csec[key])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if b <= 0 or c <= 0:
+            continue
+        out[f"{section}.{key}"] = round(c / b if higher else b / c, 4)
+    return out or None
+
+
 def _trace_api_smoke():
     """A few real MPI calls inside the traced region so the exported
     timeline shows api-layer spans (via the PMPI interposition hook
@@ -424,6 +502,12 @@ def main() -> None:
     except Exception as e:
         _phase(f"overlap microbench skipped: {e!r}")
         overlap = None
+    try:
+        telemetry = _bench_telemetry()
+        _phase("telemetry microbench done")
+    except Exception as e:
+        _phase(f"telemetry microbench skipped: {e!r}")
+        telemetry = None
     if trace_path is not None:
         from ompi_tpu.trace import export as trace_export
         from ompi_tpu.trace import recorder as trace_rec
@@ -449,6 +533,7 @@ def main() -> None:
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_baseline.json")
     vs = 1.0
+    vs_extra = None
     # the recorded baseline is a TPU measurement: only the TPU path
     # compares against it (the CPU smoke run would read as a fake
     # ~1000x regression)
@@ -456,6 +541,9 @@ def main() -> None:
         try:
             base = json.load(open(base_path))
             vs = tflops / float(base["value"])
+            vs_extra = _vs_extras(base.get("extra"),
+                                  {"dispatch": dispatch,
+                                   "overlap": overlap})
         except Exception:
             pass
 
@@ -470,6 +558,9 @@ def main() -> None:
         "value": round(tflops, 3),
         "unit": "TFLOP/s",
         "vs_baseline": round(vs, 4),
+        # dispatch/overlap microbenches vs the recorded baseline's
+        # extras (>1.0 = better); None until a TPU round records them
+        "vs_baseline_extra": vs_extra,
         "extra": {
             "tokens_per_s": round(tokens_per_s, 1),
             "mfu_pct": None if peak is None else round(
@@ -483,6 +574,7 @@ def main() -> None:
             "staging_h2d_GBs": None if h2d is None else round(h2d, 2),
             "dispatch": dispatch,
             "overlap": overlap,
+            "telemetry": telemetry,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),
             # wall attribution: metric quality depends only on

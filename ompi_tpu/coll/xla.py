@@ -36,6 +36,7 @@ import numpy as np
 from ompi_tpu import errors, op as op_mod
 from ompi_tpu.coll import CollModule, accelerator as staging, framework
 from ompi_tpu.core import cvar, output, pvar
+from ompi_tpu.telemetry import flight as _flight
 from ompi_tpu.trace import recorder as _trace
 
 _out = output.stream("coll_xla")
@@ -425,7 +426,15 @@ def allreduce_dev(comm, sendbuf, op=op_mod.SUM,
     pvar.record("coll_xla_device")
     if comm.size == 1:
         return sendbuf
-    return _allreduce_prep(comm, sendbuf, op, deterministic)()
+    fl = _flight.FLIGHT
+    if fl is None:
+        return _allreduce_prep(comm, sendbuf, op, deterministic)()
+    tok = fl.enter("allreduce_dev", getattr(comm, "cid", -1),
+                   getattr(sendbuf, "nbytes", 0))
+    try:
+        return _allreduce_prep(comm, sendbuf, op, deterministic)()
+    finally:
+        fl.exit(tok)
 
 
 #: test/diagnostic hook: the last rooted schedule's per-round,
@@ -607,7 +616,15 @@ def bcast_dev(comm, buf, root: int = 0):
     pvar.record("coll_xla_device")
     if comm.size == 1:
         return buf
-    return _bcast_prep(comm, buf, root)()
+    fl = _flight.FLIGHT
+    if fl is None:
+        return _bcast_prep(comm, buf, root)()
+    tok = fl.enter("bcast_dev", getattr(comm, "cid", -1),
+                   getattr(buf, "nbytes", 0))
+    try:
+        return _bcast_prep(comm, buf, root)()
+    finally:
+        fl.exit(tok)
 
 
 def _bcast_body(root: int):
@@ -634,7 +651,15 @@ def allgather_dev(comm, sendbuf):
     pvar.record("coll_xla_device")
     if comm.size == 1:
         return sendbuf[None] if hasattr(sendbuf, "shape") else sendbuf
-    return _allgather_prep(comm, sendbuf)()
+    fl = _flight.FLIGHT
+    if fl is None:
+        return _allgather_prep(comm, sendbuf)()
+    tok = fl.enter("allgather_dev", getattr(comm, "cid", -1),
+                   getattr(sendbuf, "nbytes", 0))
+    try:
+        return _allgather_prep(comm, sendbuf)()
+    finally:
+        fl.exit(tok)
 
 
 def gather_dev(comm, sendbuf, root: int = 0):
@@ -679,7 +704,15 @@ def alltoall_dev(comm, sendbuf):
     pvar.record("coll_xla_device")
     if comm.size == 1:
         return sendbuf
-    return _alltoall_prep(comm, sendbuf)()
+    fl = _flight.FLIGHT
+    if fl is None:
+        return _alltoall_prep(comm, sendbuf)()
+    tok = fl.enter("alltoall_dev", getattr(comm, "cid", -1),
+                   getattr(sendbuf, "nbytes", 0))
+    try:
+        return _alltoall_prep(comm, sendbuf)()
+    finally:
+        fl.exit(tok)
 
 
 def _reduce_scatter_block_prep(comm, sendbuf, op=op_mod.SUM,
@@ -712,8 +745,17 @@ def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
     pvar.record("coll_xla_device")
     if comm.size == 1:
         return sendbuf
-    return _reduce_scatter_block_prep(comm, sendbuf, op,
-                                      deterministic)()
+    fl = _flight.FLIGHT
+    if fl is None:
+        return _reduce_scatter_block_prep(comm, sendbuf, op,
+                                          deterministic)()
+    tok = fl.enter("reduce_scatter_block_dev", getattr(comm, "cid", -1),
+                   getattr(sendbuf, "nbytes", 0))
+    try:
+        return _reduce_scatter_block_prep(comm, sendbuf, op,
+                                          deterministic)()
+    finally:
+        fl.exit(tok)
 
 
 def _scatter_meta(comm, key, root: int, root_meta):
@@ -810,7 +852,15 @@ def barrier_dev(comm):
     before any member's program completes. Reference: coll/accelerator
     interposes every slot incl. barrier (ompi/mca/coll/accelerator/);
     here the rendezvous itself rides ICI instead of the host."""
-    ibarrier_dev(comm).wait()
+    fl = _flight.FLIGHT
+    if fl is None:
+        ibarrier_dev(comm).wait()
+        return
+    tok = fl.enter("barrier_dev", getattr(comm, "cid", -1), 0)
+    try:
+        ibarrier_dev(comm).wait()
+    finally:
+        fl.exit(tok)
 
 
 def scatterv_dev(comm, sendbuf, counts, root: int = 0, like=None):
@@ -1213,7 +1263,16 @@ def allreduce_multi_dev(comm, bufs, op=op_mod.SUM,
 
     if comm.size == 1 or not jax.tree.leaves(bufs):
         return bufs
-    return _allreduce_multi_prep(comm, bufs, op, deterministic)()
+    fl = _flight.FLIGHT
+    if fl is None:
+        return _allreduce_multi_prep(comm, bufs, op, deterministic)()
+    tok = fl.enter("allreduce_multi_dev", getattr(comm, "cid", -1),
+                   sum(getattr(b, "nbytes", 0)
+                       for b in jax.tree.leaves(bufs)))
+    try:
+        return _allreduce_multi_prep(comm, bufs, op, deterministic)()
+    finally:
+        fl.exit(tok)
 
 
 # ---------------------------------------------------------------------------
@@ -1504,6 +1563,9 @@ class PartitionedAllreduceRequest:
         self._n_ready = 0
         self._pending = [len(idxs) for _fn, idxs in self._buckets]
         self._results = [None] * len(self._buckets)
+        fl = _flight.FLIGHT
+        self._fl_tok = None if fl is None else fl.enter(
+            "pallreduce_cycle", -1, self.nbytes)
 
     def Pready(self, idx: int, value=None) -> None:
         if self._ready is None:
@@ -1608,6 +1670,11 @@ class PartitionedAllreduceRequest:
         pvar.record("coll_xla_fused_bytes", self.nbytes)
         self._arr = jax.tree.unflatten(self._treedef, outs)
         self._ready = None  # cycle closed: back to inactive
+        tok, self._fl_tok = self._fl_tok, None
+        if tok is not None:
+            fl = _flight.FLIGHT
+            if fl is not None:
+                fl.exit(tok)
 
     def wait(self, timeout=None):
         if self._ready is None:

@@ -48,6 +48,11 @@ WELL_KNOWN = (
     # size-bin) log2 latency histograms ride dynamic names
     # (trace_hist_<op>_sz<s>_lat<l>, decoded by trace.export)
     "trace_dropped",
+    # telemetry/ plane: collective flight-recorder entries, sampler
+    # ticks + cost, watchdog sweeps and hang verdicts dumped
+    "telemetry_flight_ops", "telemetry_samples",
+    "telemetry_sample_ns", "telemetry_watchdog_sweeps",
+    "telemetry_hangs",
     # pml/monitoring per-context traffic (combined monitoring_msgs/
     # monitoring_bytes stay alongside)
     "monitoring_p2p_msgs", "monitoring_p2p_bytes",

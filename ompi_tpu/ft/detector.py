@@ -105,9 +105,14 @@ class Detector:
     # -- emitter/observer thread -----------------------------------------
     def _run(self) -> None:
         failures = 0
+        from ompi_tpu.telemetry import flight as _flight
+
         while not self._stop.wait(self.period):
             try:
-                self._client.heartbeat(rte.rank)
+                # piggyback the telemetry plane's latest collective
+                # seq (None while telemetry is off — same 2-tuple
+                # wire message as before)
+                self._client.heartbeat(rte.rank, _flight.hb_payload())
                 self.dead = self._client.faults(self.hb_timeout)
                 epoch = self._client.inc(
                     f"ft:rev_epoch:{rte.jobid}", 0)

@@ -38,6 +38,7 @@ import numpy as np
 from ompi_tpu import errors, pml
 from ompi_tpu.core import pvar
 from ompi_tpu.pml import request as rq
+from ompi_tpu.telemetry import flight as _flight
 from ompi_tpu.trace import recorder as _trace
 
 _PART_BASE = -(1 << 24)  # below any other framework-internal tag
@@ -90,6 +91,7 @@ class _PartitionedBase(rq.Request):
         self.partitions = partitions
         self._chunks = np.split(flat, partitions)  # views
         self._started = False  # ever started (Parrived precondition)
+        self._fl_tok = None  # flight-recorder token of the open epoch
         self.completed = True  # inactive until start()
 
     @property
@@ -100,6 +102,11 @@ class _PartitionedBase(rq.Request):
         flips — same contract as _PersistentRequest/DeviceRequest."""
         if not self._done:
             self._done = self._epoch_done()
+            if self._done and self._fl_tok is not None:
+                tok, self._fl_tok = self._fl_tok, None
+                fl = _flight.FLIGHT
+                if fl is not None:
+                    fl.exit(tok)
         return self._done
 
     @completed.setter
@@ -138,6 +145,11 @@ class PartitionedSendRequest(_PartitionedBase):
         self._started = True
         self.completed = False
         pvar.record("part_send_start")
+        fl = _flight.FLIGHT
+        if fl is not None:
+            self._fl_tok = fl.enter(
+                "psend_epoch", getattr(self.comm, "cid", -1),
+                sum(int(c.nbytes) for c in self._chunks))
 
     def Pready(self, idx: int) -> None:
         if self.completed:
@@ -209,6 +221,11 @@ class PartitionedRecvRequest(_PartitionedBase):
         self._started = True
         self.completed = False
         pvar.record("part_recv_start")
+        fl = _flight.FLIGHT
+        if fl is not None:
+            self._fl_tok = fl.enter(
+                "precv_epoch", getattr(self.comm, "cid", -1),
+                sum(int(c.nbytes) for c in self._chunks))
 
     def Parrived(self, idx: int) -> bool:
         if not self._started:

@@ -41,6 +41,7 @@ from ompi_tpu.datatype.convertor import dtype_of
 from ompi_tpu.pml import custommatch, peruse
 from ompi_tpu.pml import request as rq
 from ompi_tpu.runtime import rte
+from ompi_tpu.telemetry import flight as _flight
 from ompi_tpu.trace import recorder as _trace
 
 HDR_MATCH = 1
@@ -284,6 +285,12 @@ class Ob1:
                 conv.set_hetero(swap=mine != arch.native())
         src_commrank = comm.rank
         seq = self._next_seq(ctx, dst)
+        fl = _flight.FLIGHT
+        if fl is not None and collective:
+            # dump-only detail: the hang dump shows the last pml seq
+            # that moved on each collective context (host-staged
+            # collectives progressing vs truly wedged)
+            fl.mark_pml(ctx, seq)
         size = conv.packed_size
         msgid = next(_msg_ids)
         req.conv = conv

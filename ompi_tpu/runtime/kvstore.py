@@ -26,7 +26,10 @@ Commands:
 Fault tolerance (the PRRTE-daemon side of ULFM — the reference delegates
 runtime-level failure detection to PRTE, docs/features/ulfm.rst:260-262;
 here the store IS the daemon):
-  ("hb", rank)                   -> ("ok",)   # heartbeat timestamp
+  ("hb", rank[, payload])        -> ("ok",)   # heartbeat timestamp;
+      the optional payload (telemetry plane: latest collective seq)
+      is kept per rank and read back via ("telem?",)
+  ("telem?",)                    -> ("val", {rank: payload})
   ("dead", rank, reason)         -> ("ok",)   # declare a rank failed
   ("faults?", hb_timeout|None)   -> ("val", {rank: reason})
   ("ftgather", tag, rank, value, ranks, hb_timeout)
@@ -84,6 +87,8 @@ class Store:
         # always failed, per ULFM semantics) + last heartbeat times
         self._dead: Dict[int, str] = {}
         self._hb: Dict[int, float] = {}
+        # latest heartbeat piggyback per rank (telemetry seq payloads)
+        self._telem: Dict[int, Any] = {}
         # tag -> {"contribs": {rank: val}, "result": frozen | None}
         self._gathers: Dict[str, dict] = {}
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -197,10 +202,16 @@ class Store:
             with self._cond:
                 return ("val", self._aborted)
         if op == "hb":
-            _, rank = msg
+            rank = msg[1]
+            payload = msg[2] if len(msg) > 2 else None
             with self._cond:
                 self._hb[rank] = time.monotonic()
+                if payload is not None:
+                    self._telem[rank] = payload
             return ("ok",)
+        if op == "telem?":
+            with self._cond:
+                return ("val", dict(self._telem))
         if op == "dead":
             _, rank, reason = msg
             self.mark_dead(rank, reason)
@@ -358,8 +369,18 @@ class Client:
             pass
 
     # -- fault tolerance --------------------------------------------------
-    def heartbeat(self, rank: int) -> None:
-        self._rpc("hb", rank)
+    def heartbeat(self, rank: int, payload: Any = None) -> None:
+        """Heartbeat, optionally carrying a telemetry payload (the
+        rank's latest collective seq). A None payload keeps the wire
+        message the 2-tuple pre-telemetry stores understand."""
+        if payload is None:
+            self._rpc("hb", rank)
+        else:
+            self._rpc("hb", rank, payload)
+
+    def telemetry(self) -> Dict[int, Any]:
+        """Latest heartbeat payload per rank (watchdog seq diffing)."""
+        return self._rpc("telem?")[1]
 
     def mark_dead(self, rank: int, reason: str) -> None:
         self._rpc("dead", rank, reason)

@@ -94,6 +94,16 @@ def init_instance() -> None:
                 _trace_rec.sync_clock()
             except Exception as exc:  # tracing must never sink init
                 _out.verbose(0, "trace enable failed: %r", exc)
+        # telemetry plane (cvar telemetry_enable / OMPI_TPU_TELEMETRY):
+        # flight recorder + metrics sampler + hang watchdog — after
+        # tracing so dump-on-hang can flush the span ring
+        from ompi_tpu import telemetry as _telemetry
+
+        if _telemetry.requested():
+            try:
+                _telemetry.start(rank=rte.rank)
+            except Exception as exc:  # telemetry must never sink init
+                _out.verbose(0, "telemetry enable failed: %r", exc)
         _instance_up = True
         atexit.register(_atexit_finalize)
 
@@ -124,6 +134,15 @@ def _release() -> None:
                 # every rank must have drained its last messages before
                 # any transport tears down (unlink/close races)
                 rte.fence("finalize", timeout=30.0)
+        except Exception:
+            pass
+        # telemetry threads go first: a watchdog sweeping (or a
+        # sampler publishing) against a store that the teardown below
+        # is about to close would log spurious RPC failures
+        from ompi_tpu import telemetry as _telemetry
+
+        try:
+            _telemetry.stop()
         except Exception:
             pass
         from ompi_tpu import pml

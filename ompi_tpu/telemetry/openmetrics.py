@@ -1,0 +1,103 @@
+"""OpenMetrics text rendering of the pvar plane.
+
+Semantics mapping (the acceptance contract, round-tripped by
+:func:`parse` in the tests): monotonically-increasing pvar counters
+become OpenMetrics ``counter`` families (sample suffix ``_total``);
+high-watermark pvars (``*_hwm`` keys of ``pvar.snapshot()``) and any
+explicitly-listed gauge keys become ``gauge`` families. Every sample
+carries the per-rank labels, names get the ``ompi_tpu_`` namespace
+prefix, and the exposition ends with the mandatory ``# EOF``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, Mapping, Optional, Set
+
+PREFIX = "ompi_tpu_"
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _safe(name: str) -> str:
+    return _NAME_OK.sub("_", name)
+
+
+def _labelstr(labels: Optional[Mapping[str, str]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        '%s="%s"' % (_safe(k), str(v).replace("\\", "\\\\")
+                     .replace('"', '\\"').replace("\n", "\\n"))
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def render(snap: Mapping[str, int],
+           labels: Optional[Mapping[str, str]] = None,
+           gauges: Iterable[str] = (),
+           terminate: bool = True) -> str:
+    """One rank's pvar snapshot as OpenMetrics text. ``gauges`` lists
+    extra keys to render as gauges (``*_hwm`` keys always are).
+    ``terminate=False`` omits ``# EOF`` so a job-rollup block can be
+    appended before the terminator."""
+    gauge_keys: Set[str] = set(gauges)
+    lbl = _labelstr(labels)
+    lines = []
+    for name in sorted(snap):
+        value = snap[name]
+        metric = PREFIX + _safe(name)
+        if name.endswith("_hwm") or name in gauge_keys:
+            lines.append("# TYPE %s gauge" % metric)
+            lines.append("%s%s %d" % (metric, lbl, value))
+        else:
+            lines.append("# TYPE %s counter" % metric)
+            lines.append("%s_total%s %d" % (metric, lbl, value))
+    if terminate:
+        lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def parse(text: str) -> Dict[str, Dict[str, int]]:
+    """Inverse of :func:`render` (tests + scrape checks): returns
+    ``{pvar_name: {labelstr: value}}`` with the prefix and the
+    counter ``_total`` suffix stripped, so keys match the original
+    ``pvar.snapshot()`` names."""
+    types: Dict[str, str] = {}
+    out: Dict[str, Dict[str, int]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        name_part, _, value = line.rpartition(" ")
+        metric, lbl = name_part, ""
+        if "{" in name_part:
+            metric, _, rest = name_part.partition("{")
+            lbl = "{" + rest
+        if metric.endswith("_total") \
+                and types.get(metric[:-len("_total")]) == "counter":
+            # counter sample: the family is declared without _total
+            metric = metric[:-len("_total")]
+        name = metric[len(PREFIX):] if metric.startswith(PREFIX) \
+            else metric
+        out.setdefault(name, {})[lbl] = int(value)
+    return out
+
+
+def aggregate(snaps: Iterable[Mapping[str, int]]) -> Dict[str, int]:
+    """Job-level rollup: counters sum across ranks, watermarks take
+    the max (the MPI_T reduction semantics for each class)."""
+    out: Dict[str, int] = {}
+    for snap in snaps:
+        for name, value in snap.items():
+            if name.endswith("_hwm"):
+                if value > out.get(name, 0):
+                    out[name] = value
+            else:
+                out[name] = out.get(name, 0) + value
+    return out

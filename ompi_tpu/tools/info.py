@@ -90,7 +90,13 @@ def collect(level: int = 3,
         if var.choices is not None:
             out["cvars"][name]["choices"] = list(var.choices)
     if include_pvars:
-        out["pvars"] = pvar.snapshot()
+        # seed with the well-known set so never-recorded counters
+        # (e.g. the telemetry plane's, in a process that ran no job)
+        # still list at 0 — ompi_info shows every pvar, not just the
+        # ones that already ticked
+        pvars = {k: 0 for k in pvar.WELL_KNOWN}
+        pvars.update(pvar.snapshot())
+        out["pvars"] = pvars
     from ompi_tpu.core import events
 
     out["events"] = [events.get_info(i)

@@ -15,7 +15,18 @@ from ompi_tpu.trace import export, merge
 
 
 def _cmd_merge(args) -> int:
-    doc = merge.merge_files(args.out, args.inputs)
+    try:
+        doc = merge.merge_files(args.out, args.inputs)
+    except OSError as exc:
+        # missing/unreadable per-rank file (or unwritable output):
+        # one line, nonzero exit — never a traceback
+        print(f"trace merge: {exc}", file=sys.stderr)
+        return 1
+    except (json.JSONDecodeError, KeyError, TypeError,
+            ValueError) as exc:
+        print("trace merge: corrupt trace input: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return 1
     md = doc["metadata"]
     print(f"merged {md['merged_from']} trace(s), ranks {md['ranks']}, "
           f"{len(doc['traceEvents'])} events -> {args.out}")

@@ -1,0 +1,74 @@
+#!/usr/bin/env bash
+# Watchdog smoke lane: 2-rank CPU job where rank 1 deliberately
+# sleeps before the final barrier. Rank 0's telemetry watchdog must
+# declare the hang, name rank 1 (and the stuck seq) in the JSON dump,
+# and the job must still complete cleanly once rank 1 wakes up. The
+# dump directory stays on disk for the CI artifact upload.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${1:-watchdog_smoke_out}"
+rm -rf "$out"
+mkdir -p "$out"
+
+cat > "$out/stall_job.py" <<'EOF'
+import time
+
+from ompi_tpu import mpi
+
+world = mpi.Init()
+me = world.rank
+# warm-up collectives so every rank has published flight seqs
+for _ in range(3):
+    world.allreduce(me)
+world.Barrier()
+if me == 1:
+    # the deliberate straggler: rank 0 enters the final barrier ~6s
+    # before this rank does — well past telemetry_hang_timeout
+    time.sleep(6.0)
+world.Barrier()
+world.allreduce(1)
+mpi.Finalize()
+EOF
+
+JAX_PLATFORMS=cpu python -m ompi_tpu.runtime.launcher -n 2 \
+  --timeout 120 \
+  --mca telemetry_enable 1 \
+  --mca telemetry_hang_timeout 2 \
+  --mca telemetry_watchdog_period 0.2 \
+  --mca telemetry_interval 0.5 \
+  --mca telemetry_dump_dir "$out" \
+  --mca telemetry_file "$out/metrics_rank{rank}.txt" \
+  "$out/stall_job.py"
+
+python - "$out" <<'EOF'
+import glob
+import json
+import sys
+
+out = sys.argv[1]
+dumps = sorted(glob.glob(out + "/ompi_tpu_hang_rank*_seq*.json"))
+assert dumps, f"no hang dump written in {out}"
+named = False
+for path in dumps:
+    doc = json.load(open(path))
+    assert doc["schema"] == "ompi_tpu.telemetry.hang/1", doc["schema"]
+    v = doc["verdict"]
+    assert v["op"] and v["seq"] >= 1, v
+    assert isinstance(doc["inflight"], list) and doc["pvars"], doc
+    if doc["rank"] == 0:
+        assert v["stragglers"] == [1], (
+            f"rank 0's dump must name rank 1 as the straggler: {v}")
+        seqs = {int(k): int(s) for k, s in v["peer_seqs"].items()}
+        assert seqs[1] < v["seq"] <= seqs[0], (
+            f"stuck seq {v['seq']} must sit between the straggler's "
+            f"and the waiter's published seqs: {seqs}")
+        named = True
+assert named, f"no rank-0 dump naming the straggler in {dumps}"
+
+metrics = open(out + "/metrics_rank0.txt").read()
+assert metrics.rstrip().endswith("# EOF"), "unterminated exposition"
+assert "ompi_tpu_telemetry_watchdog_sweeps_total" in metrics, metrics
+print(f"watchdog smoke OK: {len(dumps)} dump(s), straggler rank 1 "
+      f"named in {dumps[0]}")
+EOF

@@ -1,0 +1,392 @@
+"""telemetry/ subsystem tests: flight-recorder table + seq semantics,
+the one-branch disabled guard on the coll/xla hot path, the OpenMetrics
+round-trip acceptance contract over the full pvar set, sampler
+file/HTTP/rollup export, the kvstore heartbeat-payload plane, watchdog
+straggler naming + dump-on-hang, and the ft-detector handoff (a rank
+declared dead immediately resolves any hang verdict naming it)."""
+
+import json
+import threading
+import types
+import urllib.request
+
+import pytest
+
+from ompi_tpu.core import pvar
+from ompi_tpu.telemetry import flight, openmetrics, watchdog
+from ompi_tpu.telemetry.sampler import Sampler
+from ompi_tpu.telemetry.watchdog import Watchdog
+from tests.harness import run_ranks
+
+
+@pytest.fixture
+def no_flight():
+    """Guarantee the global flight recorder is off before and after."""
+    flight.disable()
+    yield
+    flight.disable()
+
+
+# -- flight recorder -----------------------------------------------------
+
+def test_flight_enter_exit_seq_semantics(no_flight):
+    fl = flight.FlightRecorder(rank=3)
+    s = pvar.session()
+    t1 = fl.enter("allreduce_dev", comm_cid=7, nbytes=1024)
+    t2 = fl.enter("bcast_dev")
+    assert (t1, t2) == (1, 2)
+    assert fl.last_entered == 2 and fl.last_completed == 0
+    oldest = fl.oldest()
+    assert oldest[0] == 1 and oldest[1] == "allreduce_dev"
+    assert oldest[2] == 7 and oldest[3] == 1024
+    snap = fl.snapshot()
+    assert [e["seq"] for e in snap] == [1, 2]
+    assert fl.hb_dict() == {"seq": 2, "done": 0, "inflight": 2}
+    fl.exit(t2)
+    fl.exit(t1)  # out-of-order completion keeps the high-water done
+    assert fl.last_completed == 2
+    assert fl.oldest() is None and fl.snapshot() == []
+    assert s.read("telemetry_flight_ops") == 2
+    assert pvar.read("telemetry_inflight") >= 2  # watermark reached
+
+
+def test_flight_pml_marks_are_dump_only_detail(no_flight):
+    fl = flight.FlightRecorder()
+    fl.enter("allreduce_dev")
+    fl.mark_pml(ctx=5, seq=42)
+    snap = fl.snapshot()
+    assert snap[-1] == {"pml_ctx_seqs": {5: 42}}
+    assert fl.hb_dict()["seq"] == 1  # pml marks never move the seq
+
+
+def test_flight_thread_safety_exact_seq_accounting(no_flight):
+    fl = flight.FlightRecorder()
+    n_threads, per = 4, 200
+    start = threading.Barrier(n_threads)
+
+    def worker():
+        start.wait()
+        for _ in range(per):
+            fl.exit(fl.enter("op"))
+
+    ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert fl.last_entered == n_threads * per
+    assert fl.last_completed == n_threads * per
+    assert fl.oldest() is None
+
+
+def test_hb_payload_none_while_disabled(no_flight):
+    """The ft heartbeat must stay the 2-tuple wire message while
+    telemetry is off — hb_payload is the gate."""
+    assert flight.hb_payload() is None
+    flight.enable(rank=1, api_hook=False)
+    assert flight.hb_payload() == {"seq": 0, "done": 0, "inflight": 0}
+
+
+def test_disabled_guard_constructs_nothing(monkeypatch, no_flight):
+    """Default-off telemetry must not touch the flight recorder
+    anywhere on the coll/xla hot path — the one-branch guard contract
+    (same discipline, and same test shape, as the trace recorder)."""
+    import jax.numpy as jnp
+
+    from ompi_tpu.coll import xla as cx
+
+    assert flight.FLIGHT is None
+
+    def boom(*a, **k):
+        raise AssertionError("flight recorder touched while disabled")
+
+    monkeypatch.setattr(flight.FlightRecorder, "enter", boom)
+    monkeypatch.setattr(flight.FlightRecorder, "exit", boom)
+    ctx = cx._Ctx.local()
+    comm = types.SimpleNamespace(_coll_xla_ctx=ctx)
+    s = pvar.session()
+    launcher = cx._allreduce_prep(comm, jnp.ones(16, jnp.float32))
+    launcher()
+    assert s.read("coll_xla_launches") >= 1  # the path really ran
+
+
+def test_api_hook_installs_and_detaches(no_flight):
+    """Enabling telemetry interposes the blocking-collective API
+    methods via the PMPI chain; disabling restores them exactly (the
+    disabled API path pays nothing at all — not even the branch)."""
+    import ompi_tpu.mpi  # noqa: F401 — attaches the API methods
+    from ompi_tpu.comm import Communicator
+
+    originals = {n: getattr(Communicator, n)
+                 for n in flight.API_COLLECTIVES
+                 if hasattr(Communicator, n)}
+    assert originals, "API_COLLECTIVES must name real methods"
+    flight.enable(rank=0, api_hook=True)
+    try:
+        for name, orig in originals.items():
+            wrapped = getattr(Communicator, name)
+            assert wrapped is not orig, name
+            assert getattr(wrapped, "__profiled__", False), name
+    finally:
+        flight.disable()
+    for name, orig in originals.items():
+        assert getattr(Communicator, name) is orig, name
+
+
+# -- OpenMetrics ---------------------------------------------------------
+
+def test_openmetrics_full_pvar_roundtrip():
+    """Acceptance criterion: every registered pvar round-trips through
+    the exposition with correct counter/watermark semantics."""
+    snap = {name: i + 1 for i, name in enumerate(pvar.WELL_KNOWN)}
+    snap["part_inflight_hwm"] = 7   # a watermark key as snapshot emits
+    text = openmetrics.render(snap, {"rank": "2", "job": "j1"})
+    assert text.rstrip().endswith("# EOF")
+    parsed = openmetrics.parse(text)
+    lbl = '{job="j1",rank="2"}'
+    for name, value in snap.items():
+        assert parsed[name] == {lbl: value}, name
+        metric = openmetrics.PREFIX + name
+        if name.endswith("_hwm"):
+            assert f"# TYPE {metric} gauge" in text
+            assert f"{metric}{lbl} {value}" in text
+        else:
+            assert f"# TYPE {metric} counter" in text
+            assert f"{metric}_total{lbl} {value}" in text
+
+
+def test_openmetrics_gauge_override_and_aggregate():
+    text = openmetrics.render({"telemetry_seq_entered": 5},
+                              gauges=("telemetry_seq_entered",))
+    assert "ompi_tpu_telemetry_seq_entered 5" in text
+    assert "_total" not in text
+    agg = openmetrics.aggregate([
+        {"allreduce": 3, "depth_hwm": 4},
+        {"allreduce": 5, "depth_hwm": 2},
+    ])
+    assert agg == {"allreduce": 8, "depth_hwm": 4}  # sum vs max
+
+
+# -- sampler -------------------------------------------------------------
+
+def test_sampler_file_export_and_flight_gauges(tmp_path, no_flight):
+    fl = flight.enable(rank=0, api_hook=False)
+    fl.enter("allreduce_dev")
+    path = str(tmp_path / "metrics_rank{rank}.txt")
+    smp = Sampler(rank=4, jobid="jf", size=1, interval=3600,
+                  port=0, path=path, rollup=False)
+    try:
+        smp.start()
+        smp.sample()  # second page: telemetry_samples has ticked
+        text = open(str(tmp_path / "metrics_rank4.txt")).read()
+    finally:
+        smp.stop()
+    assert text.rstrip().endswith("# EOF")
+    parsed = openmetrics.parse(text)
+    lbl = '{job="jf",rank="4"}'
+    assert parsed["telemetry_seq_entered"][lbl] == 1
+    assert parsed["telemetry_inflight_now"][lbl] == 1
+    assert parsed["telemetry_samples"][lbl] >= 1
+
+
+def test_sampler_http_endpoint(no_flight):
+    smp = Sampler(rank=0, jobid="jh", size=1, interval=3600,
+                  port=-1, path="", rollup=False)
+    try:
+        smp.start()
+        host, port = smp.http_addr
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=5) as resp:
+            assert resp.status == 200
+            assert "openmetrics-text" in resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert body.rstrip().endswith("# EOF")
+        assert "ompi_tpu_telemetry_samples_total" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://{host}:{port}/nope", timeout=5)
+    finally:
+        smp.stop()
+
+
+def test_sampler_kvstore_rollup(no_flight):
+    from ompi_tpu.runtime import kvstore
+
+    store = kvstore.Store().start()
+    s0 = s1 = None
+    try:
+        s1 = Sampler(rank=1, jobid="jr", size=2, interval=3600,
+                     port=0, path="", rollup=True,
+                     client=kvstore.Client(store.addr))
+        s1.sample()
+        s0 = Sampler(rank=0, jobid="jr", size=2, interval=3600,
+                     port=0, path="", rollup=True,
+                     client=kvstore.Client(store.addr))
+        text = s0.sample()
+        parsed = openmetrics.parse(text)
+        job_lbl = next(l for l in parsed["telemetry_samples"]
+                       if 'scope="job"' in l)
+        assert 'ranks="2"' in job_lbl
+        rank_lbl = '{job="jr",rank="0"}'
+        # rollup sums the counter across both ranks' snapshots
+        assert parsed["telemetry_samples"][job_lbl] \
+            >= parsed["telemetry_samples"][rank_lbl] + 1
+        assert text.rstrip().endswith("# EOF")
+    finally:
+        for s in (s0, s1):
+            if s is not None:
+                s.stop()
+        store.stop()
+
+
+# -- kvstore heartbeat payload plane -------------------------------------
+
+def test_kvstore_heartbeat_payload_roundtrip():
+    from ompi_tpu.runtime import kvstore
+
+    store = kvstore.Store().start()
+    try:
+        c = kvstore.Client(store.addr)
+        c.heartbeat(0)                       # legacy 2-tuple: no payload
+        assert c.telemetry() == {}
+        c.heartbeat(1, {"seq": 9, "done": 8, "inflight": 1})
+        c.heartbeat(0, {"seq": 11, "done": 11, "inflight": 0})
+        telem = c.telemetry()
+        assert telem[0]["seq"] == 11 and telem[1]["seq"] == 9
+        c.heartbeat(0)                       # payload-less hb keeps it
+        assert c.telemetry()[0]["seq"] == 11
+        c.close()
+    finally:
+        store.stop()
+
+
+# -- watchdog ------------------------------------------------------------
+
+class _FakeClient:
+    """Injected store client: records heartbeats, serves peer seqs."""
+
+    def __init__(self, peers=None):
+        self.peers = dict(peers or {})
+        self.beats = []
+
+    def heartbeat(self, rank, payload=None):
+        self.beats.append((rank, payload))
+
+    def telemetry(self):
+        return dict(self.peers)
+
+    def close(self):
+        pass
+
+
+def _stuck_watchdog(tmp_path, peers, dead, world=range(2), **kw):
+    """Rank 0 with collective seq 2 in flight, timeout 0 so the very
+    first sweep evaluates the stuck branch."""
+    fl = flight.FlightRecorder()
+    fl.exit(fl.enter("warmup"))
+    fl.enter("allreduce_dev", comm_cid=3, nbytes=256)
+    client = _FakeClient(peers)
+    wd = Watchdog(rank=0, jobid="jw", world=world, client=client,
+                  flight_rec=fl, dead_fn=lambda: dead,
+                  period=3600, timeout=0.0, action="dump",
+                  dump_dir=str(tmp_path), **kw)
+    return wd, fl, client
+
+
+def test_watchdog_names_straggler_and_dumps(tmp_path, no_flight):
+    dead = {}
+    wd, fl, client = _stuck_watchdog(
+        tmp_path, peers={1: {"seq": 1, "done": 1, "inflight": 0}},
+        dead=dead)
+    v = wd.sweep()
+    assert v["stragglers"] == [1]
+    assert v["op"] == "allreduce_dev" and v["seq"] == 2
+    assert v["peer_seqs"] == {0: 2, 1: 1}
+    # every sweep publishes this rank's seq on the heartbeat plane
+    assert client.beats == [(0, {"seq": 2, "done": 1, "inflight": 1})]
+    path = wd._dumped[2]
+    doc = json.load(open(path))
+    assert doc["schema"] == watchdog.DUMP_SCHEMA
+    assert doc["verdict"]["stragglers"] == [1]
+    assert doc["inflight"][0]["op"] == "allreduce_dev"
+    assert "telemetry_watchdog_sweeps" in doc["pvars"]
+    # dump-on-hang fires exactly once per stuck seq
+    wd.sweep()
+    assert list(wd._dumped) == [2]
+    # the op completing clears the verdict
+    fl.exit(2)
+    assert wd.sweep() is None and wd.verdict is None
+
+
+def test_watchdog_healthy_below_timeout(tmp_path, no_flight):
+    wd, fl, _ = _stuck_watchdog(tmp_path, peers={}, dead={})
+    wd.timeout = 3600.0
+    assert wd.sweep() is None
+    assert wd._dumped == {}
+
+
+def test_dead_rank_resolves_hang_verdict_naming_it(tmp_path,
+                                                   no_flight):
+    """Satellite contract: the moment the ft detector declares a
+    straggler dead, the hang verdict naming it resolves — the failure
+    detector owns that diagnosis."""
+    dead = {}
+    wd, fl, _ = _stuck_watchdog(
+        tmp_path, peers={1: {"seq": 1, "done": 1, "inflight": 0}},
+        dead=dead)
+    assert wd.sweep()["stragglers"] == [1]
+    dead[1] = "heartbeat timeout"
+    assert wd.sweep() is None
+    assert wd.verdict is None
+
+
+def test_watchdog_dead_only_gap_is_not_a_hang(tmp_path, no_flight):
+    """When the only ranks missing from the collective are already
+    declared dead, no hang verdict is raised at all."""
+    wd, fl, _ = _stuck_watchdog(
+        tmp_path, peers={1: {"seq": 1, "done": 1, "inflight": 0}},
+        dead={1: "killed"})
+    assert wd.sweep() is None and wd.verdict is None
+    assert wd._dumped == {}
+
+
+def test_watchdog_abort_action_reaches_rte(tmp_path, monkeypatch,
+                                           no_flight):
+    from ompi_tpu.runtime import rte
+
+    aborts = []
+    monkeypatch.setattr(rte, "abort",
+                        lambda reason, code=1: aborts.append(reason))
+    wd, fl, _ = _stuck_watchdog(
+        tmp_path, peers={1: {"seq": 1, "done": 1, "inflight": 0}},
+        dead={})
+    wd.action = "abort"
+    wd.sweep()
+    assert len(aborts) == 1 and "allreduce_dev" in aborts[0]
+
+
+# -- end to end: cvar enable + live collectives --------------------------
+
+def test_telemetry_enabled_two_ranks_end_to_end():
+    """cvar telemetry_enable brings up flight recorder + sampler +
+    watchdog at instance init; collectives register seqs; the seq
+    payload rides the heartbeat plane to the store."""
+    run_ranks("""
+        import time
+        from ompi_tpu import telemetry
+        from ompi_tpu.telemetry import flight
+
+        fl = flight.FLIGHT
+        assert fl is not None, "telemetry_enable should enable at init"
+        assert telemetry.get_sampler() is not None
+        assert telemetry.get_watchdog() is not None
+        before = fl.last_entered
+        comm.allreduce(rank)
+        comm.Barrier()
+        assert fl.last_entered > before
+        assert fl.hb_dict()["inflight"] == 0
+        text = telemetry.get_sampler().sample()
+        assert "ompi_tpu_telemetry_flight_ops_total" in text
+        comm.Barrier()
+    """, 2, mca={"telemetry_enable": "1",
+                 "telemetry_watchdog_period": "0.2"}, timeout=120)
