@@ -359,6 +359,66 @@ def _bench_overlap():
     }
 
 
+def _bench_zero():
+    """ZeRO cycle cost card (``--zero``), on the 1-device local
+    context (identity collectives — pure dispatch cost, same caveat
+    as _bench_dispatch): one fused reduce_scatter + allgather cycle
+    over 32 x 256 KB f32 gradients against the per-buffer allreduce
+    loop the sharded cycle replaces, launches per cycle from the
+    ``zero_*`` pvars (the ceil(total/bucket)+n_dtypes bound), and the
+    per-rank vs replicated optimizer state bytes (momentum SGD; the
+    per-rank number reads ≈ replicated/n on a real n-rank run)."""
+    import types
+
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu.coll import xla as cx
+    from ompi_tpu.core import pvar
+    from ompi_tpu.zero import layout as zl
+
+    ctx = cx._Ctx.local()
+    comm = types.SimpleNamespace(_coll_xla_ctx=ctx, rank=0, size=1)
+    bufs = [jnp.full((65536,), float(i), jnp.float32)  # 32 x 256 KB
+            for i in range(32)]
+
+    rs = cx._reduce_scatter_multi_prep(comm, bufs)
+    ag = cx._allgather_multi_prep(comm, rs())  # compile + warm
+    jax.block_until_ready(jax.tree.leaves(ag()))
+    perbuf = [cx._allreduce_prep(comm, b) for b in bufs]
+    jax.block_until_ready([p() for p in perbuf])
+
+    reps = 20
+    s = pvar.session()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        rs()
+        out = ag()
+    jax.block_until_ready(jax.tree.leaves(out))
+    cycle_ms = (time.perf_counter() - t0) / reps * 1e3
+    rs_launches = s.read("zero_rs_launches") / reps
+    ag_launches = s.read("zero_ag_launches") / reps
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        outs = [p() for p in perbuf]
+    jax.block_until_ready(outs)
+    perbuf_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    st = zl.ShardedState.from_full(comm, bufs)
+    return {
+        "zero_cycle_32x256k_ms": round(cycle_ms, 3),
+        "perbuf_allreduce_32x256k_ms": round(perbuf_ms, 3),
+        "fused_cycle_speedup": round(perbuf_ms / cycle_ms, 2),
+        "rs_launches_per_cycle": rs_launches,
+        "ag_launches_per_cycle": ag_launches,
+        # params + momentum slot, this rank vs a replicated optimizer
+        "state_bytes_per_rank": 2 * st.shard_bytes,
+        "state_bytes_replicated": 2 * st.total_bytes,
+        "pad_bytes": st.plan.pad_bytes,
+    }
+
+
 def _bench_telemetry():
     """Overhead of being watched (the telemetry plane's cost card):
     flight-recorder enter/exit ns per op, one sampler cycle (pvar
@@ -409,6 +469,9 @@ _EXTRA_BASELINE_KEYS = (
     ("overlap", "partitioned_32x256k_ms", False),
     ("overlap", "overlap_flushes_per_cycle", True),
     ("overlap", "pready_overhead_us_per_leaf", False),
+    ("zero", "zero_cycle_32x256k_ms", False),
+    ("zero", "fused_cycle_speedup", True),
+    ("zero", "rs_launches_per_cycle", False),
 )
 
 
@@ -508,6 +571,13 @@ def main() -> None:
     except Exception as e:
         _phase(f"telemetry microbench skipped: {e!r}")
         telemetry = None
+    zero = None
+    if "--zero" in sys.argv:
+        try:
+            zero = _bench_zero()
+            _phase("zero microbench done")
+        except Exception as e:
+            _phase(f"zero microbench skipped: {e!r}")
     if trace_path is not None:
         from ompi_tpu.trace import export as trace_export
         from ompi_tpu.trace import recorder as trace_rec
@@ -543,7 +613,8 @@ def main() -> None:
             vs = tflops / float(base["value"])
             vs_extra = _vs_extras(base.get("extra"),
                                   {"dispatch": dispatch,
-                                   "overlap": overlap})
+                                   "overlap": overlap,
+                                   "zero": zero})
         except Exception:
             pass
 
@@ -575,6 +646,7 @@ def main() -> None:
             "dispatch": dispatch,
             "overlap": overlap,
             "telemetry": telemetry,
+            "zero": zero,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),
             # wall attribution: metric quality depends only on

@@ -55,6 +55,12 @@ SLOTS = (
     # MPI-4 partitioned fused allreduce (part/ subsystem device
     # payoff): per-leaf Pready, bucket flushes on last-member ready
     "pallreduce_init_dev",
+    # zero/ sharded data parallel: bucketed reduce_scatter returning
+    # per-rank ShardedState shards, the allgather that rebuilds the
+    # pytree, their persistent forms, and the partitioned RS
+    "reduce_scatter_multi_dev", "reduce_scatter_multi_init_dev",
+    "allgather_multi_dev", "allgather_multi_init_dev",
+    "preduce_scatter_init_dev",
 )
 
 

@@ -67,6 +67,51 @@ def allreduce_multi_dev(comm, bufs, op=op_mod.SUM, deterministic=None):
         lambda b: allreduce_dev(comm, b, op, deterministic), bufs)
 
 
+def reduce_scatter_multi_dev(comm, bufs, op=op_mod.SUM,
+                             deterministic=None):
+    """Staged fallthrough for the zero/ bucketed reduce_scatter:
+    D2H every leaf, run the host bucket cycle (one host allreduce per
+    bucket + local slice), H2D the shards back next to the input
+    leaves. Serves non-traceable ops and plane-off comms; the
+    single-launch win is device-path only."""
+    import jax
+
+    from ompi_tpu.zero import layout as _zl
+
+    pvar.record("coll_accelerator_staged")
+    leaves = jax.tree.leaves(bufs)
+    hosts = jax.tree.map(lambda b: _stage_in(b), bufs)
+    st = _zl.host_reduce_scatter_multi(comm, hosts, op)
+    if leaves and not isinstance(leaves[0], np.ndarray):
+        st.shards = [_stage_out(s, leaves[0]) for s in st.shards]
+    return st
+
+
+def allgather_multi_dev(comm, state):
+    """Staged fallthrough for the zero/ bucketed allgather: host
+    object-channel allgather per bucket shard, reassemble, H2D the
+    rebuilt leaves when the shards were device arrays."""
+    from ompi_tpu.zero import layout as _zl
+
+    pvar.record("coll_accelerator_staged")
+    dev_template = None
+    hosts = []
+    for s in state.shards:
+        if isinstance(s, np.ndarray):
+            hosts.append(s)
+        else:
+            dev_template = s
+            hosts.append(_stage_in(s))
+    hstate = _zl.ShardedState(state.plan, state.metas, state.treedef,
+                              hosts, state.rank, state.n)
+    out = _zl.host_allgather_multi(comm, hstate)
+    if dev_template is None:
+        return out
+    import jax
+
+    return jax.tree.map(lambda h: _stage_out(h, dev_template), out)
+
+
 def bcast_dev(comm, buf, root=0):
     pvar.record("coll_accelerator_staged")
     host = _stage_in(buf, writable=True)
@@ -331,6 +376,16 @@ def pallreduce_init_dev(comm, bufs, op=op_mod.SUM, deterministic=None):
                                              deterministic)
 
 
+def preduce_scatter_init_dev(comm, bufs, op=op_mod.SUM,
+                             deterministic=None):
+    """Partitioned zero/ reduce_scatter over the staged path — same
+    deferred-to-wait design as pallreduce_init_dev."""
+    from ompi_tpu.coll import xla as _xla
+
+    return _xla._TrivialPartitionedReduceScatter(comm, bufs, op,
+                                                 deterministic)
+
+
 @framework.register
 class CollAccelerator(CollModule):
     NAME = "accelerator"
@@ -362,6 +417,8 @@ class CollAccelerator(CollModule):
             "alltoallv_dev": alltoallv_dev,
             "scatterv_dev": scatterv_dev,
             "reduce_scatter_dev": reduce_scatter_dev,
+            "reduce_scatter_multi_dev": reduce_scatter_multi_dev,
+            "allgather_multi_dev": allgather_multi_dev,
             "ireduce_scatter_dev": _istaged(reduce_scatter_dev),
             "ibarrier_dev": ibarrier_dev,
             "iallreduce_dev": _istaged(allreduce_dev),
@@ -382,6 +439,10 @@ class CollAccelerator(CollModule):
             "allreduce_multi_dev": allreduce_multi_dev,
             "allreduce_multi_init_dev": _pstaged(allreduce_multi_dev),
             "pallreduce_init_dev": pallreduce_init_dev,
+            "reduce_scatter_multi_init_dev":
+                _pstaged(reduce_scatter_multi_dev),
+            "allgather_multi_init_dev": _pstaged(allgather_multi_dev),
+            "preduce_scatter_init_dev": preduce_scatter_init_dev,
             "allreduce_init_dev": _pstaged(allreduce_dev),
             "bcast_init_dev": _pstaged(bcast_dev),
             "allgather_init_dev": _pstaged(allgather_dev),

@@ -103,14 +103,16 @@ _a2av_cache_var = cvar.register(
 _bucket_var = cvar.register(
     "coll_xla_bucket_bytes", 4 << 20, int,
     help="target flat-bucket size for the fused (bucketed) device "
-         "allreduce (allreduce_multi_dev / Allreduce_multi): same-"
-         "dtype buffers coalesce into flat buckets that close once "
-         "they reach this many bytes, and each bucket runs ONE "
-         "compiled concat+reduce+split program (the NCCL/Horovod/DDP "
-         "gradient-bucketing analog). The close-at-threshold rule "
-         "bounds compiled launches to ceil(total_bytes/bucket_bytes) "
-         "+ n_dtypes. 0 fuses each dtype into a single bucket "
-         "regardless of size.", level=5)
+         "collectives — allreduce_multi_dev / Allreduce_multi AND the "
+         "zero/ scatter-gather pair (Reduce_scatter_multi / "
+         "Allgather_multi, whose ZeroPlan pads each bucket to a "
+         "multiple of the comm size): same-dtype buffers coalesce "
+         "into flat buckets that close once they reach this many "
+         "bytes, and each bucket runs ONE compiled program (the "
+         "NCCL/Horovod/DDP gradient-bucketing analog). The "
+         "close-at-threshold rule bounds compiled launches to "
+         "ceil(total_bytes/bucket_bytes) + n_dtypes. 0 fuses each "
+         "dtype into a single bucket regardless of size.", level=5)
 
 _cache_max_var = cvar.register(
     "coll_xla_cache_max", 0, int,
@@ -1057,11 +1059,17 @@ def reduce_scatter_dev(comm, sendbuf, counts, op=op_mod.SUM,
     if not _op_ok(op):
         return staging.reduce_scatter_dev(comm, sendbuf, counts, op)
     counts = [int(c) for c in counts]
+    # erroneous calls raise MPIError so the comm's errhandler sees
+    # them (the MPI-4 convention part/host.py documents — a bare
+    # ValueError would bypass _with_errhandler dispatch)
     if len(counts) != comm.size:
-        raise ValueError(f"reduce_scatter: {len(counts)} counts for "
-                         f"{comm.size} ranks")
+        raise errors.MPIError(
+            errors.ERR_COUNT,
+            f"reduce_scatter: {len(counts)} counts for "
+            f"{comm.size} ranks")
     if sum(counts) != sendbuf.shape[0]:
-        raise ValueError(
+        raise errors.MPIError(
+            errors.ERR_COUNT,
             f"reduce_scatter: counts sum to {sum(counts)} but sendbuf "
             f"dim0 is {sendbuf.shape[0]} (jax slicing would clamp "
             "silently)")
@@ -1490,6 +1498,264 @@ allreduce_multi_init_dev = _pprep(
 
 
 # ---------------------------------------------------------------------------
+# fused (bucketed) reduce_scatter / allgather — the zero/ sharded
+# data-parallel engine. Same _FusePlan dtype buckets, extended with
+# pad-to-comm-size (zero.layout.ZeroPlan) so each bucket lowers to ONE
+# tiled reduce_scatter/all_gather; plans + executables live in the
+# same _Ctx LRU caches as the fused allreduce.
+
+
+def _zero_plan(ctx, metas, treedef):
+    """Pad-and-shard bucket plan, cached per (signature, bucket size,
+    comm size). Op/determinism are NOT in the key: the layout is
+    geometry only, so one plan serves the RS and AG directions."""
+    from ompi_tpu.zero import layout as _zl
+
+    bb = int(_bucket_var.get())
+    return ctx.plan(("zero", metas, treedef, bb, ctx.n),
+                    lambda: _zl.ZeroPlan(metas, bb, ctx.n))
+
+
+def _zero_rs_fn(ctx, metas, idxs, pad: int, opn, det: Optional[str]):
+    """ONE compiled concat+pad+reduce_scatter program for a bucket.
+    Bit-identity: under 'linear' C.reduce_scatter folds in exact rank
+    order then slices — elementwise identical to the per-buffer
+    allreduce-linear path, and concatenation/zero-padding never
+    change an element's fold order. Keyed like the fused allreduce so
+    the partitioned path resolves to the SAME executable."""
+    from ompi_tpu.parallel import collectives as C
+
+    sig = tuple((metas[i][0], metas[i][1]) for i in idxs)
+
+    def build():
+        def body(args):
+            import jax.numpy as jnp
+
+            flat = (jnp.concatenate(
+                [a[0].reshape(-1) for a in args])
+                if len(args) > 1 else args[0][0].reshape(-1))
+            if pad:
+                flat = jnp.pad(flat, (0, pad))
+            return C.reduce_scatter(flat, AXIS, opn, scatter_dim=0,
+                                    tiled=True, deterministic=det)
+
+        return ctx.smap(body, out_varying=True)
+
+    return ctx.compiled(("zero_rs", sig, pad, opn.name, det), build)
+
+
+def _zero_ag_fn(ctx, metas, idxs, elems: int, pad: int):
+    """ONE compiled all_gather+split program for a bucket: the local
+    shard gathers tiled in rank order (= the pack order), the pad
+    tail drops, and the static split restores member leaf shapes."""
+    from ompi_tpu.parallel import collectives as C
+
+    sig = tuple((metas[i][0], metas[i][1]) for i in idxs)
+    shapes = tuple(metas[i][0] for i in idxs)
+
+    def build():
+        def body(a):
+            full = C.allgather(a[0], AXIS, tiled=True, gather_dim=0)
+            outs, off = [], 0
+            for shape in shapes:
+                k = 1
+                for s in shape:
+                    k *= int(s)
+                outs.append(full[off:off + k].reshape(shape))
+                off += k
+            return tuple(outs)
+
+        return ctx.smap(body, out_varying=False)
+
+    return ctx.compiled(("zero_ag", sig, elems, pad), build)
+
+
+def _zero_empty_state(comm, treedef):
+    from ompi_tpu.zero import layout as _zl
+
+    plan = _zl.ZeroPlan((), int(_bucket_var.get()), comm.size)
+    return _zl.ShardedState(plan, (), treedef, [], comm.rank,
+                            comm.size)
+
+
+def _reduce_scatter_multi_prep(comm, bufs, op=op_mod.SUM,
+                               deterministic: Optional[str] = None):
+    """Plan + compile + bind the bucketed reduce_scatter NOW; the
+    returned zero-arg launcher runs one cached dispatch per bucket
+    and yields the rank's ShardedState (the ZeRO gradient shards)."""
+    import jax
+
+    from ompi_tpu.zero import layout as _zl
+
+    leaves, treedef = jax.tree.flatten(bufs)
+    if not leaves:
+        return lambda: _zero_empty_state(comm, treedef)
+    opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
+    det = _det(deterministic)
+    ctx = _ctx(comm)
+    metas = _fuse_metas(leaves)
+    plan = _zero_plan(ctx, metas, treedef)
+    launches = []
+    for b, idxs in enumerate(plan.buckets):
+        fn = _zero_rs_fn(ctx, metas, idxs,
+                         plan.padded[b] - plan.elems[b], opn, det)
+        gs = tuple(ctx.to_global(leaves[i]) for i in idxs)
+        launches.append((fn, gs))
+
+    def launch():
+        shards = []
+        for fn, gs in launches:
+            shards.append(ctx.my_shard(ctx.launch(fn, gs)))
+            pvar.record("zero_rs_launches")
+        pvar.record("zero_fused_bytes", plan.nbytes)
+        pvar.record("zero_pad_bytes", plan.pad_bytes)
+        return _zl.ShardedState(plan, metas, treedef, shards,
+                                comm.rank, ctx.n)
+
+    return launch
+
+
+def reduce_scatter_multi_dev(comm, bufs, op=op_mod.SUM,
+                             deterministic: Optional[str] = None):
+    """Bucketed reduce_scatter over a pytree of device buffers (the
+    ZeRO gradient-sharding step): dtype-segregated flat buckets padded
+    to a multiple of comm size (zero.layout.ZeroPlan), ONE compiled
+    tiled reduce_scatter per bucket, returning this rank's
+    ShardedState — full reduced gradients are never materialized.
+    'linear' determinism is bit-identical to the per-buffer
+    allreduce+slice path."""
+    if not _op_ok(op):
+        return staging.reduce_scatter_multi_dev(
+            comm, bufs, op, deterministic=deterministic)
+    pvar.record("coll_xla_device")
+    import jax
+
+    if comm.size == 1:
+        # reducing over one rank is the identity: the shard is a
+        # local pack+slice, no plane/collective needed (the same
+        # trivial fast path the other size-1 device slots take)
+        from ompi_tpu.zero import layout as _zl
+
+        return _zl.ShardedState.from_full(comm, bufs)
+    fl = _flight.FLIGHT
+    if fl is None:
+        return _reduce_scatter_multi_prep(comm, bufs, op,
+                                          deterministic)()
+    tok = fl.enter("reduce_scatter_multi_dev",
+                   getattr(comm, "cid", -1),
+                   sum(getattr(b, "nbytes", 0)
+                       for b in jax.tree.leaves(bufs)))
+    try:
+        return _reduce_scatter_multi_prep(comm, bufs, op,
+                                          deterministic)()
+    finally:
+        fl.exit(tok)
+
+
+def _zero_state_check(comm, state) -> None:
+    """MPI erroneous-call validation for the allgather direction (the
+    part/host.py MPIError convention, applied to the *_multi entry
+    points from day one)."""
+    from ompi_tpu.zero import layout as _zl
+
+    if not isinstance(state, _zl.ShardedState):
+        raise errors.MPIError(
+            errors.ERR_ARG,
+            f"Allgather_multi: operand is {type(state).__name__}, "
+            "expected a ShardedState (the Reduce_scatter_multi / "
+            "ShardedState.from_full result)")
+    if state.n != comm.size:
+        raise errors.MPIError(
+            errors.ERR_COUNT,
+            f"Allgather_multi: state sharded {state.n} ways on a "
+            f"size-{comm.size} communicator")
+    if len(state.shards) != len(state.plan.buckets):
+        raise errors.MPIError(
+            errors.ERR_COUNT,
+            f"Allgather_multi: {len(state.shards)} shards for "
+            f"{len(state.plan.buckets)} plan buckets")
+    for b, s in enumerate(state.shards):
+        k = state.plan.shard_elems[b]
+        if tuple(s.shape) != (k,) \
+                or str(s.dtype) != state.plan.dtypes[b]:
+            raise errors.MPIError(
+                errors.ERR_COUNT,
+                f"Allgather_multi: bucket {b} shard is "
+                f"{tuple(s.shape)}/{s.dtype}, plan expects "
+                f"({k},)/{state.plan.dtypes[b]} (shard-wise updates "
+                "must preserve shape and dtype)")
+
+
+def _allgather_multi_prep(comm, state):
+    """Compile + bind the bucketed allgather NOW (operand = the
+    state's current shards; like every persistent device collective
+    the binding is per-init — jax arrays are immutable)."""
+    ctx = _ctx(comm)
+    _zero_state_check(comm, state)
+    plan, metas = state.plan, state.metas
+    launches = []
+    for b, idxs in enumerate(plan.buckets):
+        fn = _zero_ag_fn(ctx, metas, idxs, plan.elems[b],
+                         plan.padded[b] - plan.elems[b])
+        launches.append((fn, ctx.to_global(state.shards[b]), idxs))
+
+    import jax
+
+    n_leaves = sum(len(idxs) for idxs in plan.buckets)
+
+    def launch():
+        outs = [None] * n_leaves
+        for fn, g, idxs in launches:
+            res = ctx.launch(fn, g)
+            for j, i in enumerate(idxs):
+                outs[i] = ctx.my_shard(res[j])
+            pvar.record("zero_ag_launches")
+        pvar.record("zero_fused_bytes", plan.nbytes)
+        return jax.tree.unflatten(state.treedef, outs)
+
+    return launch
+
+
+def allgather_multi_dev(comm, state):
+    """Bucketed allgather of a ShardedState back to the full pytree
+    (the ZeRO parameter-rebuild step): ONE compiled tiled all_gather
+    per bucket, rank-order concat (= the pack order), pad tail
+    dropped, leaf shapes restored."""
+    pvar.record("coll_xla_device")
+    _zero_state_check(comm, state)
+    if not state.shards:
+        import jax
+
+        return jax.tree.unflatten(state.treedef, [])
+    if comm.size == 1:
+        # n=1 shards ARE the full padded buckets: unpack locally
+        return state.unpack(state.shards)
+    fl = _flight.FLIGHT
+    if fl is None:
+        return _allgather_multi_prep(comm, state)()
+    tok = fl.enter("allgather_multi_dev", getattr(comm, "cid", -1),
+                   state.plan.nbytes)
+    try:
+        return _allgather_multi_prep(comm, state)()
+    finally:
+        fl.exit(tok)
+
+
+def _multi_state_empty(comm, state, *a, **k) -> bool:
+    return not getattr(state, "shards", None)
+
+
+reduce_scatter_multi_init_dev = _pprep(
+    _reduce_scatter_multi_prep, reduce_scatter_multi_dev,
+    "reduce_scatter_multi_init_dev",
+    gates=(_gate_op, _gate_size1, _multi_empty))
+allgather_multi_init_dev = _pprep(
+    _allgather_multi_prep, allgather_multi_dev,
+    "allgather_multi_init_dev",
+    gates=(_gate_size1, _multi_state_empty))
+
+
+# ---------------------------------------------------------------------------
 # partitioned fused allreduce (MPI-4 part/ subsystem, device payoff)
 
 
@@ -1827,6 +2093,328 @@ def pallreduce_init_dev(comm, bufs, op=op_mod.SUM,
                                        opn, _det(deterministic))
 
 
+class PartitionedReduceScatterRequest:
+    """MPI-4 partitioned fused reduce_scatter (Preduce_scatter_init —
+    the backward-overlap analog of Pallreduce_init for the ZeRO
+    gradient-sharding step).
+
+    Partitions are pytree leaves in flatten order. Init resolves the
+    ZeroPlan and each bucket's ONE compiled concat+pad+reduce_scatter
+    program through the SAME _Ctx caches and keys as
+    Reduce_scatter_multi (shared executables -> bit-identical under
+    'linear', zero recompiles after init). start() opens a cycle;
+    Pready(i[, value]) marks leaf i ready, and the moment a bucket's
+    LAST member is ready its reduce_scatter dispatches — early
+    buckets' scatter traffic overlaps production of later gradients
+    (``zero_overlap_flushes`` counts the buckets that beat the final
+    Pready). wait() drains the tail; ``.array`` is the cycle's
+    ShardedState."""
+
+    def __init__(self, ctx, comm, leaves, treedef, opn,
+                 det: Optional[str]) -> None:
+        from ompi_tpu.pml import request as rq
+
+        self.id = next(rq._req_ids)
+        self.status = rq.Status()
+        self.persistent = True
+        self._ctx = ctx
+        self._comm = comm
+        self._treedef = treedef
+        self._n = len(leaves)
+        metas = _fuse_metas(leaves)
+        plan = _zero_plan(ctx, metas, treedef)
+        self._plan = plan
+        self.nbytes = plan.nbytes
+        self._metas = metas
+        self._buckets = tuple(
+            (_zero_rs_fn(ctx, metas, idxs,
+                         plan.padded[b] - plan.elems[b], opn, det),
+             idxs)
+            for b, idxs in enumerate(plan.buckets))
+        self._leaf_bucket = {i: b
+                             for b, (_fn, idxs)
+                             in enumerate(self._buckets)
+                             for i in idxs}
+        self._bound = [ctx.to_global(l) for l in leaves]
+        self._ready = None  # None = inactive
+        self._arr = None
+
+    @property
+    def active(self) -> bool:
+        return self._ready is not None
+
+    @property
+    def array(self):
+        """The ShardedState of the last completed cycle."""
+        return self._arr
+
+    def start(self) -> None:
+        if self.active:
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                "Preduce_scatter start: previous cycle still active — "
+                "wait() it to completion first (starting an active "
+                "request is erroneous)")
+        self._ready = [False] * self._n
+        self._n_ready = 0
+        self._pending = [len(idxs) for _fn, idxs in self._buckets]
+        self._results = [None] * len(self._buckets)
+        fl = _flight.FLIGHT
+        self._fl_tok = None if fl is None else fl.enter(
+            "preduce_scatter_cycle", getattr(self._comm, "cid", -1),
+            self.nbytes)
+
+    def Pready(self, idx: int, value=None) -> None:
+        if self._ready is None:
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                f"Pready({idx}): request inactive — call start() "
+                "before marking partitions ready")
+        if self._ready[idx]:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                f"Pready({idx}): partition already marked ready "
+                "this cycle (double-Pready is erroneous)")
+        if value is not None:
+            shape, dtype, _nb = self._metas[idx]
+            if tuple(value.shape) != shape or str(value.dtype) != dtype:
+                raise errors.MPIError(
+                    errors.ERR_COUNT,
+                    f"Pready({idx}): value {tuple(value.shape)}/"
+                    f"{value.dtype} does not match the bound template "
+                    f"leaf {shape}/{dtype} (compiled programs are "
+                    "shape-specialized; re-init for a new signature)")
+            self._bound[idx] = self._ctx.to_global(value)
+        self._ready[idx] = True
+        self._n_ready += 1
+        pvar.record("part_pready")
+        rec = _trace.RECORDER
+        if rec is not None:
+            rec.instant("pready", "zero", {"partition": idx})
+        b = self._leaf_bucket[idx]
+        self._pending[b] -= 1
+        if self._pending[b] == 0:
+            self._flush(b, idx)
+
+    def Pready_range(self, lo: int, hi: int) -> None:
+        for i in range(lo, hi + 1):
+            self.Pready(i)
+
+    def Pready_list(self, idxs) -> None:
+        for i in idxs:
+            self.Pready(i)
+
+    def _flush(self, b: int, trigger: Optional[int] = None) -> None:
+        fn, idxs = self._buckets[b]
+        overlap = self._n_ready < self._n
+        rec = _trace.RECORDER
+        if rec is None:
+            self._results[b] = self._ctx.launch(
+                fn, tuple(self._bound[i] for i in idxs))
+        else:
+            t0 = _trace.now()
+            self._results[b] = self._ctx.launch(
+                fn, tuple(self._bound[i] for i in idxs))
+            t1 = _trace.now()
+            nb = sum(self._metas[i][2] for i in idxs)
+            rec.record("zero_bucket_flush", "zero", t0, t1,
+                       {"bucket": b, "trigger_partition": trigger,
+                        "overlap": overlap, "nbytes": nb})
+            _trace.hist("zero_bucket_flush", nb, t1 - t0)
+        pvar.record("zero_rs_launches")
+        if overlap:
+            pvar.record("zero_overlap_flushes")
+
+    @property
+    def completed(self) -> bool:
+        if self._ready is None:
+            return True
+        if self._n_ready < self._n:
+            return False
+        import jax
+
+        try:
+            return all(bool(a.is_ready())
+                       for r in self._results
+                       for a in jax.tree.leaves(r))
+        except AttributeError:  # backend without is_ready
+            jax.block_until_ready(self._results)
+            return True
+
+    def test(self) -> bool:
+        return self.completed
+
+    def _finalize(self) -> None:
+        """Close the cycle: take this rank's shard of each bucket
+        result, block, publish the ShardedState, go inactive."""
+        import jax
+
+        from ompi_tpu.zero import layout as _zl
+
+        shards = [self._ctx.my_shard(self._results[b])
+                  for b in range(len(self._buckets))]
+        jax.block_until_ready(shards)
+        pvar.record("zero_fused_bytes", self.nbytes)
+        pvar.record("zero_pad_bytes", self._plan.pad_bytes)
+        self._arr = _zl.ShardedState(
+            self._plan, self._metas, self._treedef, shards,
+            self._comm.rank, self._ctx.n)
+        self._ready = None
+        tok, self._fl_tok = self._fl_tok, None
+        if tok is not None:
+            fl = _flight.FLIGHT
+            if fl is not None:
+                fl.exit(tok)
+
+    def wait(self, timeout=None):
+        if self._ready is None:
+            return self.status  # inactive: immediately complete
+        if self._n_ready < self._n:
+            missing = [i for i, r in enumerate(self._ready) if not r]
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                f"Preduce_scatter wait: partitions {missing} never "
+                "marked ready — the bucket collective cannot launch "
+                "and the wait would deadlock every rank")
+        self._finalize()
+        return self.status
+
+    def retrieve_status(self):
+        if self._ready is not None and self._n_ready == self._n:
+            self._finalize()
+        return self.status
+
+    def cancel(self) -> None:  # dispatched programs not cancelable
+        pass
+
+    def free(self) -> None:
+        pass
+
+
+class _TrivialPartitionedReduceScatter:
+    """Degenerate Preduce_scatter handle for the gated cases
+    (non-traceable op, empty pytree): identical Pready/start/wait
+    bookkeeping and errors, the scatter itself deferred to wait()
+    through the comm's reduce_scatter_multi slot. Correct, no
+    overlap."""
+
+    def __init__(self, comm, bufs, op, deterministic) -> None:
+        import jax
+
+        from ompi_tpu.pml import request as rq
+
+        self.id = next(rq._req_ids)
+        self.status = rq.Status()
+        self.persistent = True
+        self._comm = comm
+        self._op = op
+        self._det = deterministic
+        leaves, self._treedef = jax.tree.flatten(bufs)
+        self._bound = list(leaves)
+        self._n = len(leaves)
+        self._ready = None
+        self._arr = None
+
+    @property
+    def active(self) -> bool:
+        return self._ready is not None
+
+    @property
+    def array(self):
+        return self._arr
+
+    @property
+    def completed(self) -> bool:
+        return self._ready is None or self._n_ready == self._n
+
+    def start(self) -> None:
+        if self.active:
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                "Preduce_scatter start: previous cycle still active")
+        self._ready = [False] * self._n
+        self._n_ready = 0
+
+    def Pready(self, idx: int, value=None) -> None:
+        if self._ready is None:
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                f"Pready({idx}): request inactive — call start() "
+                "before marking partitions ready")
+        if self._ready[idx]:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                f"Pready({idx}): partition already marked ready "
+                "this cycle (double-Pready is erroneous)")
+        if value is not None:
+            self._bound[idx] = value
+        self._ready[idx] = True
+        self._n_ready += 1
+        pvar.record("part_pready")
+
+    def Pready_range(self, lo: int, hi: int) -> None:
+        for i in range(lo, hi + 1):
+            self.Pready(i)
+
+    def Pready_list(self, idxs) -> None:
+        for i in idxs:
+            self.Pready(i)
+
+    def test(self) -> bool:
+        return self.completed
+
+    def _finalize(self) -> None:
+        import jax
+
+        tree = jax.tree.unflatten(self._treedef, self._bound)
+        self._arr = self._comm.coll.reduce_scatter_multi_dev(
+            self._comm, tree, self._op, deterministic=self._det)
+        self._ready = None
+
+    def wait(self, timeout=None):
+        if self._ready is None:
+            return self.status
+        if self._n_ready < self._n:
+            missing = [i for i, r in enumerate(self._ready) if not r]
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                f"Preduce_scatter wait: partitions {missing} never "
+                "marked ready")
+        self._finalize()
+        return self.status
+
+    def retrieve_status(self):
+        if self._ready is not None and self._n_ready == self._n:
+            self._finalize()
+        return self.status
+
+    def cancel(self) -> None:
+        pass
+
+    def free(self) -> None:
+        pass
+
+
+def preduce_scatter_init_dev(comm, bufs, op=op_mod.SUM,
+                             deterministic: Optional[str] = None):
+    """Partitioned fused reduce_scatter init (MPI-4 part/ on the
+    device plane, ZeRO direction): one partition per pytree leaf;
+    each bucket's single compiled reduce_scatter launches the moment
+    its last member leaf is Pready'd, overlapping gradient sharding
+    with the backward pass. Shares the ZeroPlan + executable caches
+    with reduce_scatter_multi_dev; wait() publishes the cycle's
+    ShardedState in ``.array``."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(bufs)
+    if not _op_ok(op) or comm.size == 1 or not leaves:
+        return _TrivialPartitionedReduceScatter(comm, bufs, op,
+                                                deterministic)
+    opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
+    return PartitionedReduceScatterRequest(
+        _ctx(comm), comm, leaves, treedef, opn, _det(deterministic))
+
+
 def _irequest(fn):
     """i-variant of a device slot: same dispatch, no block — the
     blocking slots already return un-awaited futures, so the i-form
@@ -1881,6 +2469,14 @@ class CollXla(CollModule):
             "allreduce_multi_init_dev": allreduce_multi_init_dev,
             # MPI-4 partitioned fused allreduce (part/ device payoff)
             "pallreduce_init_dev": pallreduce_init_dev,
+            # zero/ sharded data parallel: bucketed reduce_scatter/
+            # allgather (+ persistent forms + partitioned RS)
+            "reduce_scatter_multi_dev": reduce_scatter_multi_dev,
+            "reduce_scatter_multi_init_dev":
+                reduce_scatter_multi_init_dev,
+            "allgather_multi_dev": allgather_multi_dev,
+            "allgather_multi_init_dev": allgather_multi_init_dev,
+            "preduce_scatter_init_dev": preduce_scatter_init_dev,
             "reduce_dev": reduce_dev,
             "bcast_dev": bcast_dev,
             "allgather_dev": allgather_dev,

@@ -41,6 +41,14 @@ WELL_KNOWN = (
     # partitioned regression tests assert on these)
     "part_send_start", "part_recv_start", "part_pready",
     "part_parrived", "part_bucket_flushes", "part_overlap_flushes",
+    # zero/ (ZeRO sharded data parallel): fused reduce_scatter /
+    # allgather bucket launches (the launch bound the zero tests
+    # assert: ceil(total/bucket_bytes)+n_dtypes per direction per
+    # cycle), bytes moved through the fused cycle, pad waste from
+    # rounding buckets up to a multiple of comm size, and partitioned
+    # buckets dispatched before the cycle's final Pready
+    "zero_rs_launches", "zero_ag_launches", "zero_fused_bytes",
+    "zero_pad_bytes", "zero_overlap_flushes",
     "put", "get", "accumulate", "win_lock",
     "eager", "rndv", "rget",
     "time_progress_ns",

@@ -798,6 +798,94 @@ def _Pallreduce_init(self, bufs, op=op_mod.SUM,
                                          deterministic=deterministic)
 
 
+def _Reduce_scatter_multi(self, bufs, op=op_mod.SUM,
+                          deterministic=None):
+    """Fused (bucketed) reduce_scatter over a list/pytree of buffers
+    — the zero/ sharded-data-parallel gradient step. Leaves coalesce
+    into the same dtype-segregated buckets as Allreduce_multi, each
+    padded to a multiple of comm size so it lowers to ONE compiled
+    reduce_scatter; returns a zero.ShardedState holding this rank's
+    1-D shard per bucket ('linear' determinism stays bit-identical to
+    the per-buffer allreduce fold). Host lists/tuples run the bucket
+    cycle over the stacked host collectives."""
+    self.check_revoked()
+    self.check_failed()
+    if isinstance(bufs, (list, tuple)) and bufs \
+            and not _is_dev(bufs[0]):
+        from ompi_tpu.zero import layout as _zl
+
+        return _zl.host_reduce_scatter_multi(self, bufs, op)
+    return self.coll.reduce_scatter_multi_dev(
+        self, bufs, op, deterministic=deterministic)
+
+
+def _Reduce_scatter_multi_init(self, bufs, op=op_mod.SUM,
+                               deterministic=None) -> rq.Request:
+    """Persistent form of Reduce_scatter_multi: plan + compile + bind
+    at init, each Start()+Wait() is one cached launch per bucket;
+    req.array holds the cycle's ShardedState. Device buffers only."""
+    self.check_revoked()
+    self.check_failed()
+    if isinstance(bufs, (list, tuple)) and bufs \
+            and not _is_dev(bufs[0]):
+        raise TypeError(
+            "Reduce_scatter_multi_init: device buffers only (host "
+            "cycle: call Reduce_scatter_multi per step)")
+    return self.coll.reduce_scatter_multi_init_dev(
+        self, bufs, op, deterministic=deterministic)
+
+
+def _Allgather_multi(self, state):
+    """Rebuild the full pytree from a zero.ShardedState: ONE compiled
+    all_gather per bucket, concat in rank order (= the pack order),
+    pad dropped, leaf shapes restored. The parameter-refresh tail of
+    the ZeRO cycle. Host (numpy) shards ride the object channel."""
+    self.check_revoked()
+    self.check_failed()
+    shards = getattr(state, "shards", None)
+    if shards and isinstance(shards[0], np.ndarray):
+        from ompi_tpu.zero import layout as _zl
+
+        return _zl.host_allgather_multi(self, state)
+    return self.coll.allgather_multi_dev(self, state)
+
+
+def _Allgather_multi_init(self, state) -> rq.Request:
+    """Persistent form of Allgather_multi bound to the state object:
+    each Start()+Wait() re-gathers state's CURRENT shards (the
+    optimizer mutates them in place between cycles); req.array holds
+    the rebuilt pytree. Device shards only."""
+    self.check_revoked()
+    self.check_failed()
+    shards = getattr(state, "shards", None)
+    if shards and isinstance(shards[0], np.ndarray):
+        raise TypeError(
+            "Allgather_multi_init: device shards only (host cycle: "
+            "call Allgather_multi per step)")
+    return self.coll.allgather_multi_init_dev(self, state)
+
+
+def _Preduce_scatter_init(self, bufs, op=op_mod.SUM,
+                          deterministic=None) -> rq.Request:
+    """MPI-4 partitioned fused reduce_scatter — the overlapped form
+    of the ZeRO gradient step: one partition per pytree leaf,
+    Pready(i[, value]) hands leaf i over, and a bucket's single
+    compiled reduce_scatter launches the moment its LAST member leaf
+    is ready (zero_overlap_flushes counts buckets that beat the final
+    push); Wait() drains the tail, req.array holds the ShardedState.
+    Shares ZeroPlans and compiled programs with Reduce_scatter_multi
+    ('linear' stays bit-identical). Device buffers only."""
+    self.check_revoked()
+    self.check_failed()
+    if isinstance(bufs, (list, tuple)) and bufs \
+            and not _is_dev(bufs[0]):
+        raise TypeError(
+            "Preduce_scatter_init: device buffers only (host "
+            "partitioned transfers: use Psend_init/Precv_init)")
+    return self.coll.preduce_scatter_init_dev(
+        self, bufs, op, deterministic=deterministic)
+
+
 def _Gather(self, sendbuf, recvbuf=None, root: int = 0):
     self.check_revoked()
     self.check_failed()
@@ -1335,7 +1423,7 @@ _ERRHANDLED = (
     "Reduce", "Allreduce", "Gather", "Gatherv", "Scatter", "Scatterv",
     "Allgather", "Allgatherv", "Alltoall", "Alltoallv",
     "Reduce_scatter", "Reduce_scatter_block", "Scan", "Exscan",
-    "Allreduce_multi",
+    "Allreduce_multi", "Reduce_scatter_multi", "Allgather_multi",
 )
 
 _API = {
@@ -1356,6 +1444,11 @@ _API = {
     "Allreduce_multi": _Allreduce_multi,
     "Allreduce_multi_init": _Allreduce_multi_init,
     "Pallreduce_init": _Pallreduce_init,
+    "Reduce_scatter_multi": _Reduce_scatter_multi,
+    "Reduce_scatter_multi_init": _Reduce_scatter_multi_init,
+    "Allgather_multi": _Allgather_multi,
+    "Allgather_multi_init": _Allgather_multi_init,
+    "Preduce_scatter_init": _Preduce_scatter_init,
     "Gather": _Gather, "gather": _gather,
     "Gatherv": _Gatherv,
     "Scatter": _Scatter, "scatter": _scatter,

@@ -19,7 +19,9 @@ late ones. Three layers live under this name:
   gradient production (bound in :mod:`ompi_tpu.mpi`).
 - :mod:`ompi_tpu.part.overlap` — :class:`GradientSync`, the
   DDP/Horovod backward-hook-style wrapper over ``Pallreduce_init``
-  for training loops.
+  for training loops, and :class:`ZeroGradientSync`, the same surface
+  over ``Preduce_scatter_init`` yielding sharded gradients for the
+  zero/ optimizer cycle.
 
 ``ompi_tpu.pml.part`` remains as a compat shim over ``part.host``.
 """
@@ -29,4 +31,6 @@ from ompi_tpu.part.host import (  # noqa: F401
     MAX_PARTITIONS, MAX_TAG, PartitionedRecvRequest,
     PartitionedSendRequest,
 )
-from ompi_tpu.part.overlap import GradientSync  # noqa: F401
+from ompi_tpu.part.overlap import (  # noqa: F401
+    GradientSync, ZeroGradientSync,
+)

@@ -68,3 +68,25 @@ class GradientSync:
 
     def free(self) -> None:
         self._req.free()
+
+
+class ZeroGradientSync(GradientSync):
+    """The same push-as-produced surface over the zero/ sharded cycle:
+    bound to ``Comm.Preduce_scatter_init`` instead of
+    ``Pallreduce_init``, so ``finish()`` returns a
+    :class:`~ompi_tpu.zero.layout.ShardedState` — this rank's 1/n
+    gradient shards, ready for a sharded optimizer update (feed to
+    ``Comm.Allgather_multi`` after the update to rebuild params).
+    Buckets that dispatch before the final push count in the
+    ``zero_overlap_flushes`` pvar."""
+
+    def __init__(self, comm, template, op=op_mod.SUM,
+                 deterministic=None) -> None:
+        import jax
+
+        paths, _ = jax.tree_util.tree_flatten_with_path(template)
+        self._index = {jax.tree_util.keystr(p): i
+                       for i, (p, _leaf) in enumerate(paths)}
+        self.n_leaves = len(paths)
+        self._req = comm.Preduce_scatter_init(
+            template, op, deterministic=deterministic)

@@ -41,6 +41,7 @@ _api_handle: Optional[int] = None
 API_COLLECTIVES = (
     "Barrier", "barrier", "Bcast", "bcast", "Reduce", "reduce",
     "Allreduce", "allreduce", "Allreduce_multi",
+    "Reduce_scatter_multi", "Allgather_multi",
     "Gather", "gather", "Gatherv", "Scatter", "scatter", "Scatterv",
     "Allgather", "allgather", "Allgatherv",
     "Alltoall", "alltoall", "Alltoallv",

@@ -1,0 +1,20 @@
+"""ompi_tpu.zero — ZeRO-style sharded data parallel.
+
+Peer of :mod:`ompi_tpu.part` (MPI-4 partitioned) and
+:mod:`ompi_tpu.parallel` (in-program SPMD collectives): the subsystem
+that turns the fused gradient-bucket machinery into a memory-scaling
+story. A :class:`~ompi_tpu.zero.layout.ZeroPlan` pads each dtype
+bucket to a multiple of the comm size so it lowers to ONE
+``reduce_scatter``/``all_gather``; ``Comm.Reduce_scatter_multi`` /
+``Comm.Allgather_multi`` (coll/xla) run the cycle on device;
+:class:`~ompi_tpu.zero.optimizer.ZeroOptimizer` wraps it into the
+shard-grad -> local-update -> allgather-params training step with
+O(1/n) optimizer state per rank (ZeRO stages 1/2).
+"""
+
+from ompi_tpu.zero.layout import (  # noqa: F401
+    ShardedState, ZeroPlan, plan_for,
+)
+from ompi_tpu.zero.optimizer import (  # noqa: F401
+    ZeroOptimizer, ZeroShardedState,
+)

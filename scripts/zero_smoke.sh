@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# ZeRO smoke lane: 2-rank CPU run of examples/zero_optimizer.py.
+# The example itself asserts the subsystem's two contracts — per-rank
+# sharded optimizer state bytes ~= replicated/n, and at least one
+# bucket's reduce_scatter dispatched before the cycle's final Pready
+# (zero_overlap_flushes > 0) — so the lane only has to run it and
+# check the success line.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out=$(JAX_PLATFORMS=cpu python -m ompi_tpu.runtime.launcher -n 2 \
+  --timeout 120 \
+  --mca device_plane on \
+  --mca coll_xla_bucket_bytes 16384 \
+  examples/zero_optimizer.py)
+echo "$out"
+echo "$out" | grep -q "per-rank optimizer state" \
+  || { echo "zero smoke: missing summary line" >&2; exit 1; }
+echo "$out" | grep -Eq "[1-9][0-9]* buckets flushed before the final push" \
+  || { echo "zero smoke: no overlap flushes" >&2; exit 1; }
+echo "zero smoke OK"

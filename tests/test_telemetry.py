@@ -167,6 +167,62 @@ def test_openmetrics_gauge_override_and_aggregate():
     assert agg == {"allreduce": 8, "depth_hwm": 4}  # sum vs max
 
 
+def test_openmetrics_histogram_family_shape():
+    """trace_hist_* log2 bins render as ONE histogram family per op:
+    cumulative _bucket samples in ascending-le order (le = 2^l, the
+    bin's ns upper bound; l=0 zeros -> le=1), sz as a label, +Inf
+    closing each series, _count matching the total."""
+    snap = {
+        "trace_hist_allreduce_dev_sz10_lat0": 2,
+        "trace_hist_allreduce_dev_sz10_lat14": 7,
+        "trace_hist_allreduce_dev_sz10_lat15": 1,
+        "trace_hist_allreduce_dev_sz4_lat13": 4,
+        "allreduce": 5,
+    }
+    text = openmetrics.render(snap, {"rank": "0"})
+    fam = openmetrics.PREFIX + "trace_hist_allreduce_dev"
+    assert f"# TYPE {fam} histogram" in text
+    assert text.count(f"# TYPE {fam} ") == 1       # one family, not 4
+    # cumulative buckets, ascending le, within the sz=10 series
+    assert f'{fam}_bucket{{le="1",rank="0",sz="10"}} 2' in text
+    assert f'{fam}_bucket{{le="16384",rank="0",sz="10"}} 9' in text
+    assert f'{fam}_bucket{{le="32768",rank="0",sz="10"}} 10' in text
+    assert f'{fam}_bucket{{le="+Inf",rank="0",sz="10"}} 10' in text
+    assert f'{fam}_count{{rank="0",sz="10"}} 10' in text
+    assert f'{fam}_sum{{rank="0",sz="10"}}' in text
+    assert f'{fam}_bucket{{le="+Inf",rank="0",sz="4"}} 4' in text
+    # the non-hist counter is untouched by the folding
+    assert 'ompi_tpu_allreduce_total{rank="0"} 5' in text
+
+
+def test_openmetrics_histogram_parse_aggregate_roundtrip():
+    """parse() inverts the histogram rendering back to the EXACT
+    original bin counters (cumulative differencing, zero bins
+    dropped, _count/_sum skipped as derived); aggregate() of parsed
+    snaps then matches aggregate() of the originals."""
+    a = {
+        "trace_hist_allreduce_dev_sz10_lat0": 2,
+        "trace_hist_allreduce_dev_sz10_lat14": 7,
+        "trace_hist_bcast_sz0_lat12": 9,
+        "allreduce": 3, "telemetry_flight_ops_hwm": 5,
+    }
+    b = {
+        "trace_hist_allreduce_dev_sz10_lat14": 4,
+        "allreduce": 2, "telemetry_flight_ops_hwm": 1,
+    }
+    flat = {}
+    for snap, rank in ((a, "0"), (b, "1")):
+        parsed = openmetrics.parse(
+            openmetrics.render(snap, {"rank": rank}))
+        got = {k: v['{rank="%s"}' % rank] for k, v in parsed.items()}
+        assert got == snap, (got, snap)
+        flat[rank] = got
+    agg = openmetrics.aggregate([flat["0"], flat["1"]])
+    assert agg == openmetrics.aggregate([a, b])
+    assert agg["trace_hist_allreduce_dev_sz10_lat14"] == 11
+    assert agg["telemetry_flight_ops_hwm"] == 5    # hwm: max
+
+
 # -- sampler -------------------------------------------------------------
 
 def test_sampler_file_export_and_flight_gauges(tmp_path, no_flight):

@@ -1,0 +1,355 @@
+"""ZeRO sharded data parallel (zero/ + the coll/xla scatter-gather
+pair).
+
+The acceptance contract: Reduce_scatter_multi + Allgather_multi are
+BITWISE identical to the per-buffer allreduce path under
+deterministic='linear' (shared bucket fold by construction), each
+cycle launches exactly len(plan.buckets) compiled programs per
+direction — bounded by ceil(total/bucket_bytes) + n_dtypes — with
+zero recompiles after warmup, the partitioned form overlaps bucket
+dispatch with leaf production, erroneous calls raise MPIError with
+the MPI error classes (not bare ValueErrors), and the optimizer's
+per-rank state is total/n up to pad waste.
+"""
+
+import pytest
+
+from tests.harness import run_ranks
+
+MCA = {"device_plane": "on"}
+# small bucket target -> multiple buckets from small test tensors
+MCA_SMALL = {"device_plane": "on", "coll_xla_bucket_bytes": "2048"}
+
+
+def test_reduce_scatter_allgather_bit_identical_linear():
+    """Fused RS shards == per-buffer allreduce('linear') sliced by
+    the same plan, and AG(RS(x)) == allreduce(x) bitwise — across a
+    bucket split and mixed leaf shapes."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.zero import layout as zl
+    rng = np.random.default_rng(7)
+    vals = []
+    for s in [(57,), (8, 9), (300,), (130,), (3, 5, 7)]:
+        v = (rng.standard_normal(s)
+             * 10.0 ** rng.integers(-3, 4, s)).astype(np.float32)
+        vals.append(jnp.asarray(np.roll(v, rank)))
+    st = comm.Reduce_scatter_multi(vals, deterministic="linear")
+    full = comm.Allreduce_multi(vals, deterministic="linear")
+    ref = zl.ShardedState.from_full(comm, full, plan=st.plan)
+    for a, b in zip(st.shards, ref.shards):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    out = comm.Allgather_multi(st)
+    for o, f in zip(out, full):
+        np.testing.assert_array_equal(np.asarray(o), np.asarray(f))
+        assert o.dtype == f.dtype and o.shape == f.shape
+    """, 3, mca=MCA_SMALL)
+
+
+def test_launch_bound_and_zero_recompiles():
+    """Per cycle: exactly len(plan.buckets) launches per direction,
+    len(plan.buckets) <= ceil(total/bucket_bytes) + n_dtypes, pad
+    bytes recorded, and NO compile- or plan-cache misses after the
+    first cycle (shared executables are the bit-identity mechanism)."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    bufs = [jnp.ones((700,), jnp.float32) * rank,
+            jnp.ones((600,), jnp.float32),
+            jnp.arange(100, dtype=np.int32),
+            jnp.ones((11,), jnp.float32)]
+    st = comm.Reduce_scatter_multi(bufs)        # warm compile
+    comm.Allgather_multi(st)
+    n_buckets = len(st.plan.buckets)
+    total = sum(b.nbytes for b in bufs)
+    assert n_buckets <= -(-total // 2048) + 2   # 2 dtypes
+    s = pvar.session()
+    for _ in range(3):
+        st = comm.Reduce_scatter_multi(bufs)
+        comm.Allgather_multi(st)
+    assert s.read("zero_rs_launches") == 3 * n_buckets
+    assert s.read("zero_ag_launches") == 3 * n_buckets
+    assert s.read("coll_xla_cache_misses") == 0
+    assert s.read("coll_xla_plan_cache_misses") == 0
+    assert s.read("zero_fused_bytes") == 6 * st.plan.nbytes
+    # 700+600+11 f32 elems and 100 i32 elems both need padding to a
+    # multiple of 2 within their 2048-byte buckets
+    assert s.read("zero_pad_bytes") == 3 * st.plan.pad_bytes
+    assert st.plan.pad_bytes > 0
+    for k in st.plan.padded:
+        assert k % size == 0
+    """, 2, mca=MCA_SMALL)
+
+
+def test_persistent_inits_cycle():
+    """Reduce_scatter_multi_init / Allgather_multi_init: one cached
+    launch set per Start/Wait cycle, results match the blocking
+    forms bitwise."""
+    run_ranks("""
+    import jax.numpy as jnp
+    bufs = [jnp.arange(96, dtype=jnp.float32) * (rank + 1),
+            jnp.ones((40,), jnp.float32) * rank]
+    rs_req = comm.Reduce_scatter_multi_init(bufs,
+                                            deterministic="linear")
+    rs_req.start()
+    rs_req.wait()
+    st = rs_req.array
+    ref = comm.Reduce_scatter_multi(bufs, deterministic="linear")
+    for a, b in zip(st.shards, ref.shards):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ag_req = comm.Allgather_multi_init(st)
+    ag_req.start()
+    ag_req.wait()
+    full = comm.Allgather_multi(ref)
+    for o, f in zip(ag_req.array, full):
+        np.testing.assert_array_equal(np.asarray(o), np.asarray(f))
+    rs_req.free()
+    ag_req.free()
+    """, 2, mca=MCA)
+
+
+def test_preduce_scatter_overlap_and_bit_identity():
+    """Partitioned RS: leaves Pready'd out of order with fresh
+    per-cycle values; buckets flush before the final push
+    (zero_overlap_flushes); result bitwise == Reduce_scatter_multi."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    bufs = [jnp.arange(512, dtype=jnp.float32) * (rank + 1),
+            jnp.ones((600,), jnp.float32),
+            jnp.arange(100, dtype=np.int32) * rank]
+    req = comm.Preduce_scatter_init(bufs, deterministic="linear")
+    s = pvar.session()
+    req.start()
+    for i in (2, 0, 1):                     # out of order
+        req.Pready(i, bufs[i])
+    req.wait()
+    st = req.array
+    assert s.read("zero_overlap_flushes") >= 1
+    ref = comm.Reduce_scatter_multi(bufs, deterministic="linear")
+    for a, b in zip(st.shards, ref.shards):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # second cycle with rebound values tracks, not replays
+    fresh = [b * 2 for b in bufs]
+    req.start()
+    for i in (1, 2, 0):
+        req.Pready(i, fresh[i])
+    req.wait()
+    ref2 = comm.Reduce_scatter_multi(fresh, deterministic="linear")
+    for a, b in zip(req.array.shards, ref2.shards):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    req.free()
+    """, 2, mca=MCA_SMALL)
+
+
+def test_zero_gradient_sync_wrapper():
+    """part.ZeroGradientSync: keystr-addressed push over the
+    partitioned RS; finish() returns the ShardedState."""
+    run_ranks("""
+    import jax, jax.numpy as jnp
+    from ompi_tpu.part import ZeroGradientSync
+    grads = {"w": jnp.ones((64, 8), jnp.float32) * (rank + 1),
+             "b": jnp.zeros((16,), jnp.float32)}
+    sync = ZeroGradientSync(comm, grads, deterministic="linear")
+    paths = [jax.tree_util.keystr(p) for p, _ in
+             jax.tree_util.tree_flatten_with_path(grads)[0]]
+    sync.start()
+    for key in reversed(paths):
+        sync.push(key)
+    st = sync.finish()
+    ref = comm.Reduce_scatter_multi(grads, deterministic="linear")
+    for a, b in zip(st.shards, ref.shards):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    sync.free()
+    """, 2, mca=MCA)
+
+
+def test_erroneous_calls_raise_mpierror():
+    """MPI erroneous-call convention (part/host.py treatment): wrong
+    state type / mismatched plan / bad partition traffic raise
+    MPIError with the MPI error classes, never bare ValueError."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import errors
+    bufs = [jnp.ones((32,), jnp.float32)]
+    st = comm.Reduce_scatter_multi(bufs)
+    # Allgather_multi on a non-ShardedState
+    try:
+        comm.Allgather_multi([jnp.ones((4,), jnp.float32)])
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_ARG
+    # partitioned: Pready while inactive -> ERR_REQUEST
+    req = comm.Preduce_scatter_init(bufs)
+    try:
+        req.Pready(0)
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_REQUEST
+    # double Pready -> ERR_ARG; bad rebind shape -> ERR_COUNT
+    req.start()
+    req.Pready(0)
+    try:
+        req.Pready(0)
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_ARG
+    req.wait()
+    req.start()
+    try:
+        req.Pready(0, jnp.ones((5,), jnp.float32))
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_COUNT
+    req.Pready(0, bufs[0])
+    req.wait()
+    req.free()
+    """, 2, mca=MCA)
+
+
+def test_reduce_scatter_dev_count_mismatch_is_mpierror():
+    """The satellite conversion: reduce_scatter_dev's count
+    validation raises MPIError(ERR_COUNT), dispatched through the
+    comm's errhandler like every erroneous collective call."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import errors
+    from ompi_tpu.coll import xla as cx
+    buf = jnp.ones((10,), jnp.float32)
+    try:
+        cx.reduce_scatter_dev(comm, buf, [4] * size)  # sum != 10
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_COUNT
+    try:
+        cx.reduce_scatter_dev(comm, buf, [10])        # len != size
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_COUNT
+    """, 2, mca=MCA)
+
+
+def test_host_fallback_cycle():
+    """numpy leaves (no device plane): the same ZeroPlan layout over
+    the stacked host collectives — correct sums, O(1/n) shards,
+    allgather rebuilds the originals."""
+    run_ranks("""
+    bufs = [np.arange(50, dtype=np.float32) * (rank + 1),
+            np.ones((7, 3), np.float64)]
+    st = comm.Reduce_scatter_multi(bufs)
+    assert all(isinstance(s, np.ndarray) for s in st.shards)
+    assert st.shard_bytes * size >= st.total_bytes
+    out = comm.Allgather_multi(st)
+    np.testing.assert_allclose(
+        out[0], np.arange(50, dtype=np.float32) * sum(
+            r + 1 for r in range(size)))
+    np.testing.assert_allclose(out[1], np.ones((7, 3)) * size)
+    """, 2)
+
+
+def test_optimizer_stages_match_and_shard_bytes():
+    """stage 1 (allreduce + local slice) and stage 2
+    (reduce_scatter) produce identical parameters under 'linear';
+    momentum state is sharded; per-rank bytes = replicated/n + pad
+    share."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.zero import ZeroOptimizer
+    params = {"w": jnp.ones((40, 5), jnp.float32),
+              "b": jnp.zeros((30,), jnp.float32)}
+    grads = {"w": jnp.full((40, 5), float(rank + 1), jnp.float32),
+             "b": jnp.full((30,), 2.0, jnp.float32)}
+    o1 = ZeroOptimizer(comm, params, lr=0.1, momentum=0.9, stage=1,
+                       deterministic="linear")
+    o2 = ZeroOptimizer(comm, params, lr=0.1, momentum=0.9, stage=2,
+                       deterministic="linear")
+    for _ in range(3):
+        p1 = o1.step(grads)
+        p2 = o2.step(grads)
+    for k in ("w", "b"):
+        np.testing.assert_array_equal(np.asarray(p1[k]),
+                                      np.asarray(p2[k]))
+    st = o2.state
+    pad = st.params.plan.pad_bytes
+    assert abs(st.shard_bytes * size - st.replicated_bytes) \
+        <= 2 * pad
+    # mean grad w = (1+..+n)/n; after one momentum-free check of the
+    # arithmetic: params identical across ranks
+    gathered = comm.allgather(np.asarray(p2["w"])[0, 0])
+    assert len(set(float(g) for g in gathered)) == 1
+    """, 2, mca=MCA)
+
+
+def test_optimizer_overlap_and_arg_validation():
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import errors
+    from ompi_tpu.zero import ZeroOptimizer
+    params = {"w": jnp.ones((64,), jnp.float32)}
+    grads = {"w": jnp.full((64,), 2.0, jnp.float32)}
+    ov = ZeroOptimizer(comm, params, lr=0.5, overlap=True,
+                       deterministic="linear")
+    base = ZeroOptimizer(comm, params, lr=0.5,
+                         deterministic="linear")
+    np.testing.assert_array_equal(
+        np.asarray(ov.step(grads)["w"]),
+        np.asarray(base.step(grads)["w"]))
+    ov.free()
+    try:
+        ZeroOptimizer(comm, params, stage=3)
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_ARG
+    try:
+        ZeroOptimizer(comm, params, stage=1, overlap=True)
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_ARG
+    """, 2, mca=MCA)
+
+
+def test_size1_and_empty_trees():
+    """COMM_SELF / size-1 and empty pytrees: local identity paths
+    (no device plane required on size-1 comms)."""
+    run_ranks("""
+    import jax.numpy as jnp
+    sub = comm.split(color=rank, key=0)     # size-1 comms
+    bufs = [jnp.arange(9, dtype=jnp.float32)]
+    st = sub.Reduce_scatter_multi(bufs)
+    out = sub.Allgather_multi(st)
+    np.testing.assert_array_equal(np.asarray(out[0]),
+                                  np.asarray(bufs[0]))
+    empty = comm.Reduce_scatter_multi([])
+    assert comm.Allgather_multi(empty) == []
+    sub.free()
+    """, 2, mca=MCA)
+
+
+@pytest.mark.slow
+def test_watchdog_no_false_positives_oversubscribed():
+    """Soak: 8 oversubscribed ranks grinding collectives for ~12s
+    with an aggressive hang timeout. Scheduling jitter from
+    oversubscription must NOT trip the watchdog — progress-aware
+    sweeps (seq advancing => not hung) keep telemetry_hangs at 0
+    while sweeps demonstrably ran."""
+    run_ranks("""
+    import time
+    from ompi_tpu.core import pvar
+    from ompi_tpu import telemetry
+    assert telemetry.get_watchdog() is not None
+    s = pvar.session()
+    # fixed iteration count (NOT a per-rank wall clock: collectives
+    # pair positionally, so every rank must run the same number)
+    for i in range(400):
+        comm.allreduce(rank + i)
+        if i % 7 == rank % 7:
+            time.sleep(0.02 * (rank % 3))   # uneven per-rank load
+        comm.Barrier()
+    comm.Barrier()
+    assert s.read("telemetry_watchdog_sweeps") > 0
+    assert s.read("telemetry_hangs") == 0, \
+        "oversubscription jitter tripped the hang watchdog"
+    """, 8, mca={"telemetry_enable": "1",
+                 "telemetry_hang_timeout": "10",
+                 "telemetry_watchdog_period": "0.25",
+                 "telemetry_interval": "0.5"}, timeout=300)
