@@ -137,19 +137,23 @@ def _bench_train_step(prep):
     params, tokens, labels = (prep["params"], prep["tokens"],
                               prep["labels"])
     B, T, iters = prep["B"], prep["T"], prep["iters"]
+    from ompi_tpu.prof import ledger as prof_ledger
+
     step = jax.jit(tfm.make_train_step(cfg, ax, specs, lr=1e-3),
                    donate_argnums=(0,))
     tc = time.perf_counter()
-    params, loss = step(params, tokens, labels)   # compile + 1 step
-    jax.block_until_ready(loss)
+    with prof_ledger.phase("compile"):
+        params, loss = step(params, tokens, labels)  # compile + 1 step
+        jax.block_until_ready(loss)
     compile_s = time.perf_counter() - tc
     _phase(f"compiled+warm ({compile_s:.1f}s)")
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, loss = step(params, tokens, labels)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    with prof_ledger.phase("train"):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, loss = step(params, tokens, labels)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
     _phase(f"timed loop done ({dt:.1f}s)")
     tokens_per_s = B * T * iters / dt
 
@@ -522,6 +526,13 @@ def main() -> None:
             print("bench.py: --trace requires a path", file=sys.stderr)
             sys.exit(2)
         trace_path = sys.argv[i + 1]
+    # the attribution ledger is the source of truth for the reported
+    # phase_*_s wall breakdown (prof plane, not ad-hoc timestamps) —
+    # always on for bench: phase enter/exit cost is nothing against
+    # the phases themselves
+    from ompi_tpu.prof import ledger as prof_ledger
+
+    prof_ledger.enable()
     # staging first: the train bench necessarily reads results back
     # (loss), and the first D2H degrades this platform's uplink (see
     # _bench_staging) — h2d must be measured before any read
@@ -536,12 +547,13 @@ def main() -> None:
             prep_box["p"] = _prepare_train()
         return prep_box["p"]
 
-    try:
-        d2h, h2d, d2h_raw, d2h_chunked, prep = _bench_staging(
-            between=_prep_cached)
-    except Exception:
-        d2h = h2d = d2h_raw = d2h_chunked = None
-        prep = _prep_cached()
+    with prof_ledger.phase("staging"):
+        try:
+            d2h, h2d, d2h_raw, d2h_chunked, prep = _bench_staging(
+                between=_prep_cached)
+        except Exception:
+            d2h = h2d = d2h_raw = d2h_chunked = None
+            prep = _prep_cached()
     staging_s = time.time() - t_start
     _phase(f"staging+upload done ({staging_s:.1f}s)")
     if trace_path is not None:
@@ -624,6 +636,7 @@ def main() -> None:
         peak = acc_current().peak_flops()
     except Exception:
         peak = None
+    ph = prof_ledger.phase_seconds()
     print(json.dumps({
         "metric": "model_tflops_per_s",
         "value": round(tflops, 3),
@@ -649,12 +662,13 @@ def main() -> None:
             "zero": zero,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),
-            # wall attribution: metric quality depends only on
-            # phase_train_s; the rest is tunnel transfer + compile,
-            # which vary with tunnel health run-to-run
-            "phase_staging_s": round(staging_s, 1),
-            "phase_compile_s": round(compile_s, 1),
-            "phase_train_s": round(train_s, 1),
+            # wall attribution from the prof-plane phase ledger
+            # (metric quality depends only on phase_train_s; the rest
+            # is tunnel transfer + compile, which vary with tunnel
+            # health run-to-run)
+            "phase_staging_s": round(ph.get("staging", staging_s), 3),
+            "phase_compile_s": round(ph.get("compile", compile_s), 3),
+            "phase_train_s": round(ph.get("train", train_s), 3),
         },
     }))
 

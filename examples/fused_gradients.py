@@ -20,21 +20,25 @@ import numpy as np
 
 from ompi_tpu import mpi
 from ompi_tpu.core import pvar
+from ompi_tpu.prof import ledger as prof
 
 comm = mpi.Init()
 rank, size = comm.rank, comm.size
 
 # a params-like pytree: many small tensors, mixed dtypes — the shape
-# of a real model's gradient set, where per-tensor dispatch dominates
-grads = {
-    "embed": jnp.full((256, 32), float(rank + 1), jnp.float32),
-    "layers": [
-        {"w": jnp.ones((64, 64), jnp.float32) * (rank + 1),
-         "b": jnp.arange(64, dtype=jnp.float32) * rank}
-        for _ in range(4)
-    ],
-    "step": jnp.array([rank], jnp.int32),
-}
+# of a real model's gradient set, where per-tensor dispatch dominates.
+# Built under the attribution ledger's "staging" phase (a no-op
+# unless the job runs with --mca prof_enable 1).
+with prof.phase("staging"):
+    grads = {
+        "embed": jnp.full((256, 32), float(rank + 1), jnp.float32),
+        "layers": [
+            {"w": jnp.ones((64, 64), jnp.float32) * (rank + 1),
+             "b": jnp.arange(64, dtype=jnp.float32) * rank}
+            for _ in range(4)
+        ],
+        "step": jnp.array([rank], jnp.int32),
+    }
 
 # one fused call replaces ~10 per-tensor Allreduces; 'linear' keeps the
 # result bit-identical to the per-tensor loop (rank-order fold)
@@ -47,10 +51,11 @@ np.testing.assert_allclose(
 
 # persistent form for the training loop: init once, Start each step
 preq = comm.Allreduce_multi_init(grads)
-for _ in range(3):  # the "training loop"
-    preq.start()
-    preq.wait()
-    synced = preq.array  # fresh result pytree each cycle
+with prof.phase("train"):
+    for _ in range(3):  # the "training loop"
+        preq.start()
+        preq.wait()
+        synced = preq.array  # fresh result pytree each cycle
 preq.free()
 
 if rank == 0:

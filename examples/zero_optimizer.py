@@ -32,22 +32,27 @@ import numpy as np
 
 from ompi_tpu import mpi
 from ompi_tpu.core import pvar
+from ompi_tpu.prof import ledger as prof
 from ompi_tpu.zero import ZeroOptimizer
 
 comm = mpi.Init()
 rank, size = comm.rank, comm.size
 
-params = {
-    "embed": jnp.ones((256, 32), jnp.float32),
-    "layers": [
-        {"w": jnp.ones((64, 64), jnp.float32),
-         "b": jnp.zeros((64,), jnp.float32)}
-        for _ in range(4)
-    ],
-}
+# phase ledger (no-op unless --mca prof_enable 1): setup/optimizer
+# construction is "staging", the step loop is "train" — the same
+# attribution bench.py reports and python -m ompi_tpu.prof merges
+with prof.phase("staging"):
+    params = {
+        "embed": jnp.ones((256, 32), jnp.float32),
+        "layers": [
+            {"w": jnp.ones((64, 64), jnp.float32),
+             "b": jnp.zeros((64,), jnp.float32)}
+            for _ in range(4)
+        ],
+    }
 
-opt = ZeroOptimizer(comm, params, lr=0.1, momentum=0.9,
-                    overlap=True, deterministic="linear")
+    opt = ZeroOptimizer(comm, params, lr=0.1, momentum=0.9,
+                        overlap=True, deterministic="linear")
 
 # the O(1/n) claim: params + momentum shards on this rank vs the
 # replicated optimizer they replace (pad waste is the only slack)
@@ -59,12 +64,15 @@ assert abs(per_rank - replicated / size) <= opt.state.params.plan.pad_bytes + 8,
 s = pvar.session()
 paths = [jax.tree_util.keystr(p) for p, _ in
          jax.tree_util.tree_flatten_with_path(params)[0]]
-for step in range(3):
-    # "backward pass": every rank contributes rank+1; the averaged
-    # gradient is the same on all ranks, so params stay replicated
-    grads = jax.tree.map(
-        lambda p: jnp.full(p.shape, float(rank + 1), p.dtype), params)
-    params = opt.step(grads)
+with prof.phase("train"):
+    for step in range(3):
+        # "backward pass": every rank contributes rank+1; the
+        # averaged gradient is the same on all ranks, so params stay
+        # replicated
+        grads = jax.tree.map(
+            lambda p: jnp.full(p.shape, float(rank + 1), p.dtype),
+            params)
+        params = opt.step(grads)
 
 # every rank reassembled identical parameters (mean grad = (n+1)/2)
 ref = np.asarray(params["embed"])[0, 0]
@@ -80,4 +88,8 @@ if rank == 0:
           f"{s.read('zero_rs_launches')} reduce_scatter + "
           f"{s.read('zero_ag_launches')} allgather launches, "
           f"{flushes} buckets flushed before the final push")
+    ph = prof.phase_seconds()
+    if ph:
+        print("phase ledger: " + ", ".join(
+            f"{k}={v:.3f}s" for k, v in sorted(ph.items())))
 mpi.Finalize()

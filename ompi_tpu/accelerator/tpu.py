@@ -25,6 +25,7 @@ from typing import Optional
 
 from ompi_tpu.accelerator import Accelerator, framework
 from ompi_tpu.core import output
+from ompi_tpu.prof import ledger as _prof
 
 _out = output.stream("accelerator_tpu")
 
@@ -97,11 +98,19 @@ class TpuAccelerator(Accelerator):
         # single-stream: D2H readback is serialized device-side (chunked
         # threaded reads measure *slower*; see bench.py staging notes)
         jax = self._ensure()
-        return self._np.asarray(jax.device_get(buf))
+        if _prof.PROFILER is None:
+            return self._np.asarray(jax.device_get(buf))
+        t0 = _prof.now()
+        out = self._np.asarray(jax.device_get(buf))
+        _prof.PROFILER.xfer("d2h", out.nbytes, t0, _prof.now(),
+                            site="to_host")
+        return out
 
     def to_device(self, host_array, like=None):
         jax = self._ensure()
         np = self._np
+        prof = _prof.PROFILER
+        t_all = _prof.now() if prof is not None else 0
         sharding = like.sharding if (
             like is not None and hasattr(like, "sharding")) else None
         h = np.asarray(host_array)
@@ -114,11 +123,29 @@ class TpuAccelerator(Accelerator):
             nch = min(self.H2D_MAX_CHUNKS,
                       max(2, h.nbytes // self.H2D_CHUNK_BYTES))
             parts = np.array_split(flat, nch)
-            dparts = [jax.device_put(p, dev) for p in parts]  # concurrent
-            return jax.numpy.concatenate(dparts).reshape(h.shape)
-        if sharding is not None:
-            return jax.device_put(h, sharding)
-        return jax.device_put(h)
+            if prof is None:
+                dparts = [jax.device_put(p, dev)
+                          for p in parts]  # concurrent
+            else:
+                dparts = []
+                for ci, p in enumerate(parts):
+                    tc = _prof.now()
+                    dparts.append(jax.device_put(p, dev))  # concurrent
+                    prof.xfer_chunk("h2d", p.nbytes, tc, _prof.now(),
+                                    chunk=ci, stream=ci)
+            out = jax.numpy.concatenate(dparts).reshape(h.shape)
+            if prof is not None:
+                out.block_until_ready()
+                prof.xfer("h2d", h.nbytes, t_all, _prof.now(),
+                          site="to_device", chunks=nch)
+            return out
+        out = (jax.device_put(h, sharding) if sharding is not None
+               else jax.device_put(h))
+        if prof is not None:
+            out.block_until_ready()
+            prof.xfer("h2d", h.nbytes, t_all, _prof.now(),
+                      site="to_device", chunks=1)
+        return out
 
     def copy_async(self, src, dst_like=None):
         """Async DtoH on the component's ordered D2H stream.
@@ -131,8 +158,22 @@ class TpuAccelerator(Accelerator):
         arrays rely on (pml_ob1_accelerator.c:57-89)."""
         jax = self._ensure()
         np = self._np
-        return self._d2h_stream().submit(
-            lambda: np.asarray(jax.device_get(src)))
+        if _prof.PROFILER is None:
+            return self._d2h_stream().submit(
+                lambda: np.asarray(jax.device_get(src)))
+
+        def _profiled_copy():
+            # measured on the stream worker so the span covers the
+            # actual transfer, not the submit->drain queueing delay
+            t0 = _prof.now()
+            out = np.asarray(jax.device_get(src))
+            p = _prof.PROFILER
+            if p is not None:
+                p.xfer("d2h", out.nbytes, t0, _prof.now(),
+                       site="copy_async", stream="d2h")
+            return out
+
+        return self._d2h_stream().submit(_profiled_copy)
 
     def _d2h_stream(self):
         with self._lock:
@@ -236,4 +277,13 @@ class TpuAccelerator(Accelerator):
         from ompi_tpu.accelerator import ipc
 
         jax = self._ensure()
-        return jax.device_put(self._np.array(ipc.import_array(handle)))
+        if _prof.PROFILER is None:
+            return jax.device_put(
+                self._np.array(ipc.import_array(handle)))
+        h = self._np.array(ipc.import_array(handle))
+        t0 = _prof.now()
+        out = jax.device_put(h)
+        out.block_until_ready()
+        _prof.PROFILER.xfer("h2d", h.nbytes, t0, _prof.now(),
+                            site="ipc_import")
+        return out

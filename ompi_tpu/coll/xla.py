@@ -36,6 +36,7 @@ import numpy as np
 from ompi_tpu import errors, op as op_mod
 from ompi_tpu.coll import CollModule, accelerator as staging, framework
 from ompi_tpu.core import cvar, output, pvar
+from ompi_tpu.prof import ledger as _prof
 from ompi_tpu.telemetry import flight as _flight
 from ompi_tpu.trace import recorder as _trace
 
@@ -250,8 +251,14 @@ class _Ctx:
             resident = False  # numpy / multi-shard input: stage it
         if resident:
             pvar.record("coll_xla_device_put_skipped")
-        else:
+        elif _prof.PROFILER is None:
             x = jax.device_put(x, self.my)
+        else:
+            t0 = _prof.now()
+            x = jax.device_put(x, self.my)
+            x.block_until_ready()
+            _prof.PROFILER.xfer("h2d", getattr(x, "nbytes", 0), t0,
+                                _prof.now(), site="to_global")
         return jax.make_array_from_single_device_arrays(
             (self.n,) + x.shape, sharding or self.in_sharding,
             [x[None]])
@@ -269,18 +276,25 @@ class _Ctx:
         fn = self.fns.get(key)
         rec = _trace.RECORDER
         if fn is None:
+            # cold path: always timed — prof_compile_ns is the
+            # numerator of the attribution story and two clock reads
+            # are noise against an XLA compile
             pvar.record("coll_xla_cache_misses")
-            if rec is None:
-                fn = self.fns[key] = build()
-            else:
-                t0 = _trace.now()
-                fn = self.fns[key] = build()
-                rec.record("compile", "coll_xla", t0, _trace.now(),
+            t0 = _trace.now()
+            fn = self.fns[key] = build()
+            t1 = _trace.now()
+            if _prof.PROFILER is not None:
+                pvar.record("prof_compile_misses")
+                pvar.record("prof_compile_ns", t1 - t0)
+            if rec is not None:
+                rec.record("compile", "coll_xla", t0, t1,
                            {"cache": "miss", "key": repr(key)[:160]})
             pvar.record_hwm("coll_xla_fns_size", len(self.fns))
             self._evict(self.fns)
         else:
             pvar.record("coll_xla_cache_hits")
+            if _prof.PROFILER is not None:
+                pvar.record("prof_compile_hits")
             self.fns[key] = self.fns.pop(key)  # LRU touch
             if rec is not None:
                 rec.instant("cache_hit", "coll_xla",
@@ -294,17 +308,21 @@ class _Ctx:
         rec = _trace.RECORDER
         if p is None:
             pvar.record("coll_xla_plan_cache_misses")
-            if rec is None:
-                p = self.plans[key] = build()
-            else:
-                t0 = _trace.now()
-                p = self.plans[key] = build()
-                rec.record("plan_build", "coll_xla", t0, _trace.now(),
+            t0 = _trace.now()
+            p = self.plans[key] = build()
+            t1 = _trace.now()
+            if _prof.PROFILER is not None:
+                pvar.record("prof_compile_misses")
+                pvar.record("prof_compile_ns", t1 - t0)
+            if rec is not None:
+                rec.record("plan_build", "coll_xla", t0, t1,
                            {"cache": "miss", "key": repr(key)[:160]})
             pvar.record_hwm("coll_xla_plans_size", len(self.plans))
             self._evict(self.plans)
         else:
             pvar.record("coll_xla_plan_cache_hits")
+            if _prof.PROFILER is not None:
+                pvar.record("prof_compile_hits")
             self.plans[key] = self.plans.pop(key)  # LRU touch
             if rec is not None:
                 rec.instant("plan_cache_hit", "coll_xla",

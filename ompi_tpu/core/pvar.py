@@ -61,6 +61,17 @@ WELL_KNOWN = (
     "telemetry_flight_ops", "telemetry_samples",
     "telemetry_sample_ns", "telemetry_watchdog_sweeps",
     "telemetry_hangs",
+    # prof/ plane (wall-clock attribution): phase-ledger wall per
+    # canonical phase, host<->device transfer bytes + time per
+    # direction (bandwidth hwm gauges ride prof_xfer_*_bw_mbps_hwm),
+    # _Ctx compile cache traffic + build time, and jax's persistent
+    # compilation cache hit/miss accounting (compile_cache_dir cvar)
+    "prof_phase_staging_ns", "prof_phase_compile_ns",
+    "prof_phase_train_ns", "prof_phase_teardown_ns",
+    "prof_xfer_h2d_bytes", "prof_xfer_h2d_ns",
+    "prof_xfer_d2h_bytes", "prof_xfer_d2h_ns",
+    "prof_compile_hits", "prof_compile_misses", "prof_compile_ns",
+    "prof_compile_cache_hits", "prof_compile_cache_misses",
     # pml/monitoring per-context traffic (combined monitoring_msgs/
     # monitoring_bytes stay alongside)
     "monitoring_p2p_msgs", "monitoring_p2p_bytes",

@@ -1,0 +1,89 @@
+"""ompi_tpu.prof — wall-clock attribution profiler.
+
+Sixth observability component (after events, monitoring, profile,
+trace, telemetry): answers "where did the wall go" for the ingest
+plane. Three sub-planes, all riding the existing substrate:
+
+- the **phase ledger** (:mod:`ompi_tpu.prof.ledger`): ``staging`` /
+  ``compile`` / ``train`` / ``teardown`` phases as nestable spans +
+  ``prof_phase_*_ns`` pvars;
+- **transfer instrumentation**: h2d/d2h copy spans with bytes,
+  bandwidth gauges and log2 size/latency histograms, emitted by the
+  accelerator and ``_Ctx.to_global`` staging sites;
+- **compile observability**: `_Ctx` compile spans + hit/miss pvars,
+  jax's persistent compilation cache wired behind the
+  ``compile_cache_dir`` cvar with ``prof_compile_cache_{hits,misses}``
+  accounting, and the ``python -m ompi_tpu.prof`` attribution CLI.
+
+Enable with ``--mca prof_enable 1`` (or ``OMPI_TPU_PROF=1``); off by
+default at the usual one-branch cost per instrumented site.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ompi_tpu.core import cvar, pvar
+from ompi_tpu.prof.ledger import (  # noqa: F401  (public re-exports)
+    PROFILER, Profiler, current_phase, disable, enable, phase,
+    phase_seconds, requested,
+)
+
+_cache_dir_var = cvar.register(
+    "compile_cache_dir", "", str,
+    help="Directory for jax's persistent XLA compilation cache. When "
+         "set, runtime init points jax_compilation_cache_dir here and "
+         "accounts prof_compile_cache_{hits,misses} so repeat jobs "
+         "can prove the cold compile was skipped.",
+    level=4)
+_cache_min_var = cvar.register(
+    "compile_cache_min_secs", -1.0, float,
+    help="Override jax_persistent_cache_min_compile_time_secs "
+         "(negative: leave jax's default, which skips persisting "
+         "sub-second compiles — lower it to cache tiny CPU programs).",
+    level=7)
+
+_CACHE_WIRED = False
+
+
+def _on_cache_event(event: str, **kw) -> None:
+    # jax fires compile_requests_use_cache before (on a hit)
+    # cache_hits — count every request as a miss, then reclassify.
+    if event == "/jax/compilation_cache/cache_hits":
+        pvar.record("prof_compile_cache_hits")
+        pvar.record("prof_compile_cache_misses", -1)
+    elif event == "/jax/compilation_cache/compile_requests_use_cache":
+        pvar.record("prof_compile_cache_misses")
+
+
+def wire_compile_cache() -> Optional[str]:
+    """Point jax's persistent compilation cache at the
+    ``compile_cache_dir`` cvar and hook hit/miss accounting.
+
+    Called from runtime init (before the first device-plane compile);
+    idempotent; returns the cache dir when wired, None when the cvar
+    is unset or jax is unavailable. Failures are non-fatal — a broken
+    cache dir must never take down init."""
+    global _CACHE_WIRED
+    d = str(_cache_dir_var.get() or "").strip()
+    if not d:
+        return None
+    if _CACHE_WIRED:
+        return d
+    try:
+        import os
+
+        import jax
+        from jax import monitoring as _jmon
+
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        min_secs = float(_cache_min_var.get())
+        if min_secs >= 0:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", min_secs)
+        _jmon.register_event_listener(_on_cache_event)
+        _CACHE_WIRED = True
+        return d
+    except Exception:
+        return None

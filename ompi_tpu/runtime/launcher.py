@@ -43,6 +43,23 @@ from typing import Dict, List, NamedTuple, Optional, Sequence
 from ompi_tpu.runtime import kvstore
 
 
+def _prof_ledger(mca: Optional[Dict[str, str]]):
+    """Launcher-side phase ledger: when the job profiles (env
+    OMPI_TPU_PROF[_ENABLE] or --mca prof_enable) the supervisor
+    enables its own ledger too, so spawn/wait wall is attributed the
+    same way the ranks attribute staging/compile/train. Returns the
+    ledger module either way — phase() is the shared no-op when
+    disabled."""
+    from ompi_tpu.prof import ledger
+
+    if ledger.PROFILER is None and (
+            ledger.requested()
+            or str((mca or {}).get("prof_enable", "0")).strip().lower()
+            not in ("0", "false", "no", "off", "")):
+        ledger.enable()
+    return ledger
+
+
 class HostSpec(NamedTuple):
     name: str
     slots: int = 1
@@ -255,22 +272,26 @@ def launch_mpmd(apps, mca: Optional[Dict[str, str]] = None,
     store.seed_counter(f"ww:{jobid}", total)
     ft = (mca or {}).get("ft", "0") not in ("0", "false", "")
     topo = _topo_for(bind_to)
+    ledger = _prof_ledger(mca)
     procs: List[subprocess.Popen] = []
     try:
-        r = 0
-        for appnum, (argv, n) in enumerate(apps):
-            argv = _wrap_py(argv)
-            for _ in range(n):
-                env = build_env(r, total, store.addr, jobid, mca,
-                                bind_cpus=_cpuset_for(r, bind_to,
-                                                      topo))
-                if len(apps) > 1:  # MPI_APPNUM only exists for MPMD
-                    env["OMPI_TPU_APPNUM"] = str(appnum)
-                else:
-                    env.pop("OMPI_TPU_APPNUM", None)
-                procs.append(subprocess.Popen(argv, env=env))
-                r += 1
-        return _wait_all(procs, timeout, store=store if ft else None)
+        with ledger.phase("spawn"):
+            r = 0
+            for appnum, (argv, n) in enumerate(apps):
+                argv = _wrap_py(argv)
+                for _ in range(n):
+                    env = build_env(r, total, store.addr, jobid, mca,
+                                    bind_cpus=_cpuset_for(r, bind_to,
+                                                          topo))
+                    if len(apps) > 1:  # MPI_APPNUM: MPMD only
+                        env["OMPI_TPU_APPNUM"] = str(appnum)
+                    else:
+                        env.pop("OMPI_TPU_APPNUM", None)
+                    procs.append(subprocess.Popen(argv, env=env))
+                    r += 1
+        with ledger.phase("wait"):
+            return _wait_all(procs, timeout,
+                             store=store if ft else None)
     finally:
         reap(procs)
         cleanup_shm(jobid)
@@ -355,6 +376,7 @@ def launch_hosts(argv: Optional[Sequence[str]],
     store.seed_counter(f"ww:{jobid}", total)
     store_addr = f"{store.addr[0]}:{store.addr[1]}"
     daemons: List[subprocess.Popen] = []
+    ledger = _prof_ledger(mca)
     try:
         base = 0
         for h in hosts:
@@ -395,8 +417,9 @@ def launch_hosts(argv: Optional[Sequence[str]],
         # daemons supervise their ranks; the head aggregates daemons.
         # +30s grace over the per-daemon timeout so daemons time out
         # first and report 124 themselves.
-        rc = _wait_all(daemons, None if timeout is None
-                       else timeout + 30)
+        with ledger.phase("wait"):
+            rc = _wait_all(daemons, None if timeout is None
+                           else timeout + 30)
         ft = (mca or {}).get("ft", "0") not in ("0", "false", "")
         if rc == 0 and ft:
             # job-level "did anything survive" check: per-daemon it

@@ -53,6 +53,22 @@ def init_instance() -> None:
         _out.verbose(2, "rte up: rank %d/%d job %s",
                      rte.rank, rte.size, rte.jobid)
 
+        # attribution profiler + persistent compile cache: the ledger
+        # must be live BEFORE the accelerator/device plane so the very
+        # first device_put and XLA compile are attributed, and the
+        # compile-cache dir must be set before anything compiles
+        from ompi_tpu import prof as _prof
+
+        try:
+            if _prof.requested():
+                _prof.enable(rank=rte.rank)
+            cache_dir = _prof.wire_compile_cache()
+            if cache_dir:
+                _out.verbose(2, "persistent compile cache: %s",
+                             cache_dir)
+        except Exception as exc:  # profiling must never sink init
+            _out.verbose(0, "prof enable failed: %r", exc)
+
         # accelerator selection happens during core init in the reference
         # (opal/runtime/opal_init.c:202-206)
         from ompi_tpu.accelerator import current as _accel_current
@@ -129,26 +145,30 @@ def _release() -> None:
         _instance_users = max(0, _instance_users - 1)
         if _instance_users > 0 or not _instance_up:
             return
-        try:
-            if rte.size > 1:
-                # every rank must have drained its last messages before
-                # any transport tears down (unlink/close races)
-                rte.fence("finalize", timeout=30.0)
-        except Exception:
-            pass
-        # telemetry threads go first: a watchdog sweeping (or a
-        # sampler publishing) against a store that the teardown below
-        # is about to close would log spurious RPC failures
-        from ompi_tpu import telemetry as _telemetry
+        from ompi_tpu.prof import ledger as _prof_ledger
 
-        try:
-            _telemetry.stop()
-        except Exception:
-            pass
-        from ompi_tpu import pml
+        with _prof_ledger.phase("teardown"):
+            try:
+                if rte.size > 1:
+                    # every rank must have drained its last messages
+                    # before any transport tears down (unlink/close
+                    # races)
+                    rte.fence("finalize", timeout=30.0)
+            except Exception:
+                pass
+            # telemetry threads go first: a watchdog sweeping (or a
+            # sampler publishing) against a store that the teardown
+            # below is about to close would log spurious RPC failures
+            from ompi_tpu import telemetry as _telemetry
 
-        pml.finalize()
-        registry.close_all()
+            try:
+                _telemetry.stop()
+            except Exception:
+                pass
+            from ompi_tpu import pml
+
+            pml.finalize()
+            registry.close_all()
         _instance_up = False
 
 

@@ -29,6 +29,7 @@ import time
 from typing import Dict, Optional
 
 from ompi_tpu.core import cvar, output, pvar
+from ompi_tpu.prof import ledger as _prof_ledger
 from ompi_tpu.telemetry import flight, openmetrics
 
 _out = output.stream("telemetry")
@@ -140,6 +141,15 @@ class Sampler:
             snap["telemetry_inflight_now"] = hb["inflight"]
         gauges = ("telemetry_seq_entered", "telemetry_seq_completed",
                   "telemetry_inflight_now")
+        prof = _prof_ledger.PROFILER
+        if prof is not None:
+            # rolling achieved bandwidth over the profiler's transfer
+            # window — the live "is staging making progress" gauge
+            for d in ("h2d", "d2h"):
+                bw = prof.rolling_bw_bps(d)
+                if bw is not None:
+                    snap["prof_xfer_%s_rolling_bps" % d] = int(bw)
+                    gauges += ("prof_xfer_%s_rolling_bps" % d,)
         labels = {"rank": str(self.rank), "job": self.jobid}
         text = openmetrics.render(snap, labels, gauges=gauges,
                                   terminate=not self.rollup)

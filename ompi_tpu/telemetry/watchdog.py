@@ -212,12 +212,18 @@ class Watchdog:
     # -- dump-on-hang ------------------------------------------------------
     def _dump(self, fl) -> str:
         v = self.verdict
+        from ompi_tpu.prof import ledger as _prof_ledger
+
         doc: Dict[str, Any] = {
             "schema": DUMP_SCHEMA,
             "rank": self.rank,
             "jobid": self.jobid,
             "wall_time": time.time(),
             "verdict": v,
+            # phase from the attribution ledger: a rank stuck in
+            # staging reports phase=staging instead of being
+            # misattributed to the collective it never reached
+            "phase": _prof_ledger.current_phase(),
             "inflight": fl.snapshot(),
             "pvars": pvar.snapshot(),
         }
@@ -246,8 +252,9 @@ class Watchdog:
             _out.verbose(0, "hang dump write failed: %r", exc)
             path = ""
         pvar.record("telemetry_hangs")
-        _out.verbose(0, "HANG: %s seq %d stuck %.1fs, stragglers %s "
-                     "-> %s", v["op"], v["seq"], v["waited_s"],
+        _out.verbose(0, "HANG: %s seq %d stuck %.1fs phase=%s, "
+                     "stragglers %s -> %s", v["op"], v["seq"],
+                     v["waited_s"], doc["phase"] or "?",
                      v["stragglers"], path or "(dump failed)")
         if events.active("telemetry_hang"):
             events.emit("telemetry_hang", op=v["op"], seq=v["seq"],

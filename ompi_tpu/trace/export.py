@@ -28,8 +28,44 @@ from ompi_tpu.core import pvar
 from ompi_tpu.trace import recorder as _rec
 
 #: stable tids for the layers the tentpole instruments; anything else
-#: gets the next free id at export time
-_TIDS = {"api": 1, "coll_xla": 2, "part": 3, "pml": 4, "btl": 5}
+#: gets the next free id at export time. "prof" (phase ledger) and
+#: "xfer" (host<->device copies) are the attribution-profiler tracks.
+_TIDS = {"api": 1, "coll_xla": 2, "part": 3, "pml": 4, "btl": 5,
+         "prof": 6, "xfer": 7}
+
+
+def _xfer_counters(spans: Sequence, rank: int,
+                   shift_ns: int) -> List[Dict[str, Any]]:
+    """Perfetto counter tracks from the xfer spans: per-direction
+    achieved GB/s (sampled at each transfer's completion) and
+    bytes-in-flight (+nbytes at t0, -nbytes at t1 — overlapping
+    chunked streams stack)."""
+    rows: List[Dict[str, Any]] = []
+    for direction in ("h2d", "d2h"):
+        deltas: List[Tuple[int, int]] = []
+        for sp in spans:
+            if sp.subsys != "xfer" or sp.name != direction:
+                continue
+            nb = int((sp.args or {}).get("bytes", 0))
+            deltas.append((sp.t0, nb))
+            deltas.append((sp.t1, -nb))
+            dur = sp.t1 - sp.t0
+            if dur > 0 and nb:
+                rows.append({
+                    "ph": "C", "name": f"xfer_{direction}_GBps",
+                    "pid": rank, "tid": 0,
+                    "ts": (sp.t1 + shift_ns) / 1e3,
+                    # bytes/ns == GB/s
+                    "args": {"GBps": round(nb / dur, 3)}})
+        inflight = 0
+        for t, d in sorted(deltas):
+            inflight += d
+            rows.append({
+                "ph": "C",
+                "name": f"xfer_{direction}_bytes_in_flight",
+                "pid": rank, "tid": 0, "ts": (t + shift_ns) / 1e3,
+                "args": {"bytes": inflight}})
+    return rows
 
 
 def to_chrome(rec: Optional["_rec.Recorder"] = None,
@@ -64,7 +100,8 @@ def to_chrome(rec: Optional["_rec.Recorder"] = None,
         if sp.args:
             row["args"] = sp.args
         rows.append(row)
-    rows.sort(key=lambda e: (e["ts"], -e["dur"]))
+    rows.extend(_xfer_counters(spans, rank, shift_ns))
+    rows.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
     snap = pvar.snapshot()
     return {
         "traceEvents": evs + rows,
