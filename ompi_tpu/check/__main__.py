@@ -1,0 +1,92 @@
+"""CLI for the correctness plane.
+
+    python -m ompi_tpu.check lint <paths...>   static collective lint
+    python -m ompi_tpu.check rules             rule catalog
+    python -m ompi_tpu.check run prog.py ...   run under the sanitizer
+
+``lint`` exits 1 when any unsuppressed finding remains (the CI
+contract: ``python -m ompi_tpu.check lint ompi_tpu examples`` must
+exit 0). Missing/unreadable input is one line on stderr and exit 1,
+never a traceback — the prof CLI's error convention.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _cmd_lint(ns: argparse.Namespace) -> int:
+    from ompi_tpu.check import lint
+
+    for p in ns.paths:
+        if not os.path.exists(p):
+            print(f"check lint: no such path: {p}", file=sys.stderr)
+            return 1
+    findings = lint.lint_paths(ns.paths)
+    shown = findings if ns.show_suppressed else \
+        lint.unsuppressed(findings)
+    for f in shown:
+        tag = " (suppressed)" if f.suppressed else ""
+        print(f"{f}{tag}")
+    bad = lint.unsuppressed(findings)
+    nsup = len(findings) - len(bad)
+    print(f"check lint: {len(bad)} finding(s), {nsup} suppressed",
+          file=sys.stderr)
+    return 1 if bad else 0
+
+
+def _cmd_rules(ns: argparse.Namespace) -> int:
+    from ompi_tpu.check.lint import CATALOG
+
+    width = max(len(r) for r in CATALOG)
+    for rule, desc in sorted(CATALOG.items()):
+        print(f"{rule:<{width}}  {desc}")
+    print(f"\nsuppress with: # check: disable={next(iter(CATALOG))}"
+          "  (or disable=all)")
+    return 0
+
+
+def _cmd_run(ns: argparse.Namespace) -> int:
+    import runpy
+
+    if not os.path.exists(ns.script):
+        print(f"check run: no such file: {ns.script}", file=sys.stderr)
+        return 1
+    os.environ["OMPI_TPU_CHECK"] = str(ns.level)
+    sys.argv = [ns.script] + ns.args
+    runpy.run_path(ns.script, run_name="__main__")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ompi_tpu.check",
+        description="ompi_tpu correctness plane: static collective "
+                    "lint + runtime MPI sanitizer")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    lp = sub.add_parser("lint", help="static MPI lint over files/dirs")
+    lp.add_argument("paths", nargs="+")
+    lp.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    lp.set_defaults(fn=_cmd_lint)
+
+    rp = sub.add_parser("rules", help="print the rule catalog")
+    rp.set_defaults(fn=_cmd_rules)
+
+    xp = sub.add_parser(
+        "run", help="run a program under the runtime sanitizer "
+                    "(sets OMPI_TPU_CHECK)")
+    xp.add_argument("--level", type=int, default=2, choices=[1, 2])
+    xp.add_argument("script")
+    xp.add_argument("args", nargs=argparse.REMAINDER)
+    xp.set_defaults(fn=_cmd_run)
+
+    ns = ap.parse_args(argv)
+    return ns.fn(ns)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

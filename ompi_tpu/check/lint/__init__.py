@@ -1,0 +1,84 @@
+"""Static collective lint — the MUST-before-launch half of the
+correctness plane.
+
+One AST pass per file; rules live in :mod:`rules` (catalog:
+``rules.CATALOG`` / ``python -m ompi_tpu.check rules``). A finding on
+a line carrying ``# check: disable=RULE`` (or ``disable=all``) is
+marked suppressed and does not fail the run — the grep-able audit
+trail the reference's ``MPI_PARAM_CHECK`` ifdefs never had.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, List
+
+from ompi_tpu.check.lint.rules import CATALOG, RULES, Finding, \
+    build_parents
+
+__all__ = ["CATALOG", "Finding", "lint_source", "lint_paths",
+           "unsuppressed"]
+
+_SUPPRESS_RE = re.compile(r"#\s*check:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+def _suppressions(line: str) -> frozenset:
+    m = _SUPPRESS_RE.search(line)
+    if not m:
+        return frozenset()
+    return frozenset(p.strip() for p in m.group(1).split(",") if p.strip())
+
+
+def lint_source(src: str, path: str = "<string>") -> List[Finding]:
+    """Run every rule over one module's source; returns ALL findings
+    with ``suppressed`` set where the flagged line disables the rule."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as exc:
+        return [Finding("parse-error", path, exc.lineno or 0,
+                        f"syntax error: {exc.msg}")]
+    parents = build_parents(tree)
+    findings: List[Finding] = []
+    for rule in RULES:
+        findings.extend(rule(tree, parents, path))
+    lines = src.splitlines()
+    for f in findings:
+        if 1 <= f.line <= len(lines):
+            dis = _suppressions(lines[f.line - 1])
+            if f.rule in dis or "all" in dis:
+                f.suppressed = True
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__",))
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        yield os.path.join(root, fn)
+
+
+def lint_paths(paths: Iterable[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as exc:
+            findings.append(Finding("parse-error", path, 0,
+                                    f"unreadable: {exc}"))
+            continue
+        findings.extend(lint_source(src, path))
+    return findings
+
+
+def unsuppressed(findings: Iterable[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.suppressed]

@@ -1,0 +1,493 @@
+"""Lint rules — the MPI-aware static checks.
+
+Each rule is a function ``(tree, parents, path) -> List[Finding]``
+over one parsed module; the runner (:mod:`ompi_tpu.check.lint`)
+builds the parent map, applies ``# check: disable=RULE``
+suppressions, and renders findings. Rules are deliberately
+conservative: any use of a handle the pass cannot prove dead counts
+as handled, so a finding is close to a real defect, not a style
+opinion (the MUST/Marmot bar, not the pylint bar).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+#: rule id -> one-line description (the ``check rules`` catalog)
+CATALOG: Dict[str, str] = {
+    "unwaited-request":
+        "a request-producing call (isend/irecv/*_init/I*) whose "
+        "result is dropped or bound to a name never used again — the "
+        "operation is never Waited, Tested, or freed",
+    "pready-outside-start":
+        "Pready on a partitioned request with no Start/start_all "
+        "between the psend_init and the Pready — partitions marked "
+        "ready outside an active partitioned region",
+    "rank-divergent-collective":
+        "a collective call on comm X lexically inside a branch whose "
+        "test reads X.rank — ranks can disagree on collective order "
+        "(deadlock/mismatch risk)",
+    "buffer-reuse-before-wait":
+        "a buffer handed to a nonblocking send is written again "
+        "before the request is Waited — the transfer may read the "
+        "new bytes",
+    "handle-leak":
+        "a comm/window/file handle created in a function and never "
+        "freed, closed, returned, stored, or passed on",
+    "bare-public-raise":
+        "raise ValueError/TypeError on an MPI API path (coll/, osc/, "
+        "shmem/, part/) — raise errors.MPIError(ERR_*) so the comm "
+        "errhandler sees it (a bare ValueError bypasses "
+        "_with_errhandler dispatch)",
+    "unregistered-pvar":
+        "pvar recorded under a literal name missing from "
+        "pvar.WELL_KNOWN — tools/info and the OpenMetrics sampler "
+        "will not export it at 0 (dynamic f-string families are "
+        "exempt)",
+    "unguarded-observability":
+        "direct call through an observability guard global "
+        "(FLIGHT/RECORDER/SANITIZER) with no enclosing None check — "
+        "hot paths must bind the guard once and branch on it",
+    "parse-error":
+        "the file does not parse; nothing else can be checked",
+}
+
+# -- call-name tables ----------------------------------------------------
+
+REQUEST_PRODUCERS = frozenset((
+    "isend", "irecv", "Isend", "Irecv", "Issend", "Isendrecv",
+    "Isendrecv_replace", "Send_init", "Recv_init",
+    "Ibarrier", "Ibcast", "Iallreduce", "Ireduce", "Igather",
+    "Iscatter", "Iallgather", "Ialltoall", "Igatherv", "Iscatterv",
+    "Iallgatherv", "Ialltoallv", "Iscan", "Iexscan",
+    "Ireduce_scatter", "Ireduce_scatter_block",
+    "Barrier_init", "Bcast_init", "Allreduce_init", "Reduce_init",
+    "Gather_init", "Scatter_init", "Allgather_init", "Alltoall_init",
+    "Reduce_scatter_block_init", "Allreduce_multi_init",
+    "Pallreduce_init", "Reduce_scatter_multi_init",
+    "Allgather_multi_init", "Preduce_scatter_init",
+    "psend_init", "precv_init", "Psend_init", "Precv_init",
+))
+
+PART_INIT = frozenset(("psend_init", "precv_init",
+                       "Psend_init", "Precv_init"))
+PREADY_NAMES = frozenset(("pready", "Pready", "pready_range",
+                          "Pready_range", "pready_list", "Pready_list"))
+START_NAMES = frozenset(("start", "Start", "start_all", "Start_all",
+                         "startall", "Startall"))
+
+COLLECTIVES = frozenset((
+    "Barrier", "barrier", "Bcast", "bcast", "Reduce", "reduce",
+    "Allreduce", "allreduce", "Gather", "gather", "Gatherv",
+    "Scatter", "scatter", "Scatterv", "Allgather", "allgather",
+    "Allgatherv", "Alltoall", "alltoall", "Alltoallv",
+    "Reduce_scatter", "Reduce_scatter_block", "Scan", "Exscan",
+    "Allreduce_multi", "Reduce_scatter_multi", "Allgather_multi",
+)) | REQUEST_PRODUCERS.difference((
+    "isend", "irecv", "Isend", "Irecv", "Issend", "Isendrecv",
+    "Isendrecv_replace", "Send_init", "Recv_init",
+    "psend_init", "precv_init", "Psend_init", "Precv_init",
+))
+
+NONBLOCKING_SENDS = frozenset(("isend", "Isend", "Issend",
+                               "Send_init", "psend_init",
+                               "Psend_init"))
+
+HANDLE_PRODUCERS = frozenset(("dup", "Dup", "split", "Split",
+                              "split_type", "Split_type",
+                              "create_group", "Create_group",
+                              "merge", "Merge",
+                              "win_create", "Win_create",
+                              "win_allocate", "Win_allocate"))
+HANDLE_PRODUCER_FNS = frozenset(("File_open", "win_create",
+                                 "win_allocate"))
+FREE_NAMES = frozenset(("free", "Free", "close", "Close",
+                        "disconnect", "Disconnect", "shutdown"))
+
+#: module globals carrying the one-branch disabled guard convention
+GUARD_GLOBALS = frozenset(("FLIGHT", "RECORDER", "SANITIZER"))
+
+#: path components marking the MPI-convention public API surface for
+#: bare-public-raise (the satellite scope: coll/, osc/, shmem/, part/)
+PUBLIC_API_DIRS = frozenset(("coll", "osc", "shmem", "part"))
+
+
+# -- shared walking helpers ----------------------------------------------
+
+def build_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    return {child: node for node in ast.walk(tree)
+            for child in ast.iter_child_nodes(node)}
+
+
+def _unparse(node: Optional[ast.AST]) -> str:
+    if node is None:
+        return ""
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — best-effort source rendering
+        return ""
+
+
+def _enclosing_scope(node: ast.AST, parents) -> ast.AST:
+    """Nearest enclosing function (or the module)."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Module)):
+            return cur
+        cur = parents.get(cur)
+    return node
+
+
+def _enclosing_stmt(node: ast.AST, parents) -> Optional[ast.stmt]:
+    cur: Optional[ast.AST] = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = parents.get(cur)
+    return cur
+
+
+def _method_call_name(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _loads_after(scope: ast.AST, name: str, line: int) -> List[ast.Name]:
+    return [n for n in ast.walk(scope)
+            if isinstance(n, ast.Name) and n.id == name
+            and isinstance(n.ctx, ast.Load)
+            and getattr(n, "lineno", 0) > line]
+
+
+# -- rules ---------------------------------------------------------------
+
+def rule_unwaited_request(tree, parents, path) -> List[Finding]:
+    out: List[Finding] = []
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if _method_call_name(call) not in REQUEST_PRODUCERS:
+            continue
+        stmt = _enclosing_stmt(call, parents)
+        if stmt is None:
+            continue
+        op = call.func.attr  # type: ignore[union-attr]
+        if isinstance(stmt, ast.Expr) and stmt.value is call:
+            out.append(Finding(
+                "unwaited-request", path, call.lineno,
+                f"result of {op}() dropped — the request is never "
+                "waited, tested, or freed"))
+            continue
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)) \
+                and stmt.value is call:
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+                continue  # attribute/subscript/tuple target: escapes
+            name = targets[0].id
+            if name == "_":
+                out.append(Finding(
+                    "unwaited-request", path, call.lineno,
+                    f"result of {op}() bound to '_' — the request is "
+                    "never waited, tested, or freed"))
+                continue
+            scope = _enclosing_scope(stmt, parents)
+            if not _loads_after(scope, name, stmt.lineno):
+                out.append(Finding(
+                    "unwaited-request", path, call.lineno,
+                    f"request from {op}() bound to '{name}' which is "
+                    "never used again — never waited, tested, or "
+                    "freed"))
+    return out
+
+
+def rule_pready_outside_start(tree, parents, path) -> List[Finding]:
+    out: List[Finding] = []
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if _method_call_name(call) not in PREADY_NAMES:
+            continue
+        recv = call.func.value  # type: ignore[union-attr]
+        if not isinstance(recv, ast.Name):
+            continue
+        req = recv.id
+        scope = _enclosing_scope(call, parents)
+        init_line = None
+        for other in ast.walk(scope):
+            if isinstance(other, ast.Assign) \
+                    and isinstance(other.value, ast.Call) \
+                    and _method_call_name(other.value) in PART_INIT \
+                    and any(isinstance(t, ast.Name) and t.id == req
+                            for t in other.targets) \
+                    and other.lineno < call.lineno:
+                init_line = other.lineno
+        if init_line is None:
+            continue  # request came from elsewhere: cannot see
+        started = False
+        for other in ast.walk(scope):
+            if not (isinstance(other, ast.Call)
+                    and init_line <= getattr(other, "lineno", 0)
+                    <= call.lineno):
+                continue
+            nm = _method_call_name(other)
+            if nm in START_NAMES and isinstance(
+                    other.func.value, ast.Name) \
+                    and other.func.value.id == req:
+                started = True
+            elif isinstance(other.func, ast.Name) \
+                    and other.func.id in START_NAMES \
+                    and req in _unparse(other):
+                started = True  # start_all([req, ...])
+        if not started:
+            out.append(Finding(
+                "pready-outside-start", path, call.lineno,
+                f"Pready on '{req}' with no Start/start_all between "
+                f"the psend_init (line {init_line}) and here — no "
+                "active partitioned region"))
+    return out
+
+
+def rule_rank_divergent_collective(tree, parents, path) -> List[Finding]:
+    out: List[Finding] = []
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if _method_call_name(call) not in COLLECTIVES:
+            continue
+        recv_src = _unparse(call.func.value)  # type: ignore[union-attr]
+        if not recv_src:
+            continue
+        cur = parents.get(call)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break  # stop at the enclosing function boundary
+            if isinstance(cur, (ast.If, ast.While)):
+                for sub in ast.walk(cur.test):
+                    if isinstance(sub, ast.Attribute) \
+                            and sub.attr == "rank" \
+                            and _unparse(sub.value) == recv_src:
+                        out.append(Finding(
+                            "rank-divergent-collective", path,
+                            call.lineno,
+                            f"{call.func.attr}() on '{recv_src}' "
+                            f"under a branch testing {recv_src}.rank "
+                            "(line %d) — ranks can diverge on "
+                            "collective order" % cur.lineno))
+                        break
+                else:
+                    cur = parents.get(cur)
+                    continue
+                break
+            cur = parents.get(cur)
+    return out
+
+
+def rule_buffer_reuse_before_wait(tree, parents, path) -> List[Finding]:
+    out: List[Finding] = []
+
+    def stores_of(stmt: ast.stmt) -> List[str]:
+        names: List[str] = []
+        if isinstance(stmt, ast.Assign):
+            tgts = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            tgts = [stmt.target]
+        else:
+            return names
+        for t in tgts:
+            if isinstance(t, ast.Name):
+                names.append(t.id)
+            elif isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Name):
+                names.append(t.value.id)
+        return names
+
+    def scan(body: List[ast.stmt]) -> None:
+        # linear scan of one sibling statement list: buffer name ->
+        # (request name or None, send op, line)
+        pending: Dict[str, Tuple[Optional[str], str, int]] = {}
+        for stmt in body:
+            src = _unparse(stmt)
+            done = [b for b, (req, _, _) in pending.items()
+                    if req is not None and req in src
+                    and ("wait" in src or "test" in src
+                         or "Wait" in src or "Test" in src)]
+            for b in done:
+                pending.pop(b, None)
+            for b in stores_of(stmt):
+                if b in pending:
+                    req, op, line = pending.pop(b)
+                    out.append(Finding(
+                        "buffer-reuse-before-wait", path, stmt.lineno,
+                        f"'{b}' written before the {op}() of line "
+                        f"{line} is waited — the transfer may read "
+                        "the new bytes"))
+            for call in ast.walk(stmt):
+                if isinstance(call, ast.Call) \
+                        and _method_call_name(call) \
+                        in NONBLOCKING_SENDS \
+                        and call.args \
+                        and isinstance(call.args[0], ast.Name):
+                    req = None
+                    if isinstance(stmt, ast.Assign) \
+                            and stmt.value is call \
+                            and len(stmt.targets) == 1 \
+                            and isinstance(stmt.targets[0], ast.Name):
+                        req = stmt.targets[0].id
+                    pending[call.args[0].id] = (
+                        req, call.func.attr, call.lineno)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Module)):
+            scan(node.body)
+    return out
+
+
+def rule_handle_leak(tree, parents, path) -> List[Finding]:
+    out: List[Finding] = []
+    for stmt in ast.walk(tree):
+        if not (isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Call)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            continue
+        call = stmt.value
+        produced = _method_call_name(call)
+        if produced in HANDLE_PRODUCERS:
+            what = produced
+        elif isinstance(call.func, ast.Name) \
+                and call.func.id in HANDLE_PRODUCER_FNS:
+            what = call.func.id
+        else:
+            continue
+        scope = _enclosing_scope(stmt, parents)
+        if isinstance(scope, ast.Module):
+            continue  # module-level handles live for the program
+        name = stmt.targets[0].id
+        handled = False
+        for use in _loads_after(scope, name, stmt.lineno):
+            parent = parents.get(use)
+            if isinstance(parent, ast.Attribute):
+                gp = parents.get(parent)
+                if isinstance(gp, ast.Call) and gp.func is parent:
+                    if parent.attr in FREE_NAMES:
+                        handled = True
+                        break
+                    continue  # plain method call: used, not released
+            handled = True  # returned / stored / passed on: escapes
+            break
+        if not handled:
+            out.append(Finding(
+                "handle-leak", path, stmt.lineno,
+                f"handle from {what}() bound to '{name}' is never "
+                "freed, closed, returned, stored, or passed on"))
+    return out
+
+
+def rule_bare_public_raise(tree, parents, path) -> List[Finding]:
+    parts = path.replace("\\", "/").split("/")
+    if not PUBLIC_API_DIRS.intersection(parts):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Raise) or node.exc is None:
+            continue
+        exc = node.exc
+        name = None
+        if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+            name = exc.func.id
+        elif isinstance(exc, ast.Name):
+            name = exc.id
+        if name not in ("ValueError", "TypeError"):
+            continue
+        out.append(Finding(
+            "bare-public-raise", path, node.lineno,
+            f"raise {name} on an MPI API path — raise "
+            "errors.MPIError(ERR_*) so the comm errhandler sees it"))
+    return out
+
+
+def rule_unregistered_pvar(tree, parents, path) -> List[Finding]:
+    from ompi_tpu.core import pvar
+
+    known = set(pvar.WELL_KNOWN)
+    out: List[Finding] = []
+    for call in ast.walk(tree):
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("record", "record_hwm", "timer")
+                and "pvar" in _unparse(call.func.value)):
+            continue
+        if not (call.args and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            continue  # dynamic name families are exempt
+        name = call.args[0].value
+        reg = name + "_ns" if call.func.attr == "timer" else name
+        if reg not in known:
+            out.append(Finding(
+                "unregistered-pvar", path, call.lineno,
+                f"pvar '{reg}' is not in pvar.WELL_KNOWN — it will "
+                "not export at 0 before first use"))
+    return out
+
+
+def rule_unguarded_observability(tree, parents, path) -> List[Finding]:
+    out: List[Finding] = []
+    for call in ast.walk(tree):
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)):
+            continue
+        base = call.func.value
+        guard = None
+        if isinstance(base, ast.Attribute) and base.attr in GUARD_GLOBALS:
+            guard = base.attr
+        elif isinstance(base, ast.Name) and base.id in GUARD_GLOBALS:
+            guard = base.id
+        if guard is None:
+            continue
+        cur = parents.get(call)
+        protected = False
+        while cur is not None and not protected:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            if isinstance(cur, (ast.If, ast.While, ast.Assert)) \
+                    and guard in _unparse(cur.test):
+                protected = True
+            if isinstance(cur, ast.IfExp) and guard in _unparse(cur.test):
+                protected = True
+            cur = parents.get(cur)
+        if not protected:
+            out.append(Finding(
+                "unguarded-observability", path, call.lineno,
+                f"direct call through {guard} with no enclosing None "
+                "check — bind the guard once and branch on it (the "
+                "one-branch disabled-guard convention)"))
+    return out
+
+
+RULES = (
+    rule_unwaited_request,
+    rule_pready_outside_start,
+    rule_rank_divergent_collective,
+    rule_buffer_reuse_before_wait,
+    rule_handle_leak,
+    rule_bare_public_raise,
+    rule_unregistered_pvar,
+    rule_unguarded_observability,
+)

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ompi_tpu import errors
 from ompi_tpu import op as op_mod
 from ompi_tpu.accelerator import current as acc_current
 from ompi_tpu.coll import CollModule, framework
@@ -142,7 +143,8 @@ def alltoall_dev(comm, sendbuf):
     pvar.record("coll_accelerator_staged")
     host = _stage_in(sendbuf)
     if host.size % comm.size:
-        raise ValueError(
+        raise errors.MPIError(
+            errors.ERR_COUNT,
             f"alltoall: {host.size} elements not divisible by "
             f"comm size {comm.size}")
     recv = np.empty_like(host)
@@ -156,7 +158,8 @@ def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
     host = _stage_in(sendbuf)
     n = comm.size
     if host.shape[0] % n:
-        raise ValueError(
+        raise errors.MPIError(
+            errors.ERR_COUNT,
             f"reduce_scatter_block: dim0 {host.shape[0]} not "
             f"divisible by comm size {n}")
     recv = np.empty((host.shape[0] // n,) + host.shape[1:], host.dtype)
@@ -226,7 +229,8 @@ def reduce_scatter_dev(comm, sendbuf, counts, op=op_mod.SUM,
     host = _stage_in(sendbuf)
     counts = [int(c) for c in counts]
     if sum(counts) != host.shape[0]:
-        raise ValueError(
+        raise errors.MPIError(
+            errors.ERR_COUNT,
             f"reduce_scatter: counts sum to {sum(counts)} but sendbuf "
             f"dim0 is {host.shape[0]}")
     recv = np.empty((counts[comm.rank],) + host.shape[1:], host.dtype)
@@ -263,7 +267,8 @@ def scatter_dev(comm, sendbuf, root=0, like=None):
     if comm.rank == root:
         host = _stage_in(sendbuf)
         if host.shape[0] % n:
-            raise ValueError(
+            raise errors.MPIError(
+                errors.ERR_COUNT,
                 f"scatter: dim0 {host.shape[0]} not divisible "
                 f"by comm size {n}")
         k = host.shape[0] // n
@@ -328,7 +333,8 @@ def neighbor_alltoall_dev(comm, sendbuf):
     ins = comm.topo.in_neighbors(comm.rank)
     outs = comm.topo.out_neighbors(comm.rank)
     if host.shape[0] != len(outs):
-        raise ValueError(
+        raise errors.MPIError(
+            errors.ERR_COUNT,
             f"neighbor_alltoall: sendbuf dim0 {host.shape[0]} != "
             f"out-degree {len(outs)}")
     recv = np.zeros((len(ins),) + host.shape[1:], host.dtype)

@@ -696,7 +696,8 @@ def gather_dev(comm, sendbuf, root: int = 0):
 
 def _alltoall_prep(comm, sendbuf):
     if sendbuf.shape[0] % comm.size:
-        raise ValueError(
+        raise errors.MPIError(
+            errors.ERR_COUNT,
             f"alltoall: dim0 {sendbuf.shape[0]} not divisible by "
             f"comm size {comm.size}")
     from ompi_tpu.parallel import collectives as C
@@ -739,7 +740,8 @@ def _reduce_scatter_block_prep(comm, sendbuf, op=op_mod.SUM,
                                deterministic: Optional[str] = None):
     det = _det(deterministic)
     if sendbuf.shape[0] % comm.size:
-        raise ValueError(
+        raise errors.MPIError(
+            errors.ERR_COUNT,
             f"reduce_scatter_block: dim0 {sendbuf.shape[0]} not "
             f"divisible by comm size {comm.size}")
     from ompi_tpu.parallel import collectives as C
@@ -805,7 +807,8 @@ def _scatter_meta(comm, key, root: int, root_meta):
             comm.coll.bcast_obj(comm, root_meta, root)
             cache[key] = root_meta
         elif cached != root_meta:
-            raise ValueError(
+            raise errors.MPIError(
+                errors.ERR_ARG,
                 f"{key}: buffer signature changed {cached} -> "
                 f"{root_meta} after the metadata round was cached. "
                 "Non-root peers reuse the cached shape and are "
@@ -852,7 +855,8 @@ def scatter_dev(comm, sendbuf, root: int = 0, like=None):
                                      None)
         x = ctx0.jax.device_put(jnp.zeros(shape, dtype), ctx0.my)
     if x.shape[0] % comm.size:
-        raise ValueError(
+        raise errors.MPIError(
+            errors.ERR_COUNT,
             f"scatter: dim0 {x.shape[0]} not divisible by comm size "
             f"{comm.size}")
     from ompi_tpu.parallel import collectives as C
@@ -895,8 +899,9 @@ def scatterv_dev(comm, sendbuf, counts, root: int = 0, like=None):
     if comm.size == 1:
         return sendbuf
     if len(counts) != comm.size:
-        raise ValueError(f"scatterv: {len(counts)} counts for "
-                         f"{comm.size} ranks")
+        raise errors.MPIError(
+            errors.ERR_COUNT,
+            f"scatterv: {len(counts)} counts for {comm.size} ranks")
     import jax.numpy as jnp
     from jax import lax
 
@@ -963,8 +968,9 @@ def allgatherv_dev(comm, sendbuf, counts):
     if comm.size == 1:
         return sendbuf
     if len(counts) != comm.size:
-        raise ValueError(f"allgatherv: {len(counts)} counts for "
-                         f"{comm.size} ranks")
+        raise errors.MPIError(
+            errors.ERR_COUNT,
+            f"allgatherv: {len(counts)} counts for {comm.size} ranks")
     import jax.numpy as jnp
     from jax import lax
 
@@ -1040,7 +1046,8 @@ def alltoallv_dev(comm, sendbuf, scounts, rcounts, max_count=None):
     else:
         m = int(max_count)
         if max(max(scounts), max(rcounts)) > m:
-            raise ValueError(
+            raise errors.MPIError(
+                errors.ERR_COUNT,
                 f"alltoallv: max_count {m} below local max "
                 f"{max(max(scounts), max(rcounts))}")
     pvar.record("coll_xla_device")  # after the fallback decision, so
@@ -1865,7 +1872,8 @@ class PartitionedAllreduceRequest:
         if value is not None:
             shape, dtype, _nb = self._metas[idx]
             if tuple(value.shape) != shape or str(value.dtype) != dtype:
-                raise ValueError(
+                raise errors.MPIError(
+                    errors.ERR_ARG,
                     f"Pready({idx}): value {tuple(value.shape)}/"
                     f"{value.dtype} does not match the bound template "
                     f"leaf {shape}/{dtype} (compiled programs are "

@@ -30,6 +30,7 @@ from typing import List, Tuple
 
 import numpy as np
 
+from ompi_tpu import errors
 from ompi_tpu.core import pvar
 from ompi_tpu.pml.request import PROC_NULL
 
@@ -113,7 +114,8 @@ def _edges_alltoall(topo, n: int):
                 continue
             q = out_slots.get((s, d))
             if not q:
-                raise ValueError(
+                raise errors.MPIError(
+                    errors.ERR_TOPOLOGY,
                     f"inconsistent topology: rank {d} lists {s} as an "
                     f"in-neighbor more times than {s} lists {d} "
                     "outbound")
@@ -217,7 +219,8 @@ def neighbor_alltoall_dev(comm, sendbuf):
     my_out = len(topo.out_neighbors(comm.rank))
     my_in = len(topo.in_neighbors(comm.rank))
     if sendbuf.shape[0] != my_out:
-        raise ValueError(
+        raise errors.MPIError(
+            errors.ERR_COUNT,
             f"neighbor_alltoall: sendbuf dim0 {sendbuf.shape[0]} != "
             f"out-degree {my_out}")
     edges, max_in, max_out = _edges_alltoall(topo, n)

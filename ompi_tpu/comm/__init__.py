@@ -303,6 +303,8 @@ class Communicator(AttrHost):
         self.__dict__.pop("_part_epochs", None)
         with _comms_lock:
             _comms.pop(self.cid, None)
+        # the check-plane sanitizer flags any later call on this comm
+        self._freed = True
 
     # -- ULFM (reference: ompi/communicator/ft) ---------------------------
     def revoke(self) -> None:

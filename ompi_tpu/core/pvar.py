@@ -76,6 +76,38 @@ WELL_KNOWN = (
     # monitoring_bytes stay alongside)
     "monitoring_p2p_msgs", "monitoring_p2p_bytes",
     "monitoring_coll_msgs", "monitoring_coll_bytes",
+    "monitoring_msgs", "monitoring_bytes",
+    # check/ plane (runtime MPI sanitizer): argument/signature
+    # violations raised, leaked requests reported at Finalize,
+    # cross-rank fingerprint exchanges performed at level 2
+    "check_violations", "check_leaks", "check_sig_exchanges",
+    "memchecker_violations",
+    # every remaining literal recorded anywhere in the framework —
+    # the check plane's unregistered-pvar lint rule enforces that
+    # this tuple stays the single source of truth, so tools/info and
+    # the OpenMetrics sampler export each name at 0 before first use
+    "accel_p2p_send", "accel_p2p_recv",
+    "adapt_ibcast", "adapt_ireduce",
+    "coll_accelerator_staged", "coll_xla_device",
+    "coll_xla_a2av_meta_cached", "coll_xla_alltoallv_fallback",
+    "coll_xla_fns_size", "coll_xla_plans_size",
+    "file_open", "file_read_bytes", "file_write_bytes",
+    "han_allgather", "han_allreduce", "han_barrier", "han_bcast",
+    "han_reduce",
+    "inter_allgather", "inter_allreduce", "inter_barrier",
+    "inter_bcast",
+    "mem_hooks_released", "mpool_hits", "mpool_misses",
+    "neighbor_allgather", "neighbor_allgatherv", "neighbor_alltoall",
+    "neighbor_alltoallv",
+    "osc_put", "osc_get", "osc_acc", "osc_fence",
+    "osc_device_epoch_op",
+    "rcache_hits", "rcache_evictions",
+    "rndv_frag", "rndv_sc",
+    "shmem_alloc_bytes", "shmem_put", "shmem_get", "shmem_atomic",
+    "smsc_bytes", "smsc_single_copies",
+    "spawned_procs", "sync_injected_barriers",
+    "telemetry_inflight",
+    "vprotocol_logged_sends", "vprotocol_resends",
 )
 
 

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ompi_tpu import errors
 from ompi_tpu import op as op_mod
 from ompi_tpu import pml
 from ompi_tpu.attr import AttrHost
@@ -427,7 +428,8 @@ class Window(AttrHost):
         mirror since the last call — call at epoch boundaries (after
         Fence/Wait/Unlock) to hand the window back to compiled code."""
         if self._dev_like is None:
-            raise ValueError(
+            raise errors.MPIError(
+                errors.ERR_WIN,
                 "device_array() on a host window: create the window "
                 "over a jax array (win_create accepts device buffers)")
         from ompi_tpu import accelerator
@@ -707,7 +709,8 @@ class DynamicWindow(Window):
         in it directly); returns its address in this window."""
         if not (isinstance(arr, np.ndarray)
                 and arr.flags["C_CONTIGUOUS"]):
-            raise ValueError(
+            raise errors.MPIError(
+                errors.ERR_BUFFER,
                 "Win_attach needs a C-contiguous ndarray (RMA writes "
                 "land in the attached memory itself)")
         with self._local_mutex:
@@ -732,7 +735,8 @@ class DynamicWindow(Window):
                 off = disp - start
                 flat = arr.view(np.uint8).reshape(-1)[off:off + span]
                 return flat.view(dt)[::stride]
-        raise ValueError(
+        raise errors.MPIError(
+            errors.ERR_ARG,
             f"dynamic window {self.name}: [{disp}, {disp + span}) "
             "is not within any attached region")
 
@@ -752,7 +756,8 @@ class SharedWindow(Window):
 
         hosts = comm.coll.allgather_obj(comm, rte.hostname())
         if len(set(hosts)) != 1:
-            raise ValueError(
+            raise errors.MPIError(
+                errors.ERR_ARG,
                 "Win_allocate_shared: members span hosts "
                 f"{sorted(set(hosts))}; use comm.split_type('shared') "
                 "to get a node-local communicator first")

@@ -32,6 +32,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ompi_tpu import errors
 from ompi_tpu.core import pvar
 
 
@@ -107,7 +108,8 @@ class DeviceEpochWindow:
         # fusable = exactly what the fence program can apply as one
         # scatter-update (_APPLY keys; "put" is Put's own marker)
         if kind == "put" or kind not in self._APPLY:
-            raise ValueError(
+            raise errors.MPIError(
+                errors.ERR_OP,
                 f"device-epoch accumulate op {name!r} not fusable; "
                 "use the host Window AM path for exotic ops")
         pvar.record("osc_device_epoch_op")

@@ -48,7 +48,9 @@ MAX_TAG = 1024  # keeps the encoded tag within int32 (see module doc)
 
 def _part_tag(user_tag: int, epoch: int, idx: int) -> int:
     if not 0 <= user_tag < MAX_TAG:
-        raise ValueError(f"partitioned tag must be in [0,{MAX_TAG})")
+        raise errors.MPIError(
+            errors.ERR_TAG,
+            f"partitioned tag must be in [0,{MAX_TAG})")
     return _PART_BASE - (((user_tag << 8) | (epoch & 0xFF))
                          * MAX_PARTITIONS + idx)
 
@@ -71,17 +73,21 @@ class _PartitionedBase(rq.Request):
                  tag: int) -> None:
         super().__init__()
         if partitions < 1 or partitions > MAX_PARTITIONS:
-            raise ValueError(f"partitions must be in [1,{MAX_PARTITIONS}]")
+            raise errors.MPIError(
+                errors.ERR_COUNT,
+                f"partitions must be in [1,{MAX_PARTITIONS}]")
         arr = np.asarray(buf)
         if not arr.flags.c_contiguous:
             # reshape(-1) would copy: partition views must alias the
             # user's buffer (recv data lands in them; send reads them
             # at Pready time) — same contract the Convertor enforces
-            raise ValueError(
+            raise errors.MPIError(
+                errors.ERR_BUFFER,
                 "partitioned buffers must be C-contiguous")
         flat = arr.reshape(-1)
         if flat.size % partitions:
-            raise ValueError(
+            raise errors.MPIError(
+                errors.ERR_COUNT,
                 f"buffer of {flat.size} elements not divisible into "
                 f"{partitions} partitions")
         self.persistent = True

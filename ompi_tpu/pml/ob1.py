@@ -35,7 +35,8 @@ import numpy as np
 
 from ompi_tpu import errors
 from ompi_tpu.btl import base as btl_base
-from ompi_tpu.core import arch, events, memchecker, mpool, output, pvar
+from ompi_tpu.check import memchecker
+from ompi_tpu.core import arch, events, mpool, output, pvar
 from ompi_tpu.datatype import BYTE, Convertor
 from ompi_tpu.datatype.convertor import dtype_of
 from ompi_tpu.pml import custommatch, peruse

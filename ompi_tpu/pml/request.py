@@ -11,7 +11,8 @@ from __future__ import annotations
 import itertools
 from typing import Any, List, Optional, Sequence
 
-from ompi_tpu.core import memchecker, progress
+from ompi_tpu.check import memchecker
+from ompi_tpu.core import progress
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -137,7 +138,7 @@ class Request:
         self.status.error = error
         self.completed = True
         # memchecker: a completed receive's bytes become defined
-        # (no-op unless shadow intervals exist — see core/memchecker)
+        # (no-op unless shadow intervals exist — see check/memchecker)
         memchecker.mark_defined(self.id)
 
     def test(self) -> bool:

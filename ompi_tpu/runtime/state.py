@@ -120,6 +120,17 @@ def init_instance() -> None:
                 _telemetry.start(rank=rte.rank)
             except Exception as exc:  # telemetry must never sink init
                 _out.verbose(0, "telemetry enable failed: %r", exc)
+        # correctness plane (cvar check_level / OMPI_TPU_CHECK): the
+        # runtime sanitizer interposes on the API dispatch table, so
+        # it comes up last — after every plane that wraps methods —
+        # and validates calls before the PML/coll layers see them
+        from ompi_tpu import check as _check
+
+        if _check.requested():
+            try:
+                _check.start(rank=rte.rank)
+            except Exception as exc:  # checking must never sink init
+                _out.verbose(0, "check enable failed: %r", exc)
         _instance_up = True
         atexit.register(_atexit_finalize)
 
@@ -163,6 +174,14 @@ def _release() -> None:
 
             try:
                 _telemetry.stop()
+            except Exception:
+                pass
+            # sanitizer after telemetry (its leak report already ran
+            # from the Finalize hook), before the transports die
+            from ompi_tpu import check as _check
+
+            try:
+                _check.stop()
             except Exception:
                 pass
             from ompi_tpu import pml

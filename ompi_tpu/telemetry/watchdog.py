@@ -227,6 +227,14 @@ class Watchdog:
             "inflight": fl.snapshot(),
             "pvars": pvar.snapshot(),
         }
+        # a collective signature mismatch the check-plane sanitizer
+        # observed is the likeliest root cause of this hang — put it
+        # next to the verdict (optional key, same dump schema)
+        from ompi_tpu.check import sanitizer as _check_san
+
+        san = _check_san.SANITIZER
+        if san is not None and san.last_mismatch is not None:
+            doc["check_mismatch"] = san.last_mismatch
         from ompi_tpu.trace import recorder as _trace
 
         rec = _trace.RECORDER

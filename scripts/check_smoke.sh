@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Correctness-plane smoke lane, two halves:
+#   1. the static lint over the framework + examples exits 0 with
+#      zero suppressions (the tree lints clean);
+#   2. a 2-rank job under check_level=2 seeds a rank-dependent
+#      Allreduce count — the sanitizer must raise a named MPIError
+#      (op, seq, both ranks' signatures) on BOTH ranks immediately,
+#      long before the watchdog's hang timeout would fire, and the
+#      job must then complete a matched collective and finalize.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${1:-check_smoke_out}"
+rm -rf "$out"
+mkdir -p "$out"
+
+echo "== static lint: ompi_tpu + examples must be clean =="
+JAX_PLATFORMS=cpu python -m ompi_tpu.check lint ompi_tpu examples
+
+cat > "$out/mismatch_job.py" <<'EOF'
+import sys
+
+import numpy as np
+
+from ompi_tpu import errors, mpi
+
+world = mpi.Init()
+me = world.rank
+try:
+    # the seeded defect: ranks disagree on the Allreduce count
+    world.Allreduce(np.ones(me + 1, np.float32))
+except errors.MPIError as exc:
+    msg = str(exc)
+    assert "signature mismatch" in msg, msg
+    assert "Allreduce" in msg and "seq 1" in msg, msg
+    assert "rank 0" in msg and "rank 1" in msg, msg
+    print(f"rank {me}: sanitizer caught it: {msg}")
+else:
+    print(f"rank {me}: sanitizer MISSED the mismatch", file=sys.stderr)
+    sys.exit(1)
+# matched traffic still flows after the diagnosis
+assert world.allreduce(1) == world.size
+mpi.Finalize()
+EOF
+
+# telemetry is on with a LONG hang timeout: the run must finish far
+# inside the launcher timeout because the sanitizer raises at the
+# call — if the mismatch ever reached the PML and hung, the watchdog
+# would not save this lane, the timeout would fail it
+JAX_PLATFORMS=cpu python -m ompi_tpu.runtime.launcher -n 2 \
+  --timeout 120 \
+  --mca check_level 2 \
+  --mca telemetry_enable 1 \
+  --mca telemetry_hang_timeout 600 \
+  --mca telemetry_dump_dir "$out" \
+  "$out/mismatch_job.py" | tee "$out/job.log"
+
+python - "$out" <<'EOF'
+import glob
+import sys
+
+out = sys.argv[1]
+log = open(out + "/job.log").read()
+for r in (0, 1):
+    assert f"rank {r}: sanitizer caught it" in log, (
+        f"rank {r} never reported the mismatch:\n{log}")
+assert log.count("signature mismatch") >= 2, log
+dumps = glob.glob(out + "/ompi_tpu_hang_rank*_seq*.json")
+assert not dumps, f"sanitizer should preempt any hang dump: {dumps}"
+print("check smoke OK: both ranks named the mismatched Allreduce "
+      "(seq 1) at the call; no hang, no dump")
+EOF

@@ -1,0 +1,480 @@
+"""check/ correctness-plane tests: one positive + one negative fixture
+per lint rule, suppression comments, the CLI exit-code contract, the
+runtime sanitizer's param checks / request registry / leak report, the
+in-process cross-rank signature-matching protocol (the watchdog's
+injectable-collaborator test discipline), the hang-dump integration,
+and the zero-overhead contract at check_level=0."""
+
+import json
+import textwrap
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from ompi_tpu import check, errors
+from ompi_tpu.check import lint
+from ompi_tpu.check import sanitizer as san_mod
+from ompi_tpu.check.sanitizer import Sanitizer
+from ompi_tpu.core import pvar
+from ompi_tpu.runtime import kvstore
+from ompi_tpu.telemetry import flight
+from ompi_tpu.telemetry.watchdog import Watchdog
+from tests.harness import run_ranks
+
+
+def _lint(src, path="prog.py", rule=None):
+    fs = lint.lint_source(textwrap.dedent(src), path)
+    if rule is not None:
+        fs = [f for f in fs if f.rule == rule]
+    return fs
+
+
+# -- lint rules: one positive + one negative each ------------------------
+
+def test_unwaited_request_dropped_and_named():
+    fs = _lint("""
+        def f(comm, buf):
+            comm.isend(buf, dest=1)
+    """, rule="unwaited-request")
+    assert len(fs) == 1 and "isend" in fs[0].message
+    fs = _lint("""
+        def f(comm, buf):
+            r = comm.irecv(buf, source=0)
+    """, rule="unwaited-request")
+    assert len(fs) == 1 and "'r'" in fs[0].message
+
+
+def test_unwaited_request_negative_waited_or_returned():
+    assert _lint("""
+        def f(comm, buf):
+            r = comm.isend(buf, dest=1)
+            r.wait()
+    """, rule="unwaited-request") == []
+    # a returned request escapes the scope: the caller owns it
+    assert _lint("""
+        def f(comm, buf):
+            return comm.isend(buf, dest=1)
+    """, rule="unwaited-request") == []
+
+
+def test_pready_outside_start_positive():
+    fs = _lint("""
+        def f(comm, buf):
+            req = comm.psend_init(buf, 4, dest=1)
+            req.pready(0)
+            req.wait()
+    """, rule="pready-outside-start")
+    assert len(fs) == 1 and "no Start" in fs[0].message
+
+
+def test_pready_after_start_negative():
+    assert _lint("""
+        def f(comm, buf):
+            req = comm.psend_init(buf, 4, dest=1)
+            req.start()
+            req.pready(0)
+            req.wait()
+    """, rule="pready-outside-start") == []
+
+
+def test_rank_divergent_collective_positive():
+    fs = _lint("""
+        def f(comm, x):
+            if comm.rank == 0:
+                comm.bcast(x)
+    """, rule="rank-divergent-collective")
+    assert len(fs) == 1 and "comm.rank" in fs[0].message
+
+
+def test_rank_divergent_negative_other_comms_rank():
+    # branching on a DIFFERENT comm's rank says nothing about
+    # collective order on this one
+    assert _lint("""
+        def f(comm, other, x):
+            if other.rank == 0:
+                comm.bcast(x)
+    """, rule="rank-divergent-collective") == []
+
+
+def test_buffer_reuse_before_wait_positive():
+    fs = _lint("""
+        def f(comm, buf, new):
+            req = comm.Isend(buf, dest=1)
+            buf = new
+            req.wait()
+    """, rule="buffer-reuse-before-wait")
+    assert len(fs) == 1 and "'buf'" in fs[0].message
+
+
+def test_buffer_reuse_after_wait_negative():
+    assert _lint("""
+        def f(comm, buf, new):
+            req = comm.Isend(buf, dest=1)
+            req.wait()
+            buf = new
+    """, rule="buffer-reuse-before-wait") == []
+
+
+def test_handle_leak_positive():
+    fs = _lint("""
+        def f(comm):
+            sub = comm.split(1)
+            sub.bcast(0)
+    """, rule="handle-leak")
+    assert len(fs) == 1 and "split" in fs[0].message
+
+
+def test_handle_freed_or_escaping_negative():
+    assert _lint("""
+        def f(comm):
+            sub = comm.split(1)
+            sub.bcast(0)
+            sub.free()
+    """, rule="handle-leak") == []
+    assert _lint("""
+        def f(comm):
+            sub = comm.dup()
+            return sub
+    """, rule="handle-leak") == []
+
+
+def test_bare_public_raise_is_path_scoped():
+    src = """
+        def g(n):
+            if n < 0:
+                raise ValueError("bad")
+    """
+    fs = _lint(src, path="ompi_tpu/coll/x.py", rule="bare-public-raise")
+    assert len(fs) == 1 and "MPIError" in fs[0].message
+    assert _lint(src, path="ompi_tpu/util/x.py",
+                 rule="bare-public-raise") == []
+
+
+def test_unregistered_pvar_literal_only():
+    fs = _lint("""
+        from ompi_tpu.core import pvar
+
+        def f():
+            pvar.record("definitely_not_registered_xyz")
+    """, rule="unregistered-pvar")
+    assert len(fs) == 1 and "WELL_KNOWN" in fs[0].message
+    # registered names and dynamic f-string families are clean
+    assert _lint("""
+        from ompi_tpu.core import pvar
+
+        def f(op):
+            pvar.record("allreduce")
+            pvar.record(f"trace_hist_{op}")
+    """, rule="unregistered-pvar") == []
+
+
+def test_unguarded_observability_positive_and_guarded():
+    fs = _lint("""
+        from ompi_tpu.telemetry import flight
+
+        def f():
+            flight.FLIGHT.enter("x")
+    """, rule="unguarded-observability")
+    assert len(fs) == 1 and "FLIGHT" in fs[0].message
+    assert _lint("""
+        from ompi_tpu.telemetry import flight
+
+        def f():
+            if flight.FLIGHT is not None:
+                flight.FLIGHT.enter("x")
+
+        def g():
+            fl = flight.FLIGHT
+            if fl is not None:
+                fl.enter("x")
+    """, rule="unguarded-observability") == []
+
+
+def test_suppression_comment_marks_not_hides():
+    fs = _lint("""
+        def f(comm, buf):
+            comm.isend(buf, dest=1)  # check: disable=unwaited-request
+    """)
+    assert [f.rule for f in fs] == ["unwaited-request"]
+    assert fs[0].suppressed and lint.unsuppressed(fs) == []
+    # disable=all on the line suppresses every rule there
+    fs = _lint("""
+        def f(comm, buf):
+            comm.isend(buf, dest=1)  # check: disable=all
+    """)
+    assert fs and all(f.suppressed for f in fs)
+
+
+def test_parse_error_is_a_finding():
+    fs = lint.lint_source("def f(:\n", "bad.py")
+    assert [f.rule for f in fs] == ["parse-error"]
+
+
+def test_framework_self_lint_clean():
+    """The plane lints itself clean — the CI lane's contract, scoped
+    to the check/ tree so the test stays fast."""
+    assert lint.unsuppressed(lint.lint_paths(["ompi_tpu/check"])) == []
+
+
+# -- CLI -----------------------------------------------------------------
+
+def test_cli_lint_exit_codes(tmp_path, capsys):
+    from ompi_tpu.check.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(comm, buf):\n    comm.isend(buf, dest=1)\n")
+    good = tmp_path / "good.py"
+    good.write_text("def f(comm, buf):\n"
+                    "    r = comm.isend(buf, dest=1)\n"
+                    "    r.wait()\n")
+    assert main(["lint", str(bad)]) == 1
+    out = capsys.readouterr()
+    assert "unwaited-request" in out.out and "1 finding(s)" in out.err
+    assert main(["lint", str(good)]) == 0
+    assert main(["lint", str(tmp_path / "missing.py")]) == 1
+    assert "no such path" in capsys.readouterr().err
+
+
+def test_cli_rules_prints_catalog(capsys):
+    from ompi_tpu.check.__main__ import main
+
+    assert main(["rules"]) == 0
+    out = capsys.readouterr().out
+    assert "unwaited-request" in out and "disable=" in out
+
+
+# -- level() knob --------------------------------------------------------
+
+def test_level_env_parsing(monkeypatch):
+    monkeypatch.delenv("OMPI_TPU_CHECK", raising=False)
+    assert check.level() == 0 and not check.requested()
+    monkeypatch.setenv("OMPI_TPU_CHECK", "2")
+    assert check.level() == 2
+    monkeypatch.setenv("OMPI_TPU_CHECK", "7")
+    assert check.level() == 2  # clamped
+    monkeypatch.setenv("OMPI_TPU_CHECK", "yes")
+    assert check.level() == 1  # bare truthy means level 1
+    monkeypatch.setenv("OMPI_TPU_CHECK", "off")
+    assert check.level() == 0
+
+
+# -- sanitizer: param checks ---------------------------------------------
+
+def _comm(size=4, freed=False):
+    return types.SimpleNamespace(size=size, _freed=freed, cid=1, rank=0)
+
+
+def test_check_call_bounds_and_freed_comm():
+    s = Sanitizer(rank=0, level=1)
+    with pytest.raises(errors.MPIError) as ei:
+        s.check_call("Bcast", _comm(), (np.zeros(2),), {"root": 9})
+    assert ei.value.error_class == errors.ERR_ROOT
+    with pytest.raises(errors.MPIError) as ei:
+        s.check_call("Send", _comm(), (np.zeros(2), 4), {})
+    assert ei.value.error_class == errors.ERR_RANK
+    with pytest.raises(errors.MPIError) as ei:
+        s.check_call("Send", _comm(), (np.zeros(2), 1), {"tag": -3})
+    assert ei.value.error_class == errors.ERR_TAG
+    with pytest.raises(errors.MPIError) as ei:
+        s.check_call("Scatterv", _comm(),
+                     (np.zeros(4), np.zeros(1), [1, -2, 1, 1]), {})
+    assert ei.value.error_class == errors.ERR_COUNT
+    with pytest.raises(errors.MPIError) as ei:
+        s.check_call("Bcast", _comm(freed=True), (np.zeros(2),), {})
+    assert ei.value.error_class == errors.ERR_COMM
+    # clean calls pass: ANY_TAG is legal on the receive side
+    s.check_call("Bcast", _comm(), (np.zeros(2),), {"root": 3})
+    s.check_call("Recv", _comm(), (np.zeros(2),), {"source": 1,
+                                                   "tag": -1})
+    assert pvar.read("check_violations") >= 5
+
+
+# -- sanitizer: request registry -----------------------------------------
+
+class _Req:
+    def __init__(self, id=1, persistent=False):
+        self.id = id
+        self.persistent = persistent
+
+
+def test_use_after_free_raises_at_the_call():
+    s = Sanitizer(rank=0, level=1)
+    r = _Req(id=7)
+    s.track(r)
+    s.on_free(r)
+    with pytest.raises(errors.MPIError) as ei:
+        s.on_wait(r)
+    assert ei.value.error_class == errors.ERR_REQUEST
+    assert "use after free" in str(ei.value)
+    with pytest.raises(errors.MPIError):
+        s.on_start(r)
+
+
+def test_leak_report_names_persistent_and_incomplete():
+    s = Sanitizer(rank=0, level=1)
+    leaked_p = _Req(id=1, persistent=True)   # never freed
+    leaked_n = _Req(id=2)                    # never completed
+    clean = _Req(id=3)
+    for r in (leaked_p, leaked_n, clean):
+        s.track(r)
+    s.on_complete(clean)
+    before = pvar.read("check_leaks")
+    leaks = s.leak_report()
+    assert sorted(l["id"] for l in leaks) == [1, 2]
+    whys = {l["id"]: l["why"] for l in leaks}
+    assert "never freed" in whys[1] and "never completed" in whys[2]
+    assert pvar.read("check_leaks") == before + 2
+    # freeing settles both: a second report is clean
+    s.on_free(leaked_p)
+    s.on_free(leaked_n)
+    assert s.leak_report() == []
+
+
+# -- sanitizer: cross-rank signature matching ----------------------------
+
+@pytest.fixture
+def store():
+    st = kvstore.Store().start()
+    yield st
+    st.stop()
+
+
+def test_signature_mismatch_raises_on_both_ranks(store):
+    c0, c1 = kvstore.Client(store.addr), kvstore.Client(store.addr)
+    s0 = Sanitizer(rank=0, world=[0, 1], jobid="t", client=c0,
+                   level=2, match_timeout=20)
+    s1 = Sanitizer(rank=1, world=[0, 1], jobid="t", client=c1,
+                   level=2, match_timeout=20)
+    errs = {}
+
+    def go(s, count_hash):
+        try:
+            s.match_collective("Allreduce", cid=0, dtype="float32",
+                               count_hash=count_hash)
+        except errors.MPIError as exc:
+            errs[s.rank] = str(exc)
+
+    t = threading.Thread(target=go, args=(s1, 8))
+    t.start()
+    go(s0, 4)
+    t.join()
+    # BOTH sides raise, naming op, seq, and the divergent ranks
+    assert set(errs) == {0, 1}
+    assert "Allreduce" in errs[0] and "seq 1" in errs[0]
+    assert "rank 0" in errs[0] and "rank 1" in errs[0]
+    assert s0.last_mismatch["peer"] == 1
+    assert s1.last_mismatch["peer"] == 0
+    # a matched round on the same comm then proceeds clean at seq 2
+    t = threading.Thread(target=s1.match_collective,
+                         args=("Bcast", 0, "any", 0))
+    t.start()
+    s0.match_collective("Bcast", 0, "any", 0)
+    t.join()
+    assert s0.last_mismatch["seq"] == 1  # unchanged by the clean round
+    assert s0._seq[0] == 2
+    c0.close()
+    c1.close()
+
+
+def test_signature_match_timeout_proceeds(store):
+    c0 = kvstore.Client(store.addr)
+    s0 = Sanitizer(rank=0, world=[0, 1], jobid="solo", client=c0,
+                   level=2, match_timeout=0.05)
+    # the peer never publishes: matching times out and lets the
+    # collective proceed unverified instead of deadlocking the rank
+    s0.match_collective("Allreduce", cid=0, dtype="float32",
+                        count_hash=4)
+    assert s0.last_mismatch is None
+    c0.close()
+
+
+def test_buf_signature_shapes():
+    dt, ch = san_mod._buf_signature((np.ones(8, np.float32),))
+    assert dt == "float32" and ch == san_mod._crc(8)
+    # object payloads fall back to the type name
+    dt, _ = san_mod._buf_signature(({"a": 1},))
+    assert dt == "dict"
+    assert san_mod._buf_signature(()) == ("none", 0)
+
+
+# -- watchdog integration ------------------------------------------------
+
+def test_hang_dump_carries_check_mismatch(tmp_path, monkeypatch):
+    flight.disable()
+    s = Sanitizer(rank=0, level=2)
+    s.last_mismatch = {"op": "Allreduce", "seq": 3, "cid": 0,
+                       "rank": 0, "peer": 1}
+    monkeypatch.setattr(san_mod, "SANITIZER", s)
+    fl = flight.FlightRecorder(rank=0)
+    fl.enter("allreduce_dev", comm_cid=0, nbytes=64)
+    wd = Watchdog(rank=0, world=[0], client=None, flight_rec=fl,
+                  dead_fn=lambda: {}, period=10, timeout=0.0,
+                  action="dump", dump_dir=str(tmp_path))
+    v = wd.sweep()
+    assert v is not None and v["seq"] == 1
+    doc = json.load(open(wd._dumped[1]))
+    assert doc["check_mismatch"]["op"] == "Allreduce"
+    assert doc["check_mismatch"]["seq"] == 3
+    flight.disable()
+
+
+# -- lifecycle + zero-overhead -------------------------------------------
+
+def test_enable_disable_roundtrip_restores_requests():
+    from ompi_tpu.pml import request as rq
+
+    assert san_mod.SANITIZER is None
+    san_mod.enable(rank=0, level=1)
+    try:
+        assert san_mod.SANITIZER is not None
+        assert san_mod.SANITIZER.level == 1
+        assert hasattr(rq.Request.wait, "__wrapped__")
+        assert san_mod._request_patches
+        san_mod.enable(rank=0, level=2)  # idempotent: first wins
+        assert san_mod.SANITIZER.level == 1
+    finally:
+        san_mod.disable()
+    assert san_mod.SANITIZER is None
+    assert not san_mod._request_patches
+    assert not hasattr(rq.Request.wait, "__wrapped__")
+    san_mod.disable()  # idempotent
+
+
+def test_zero_overhead_when_disabled(monkeypatch):
+    """check_level=0: no sanitizer instance, no interposition, no
+    request patches — instrumented sites see only the None guard."""
+    from ompi_tpu.pml import request as rq
+
+    monkeypatch.delenv("OMPI_TPU_CHECK", raising=False)
+    assert not check.requested()
+    assert check.get_sanitizer() is None
+    assert san_mod.SANITIZER is None
+    assert not san_mod._request_patches
+    assert not hasattr(rq.Request.wait, "__wrapped__")
+
+
+# -- end to end: 2 ranks, seeded mismatch --------------------------------
+
+def test_seeded_allreduce_mismatch_two_ranks():
+    """The acceptance contract: under check_level=2 a rank-dependent
+    Allreduce count raises a named MPIError on both ranks immediately
+    instead of hanging until the watchdog's timeout."""
+    run_ranks("""
+        from ompi_tpu import check, errors
+
+        san = check.get_sanitizer()
+        assert san is not None and san.level == 2
+        try:
+            comm.Allreduce(np.ones(rank + 1, np.float32))
+        except errors.MPIError as exc:
+            msg = str(exc)
+            assert "signature mismatch" in msg and "Allreduce" in msg
+            assert "seq 1" in msg and "rank 0" in msg and "rank 1" in msg
+        else:
+            raise AssertionError("sanitizer missed the mismatch")
+        # a matched collective afterwards still completes
+        out = comm.allreduce(1)
+        assert out == size
+    """, 2, mca={"check_level": "2"}, timeout=120)

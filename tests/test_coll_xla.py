@@ -425,10 +425,12 @@ def test_scatter_metadata_round_cached():
     meta = comm._coll_xla_scatter_meta
     assert list(meta) == [("scatter", 0)], meta
     if rank == 0:
+        from ompi_tpu import errors
         try:
             comm.Scatter(jnp.arange(size * 4, dtype=jnp.float32),
                          root=0)
-        except ValueError as e:
+        except errors.MPIError as e:
+            assert e.error_class == errors.ERR_ARG
             assert "signature changed" in str(e)
         else:
             raise AssertionError("shape change must raise")

@@ -7,7 +7,8 @@ buffers under Valgrind; here the shadow map is first-party."""
 import numpy as np
 import pytest
 
-from ompi_tpu.core import cvar, memchecker
+from ompi_tpu.check import memchecker
+from ompi_tpu.core import cvar
 from tests import harness
 
 
@@ -72,7 +73,7 @@ def test_pml_flags_send_from_inflight_recv_buffer():
     buf — the ob1 send entry must flag it (the exact race the
     reference's MEMCHECKER annotations exist for)."""
     harness.run_ranks("""
-        from ompi_tpu.core import memchecker
+        from ompi_tpu.check import memchecker
         buf = np.zeros(64, np.float32)
         if rank == 0:
             req = comm.Irecv(buf, source=1, tag=7)

@@ -191,11 +191,12 @@ def test_device_buffer_window():
 
 def test_host_window_device_array_errors():
     run_ranks("""
-    from ompi_tpu import osc
+    from ompi_tpu import errors, osc
     win = osc.win_create(comm, np.zeros(4), disp_unit=8)
     try:
         win.device_array()
-    except ValueError as e:
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_WIN
         assert "host window" in str(e)
     else:
         raise AssertionError("device_array on host window must raise")

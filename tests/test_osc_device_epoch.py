@@ -117,10 +117,11 @@ def test_device_epoch_accumulate_fused():
     if rank == 0:
         assert (np.asarray(win.array)[12:16] == size).all(), win.array
     # non-fusable ops are rejected toward the AM path
+    from ompi_tpu import errors
     try:
         win.Accumulate(jnp.ones(1, jnp.float32), target=0, op="bxor")
         raise SystemExit("bxor accepted")
-    except ValueError:
-        pass
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_OP
     win.Free()
     """, 4, mca=MCA)

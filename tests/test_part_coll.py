@@ -149,9 +149,9 @@ def test_pallreduce_semantics_errors():
         assert e.error_class == errors.ERR_REQUEST
     try:
         preq.Pready(1, jnp.ones((10,), jnp.float32))
-        raise SystemExit("expected ValueError (shape mismatch)")
-    except ValueError:
-        pass
+        raise SystemExit("expected MPIError (shape mismatch)")
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_ARG
     preq.Pready(1)
     preq.wait()
     assert not preq.active
