@@ -464,6 +464,70 @@ def _bench_telemetry():
     }
 
 
+def _bench_monitoring():
+    """Cost card for the traffic plane: the level-0 guard (``TRAFFIC
+    is None`` — what every send/collective pays when monitoring is
+    off), the level-1 per-cell count, and the guard cost relative to
+    the cheapest real per-message host work (one 256KiB buffer
+    materialization, the bench's standard leaf size) — the acceptance
+    bound is level-0 overhead < 1% of that floor."""
+    import numpy as np
+
+    from ompi_tpu.monitoring import matrix as _mon
+
+    iters = 200000
+
+    def guarded():
+        tm = _mon.TRAFFIC
+        if tm is not None:
+            tm.count("p2p", 1, 4096)
+
+    def bare():
+        pass
+
+    prev, _mon.TRAFFIC = _mon.TRAFFIC, None  # force level-0 view
+    try:
+        guarded()  # warm
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            guarded()
+        call_ns = (time.perf_counter_ns() - t0) / iters
+        # the real sites are inline: subtract the closure-call floor
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            bare()
+        guard_ns = max(call_ns
+                       - (time.perf_counter_ns() - t0) / iters, 0.0)
+    finally:
+        _mon.TRAFFIC = prev
+
+    # per-message host-work floor: materializing one 256KiB payload
+    # (the bench's standard leaf size) — the guard must vanish
+    # against it
+    t0 = time.perf_counter_ns()
+    for _ in range(iters // 10):
+        np.zeros(262144, np.uint8)
+    msg_ns = (time.perf_counter_ns() - t0) / (iters // 10)
+
+    fresh = _mon.TRAFFIC is None  # don't clobber a live plane
+    if fresh:
+        _mon.enable(rank=0, level=1, nranks=4)
+    try:
+        t0 = time.perf_counter_ns()
+        for _ in range(20000):
+            guarded()
+        count_ns = (time.perf_counter_ns() - t0) / 20000
+    finally:
+        if fresh:
+            _mon.disable()
+    return {
+        "level0_guard_ns": round(guard_ns, 1),
+        "level1_count_ns": round(count_ns, 1),
+        "level0_overhead_pct": round(
+            guard_ns / max(msg_ns, 1.0) * 100.0, 3),
+    }
+
+
 #: microbench extras compared across rounds once a TPU round records
 #: them in bench_baseline.json: (section, key, higher_is_better)
 _EXTRA_BASELINE_KEYS = (
@@ -583,6 +647,12 @@ def main() -> None:
     except Exception as e:
         _phase(f"telemetry microbench skipped: {e!r}")
         telemetry = None
+    try:
+        monitoring = _bench_monitoring()
+        _phase("monitoring microbench done")
+    except Exception as e:
+        _phase(f"monitoring microbench skipped: {e!r}")
+        monitoring = None
     zero = None
     if "--zero" in sys.argv:
         try:
@@ -659,6 +729,7 @@ def main() -> None:
             "dispatch": dispatch,
             "overlap": overlap,
             "telemetry": telemetry,
+            "monitoring": monitoring,
             "zero": zero,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),

@@ -120,7 +120,8 @@ FREE_NAMES = frozenset(("free", "Free", "close", "Close",
                         "disconnect", "Disconnect", "shutdown"))
 
 #: module globals carrying the one-branch disabled guard convention
-GUARD_GLOBALS = frozenset(("FLIGHT", "RECORDER", "SANITIZER"))
+GUARD_GLOBALS = frozenset(("FLIGHT", "RECORDER", "SANITIZER",
+                           "TRAFFIC"))
 
 #: path components marking the MPI-convention public API surface for
 #: bare-public-raise (the satellite scope: coll/, osc/, shmem/, part/)

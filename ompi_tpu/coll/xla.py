@@ -36,6 +36,7 @@ import numpy as np
 from ompi_tpu import errors, op as op_mod
 from ompi_tpu.coll import CollModule, accelerator as staging, framework
 from ompi_tpu.core import cvar, output, pvar
+from ompi_tpu.monitoring import matrix as _mon
 from ompi_tpu.prof import ledger as _prof
 from ompi_tpu.telemetry import flight as _flight
 from ompi_tpu.trace import recorder as _trace
@@ -446,6 +447,10 @@ def allreduce_dev(comm, sendbuf, op=op_mod.SUM,
     pvar.record("coll_xla_device")
     if comm.size == 1:
         return sendbuf
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        tm.coll("allreduce", comm, getattr(sendbuf, "nbytes", 0),
+                dtype=str(getattr(sendbuf, "dtype", "")))
     fl = _flight.FLIGHT
     if fl is None:
         return _allreduce_prep(comm, sendbuf, op, deterministic)()
@@ -581,12 +586,20 @@ def reduce_dev(comm, sendbuf, op=op_mod.SUM, root: int = 0,
             out = allreduce_dev(comm, sendbuf, op, deterministic)
             return out if comm.rank == root else None
         pvar.record("coll_xla_device")
+        tm = _mon.TRAFFIC
+        if tm is not None:
+            tm.coll("reduce", comm, nbytes, root=root,
+                    dtype=str(getattr(sendbuf, "dtype", "")))
         return _reduce_binomial(_ctx(comm), comm, sendbuf, opn, root)
     # rooted schedule: reduce_scatter leaves each rank ONE 1/n chunk
     # (O(bytes/n) output), then the chunks ride single-pair ppermutes
     # to the root — non-roots do O(bytes) HBM/ICI total, never the
     # n-fold allreduce result (coll_base_reduce.c binomial role)
     pvar.record("coll_xla_device")
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        tm.coll("reduce", comm, nbytes, root=root,
+                dtype=str(getattr(sendbuf, "dtype", "")))
     import jax.numpy as jnp
 
     from ompi_tpu.parallel import collectives as C
@@ -636,6 +649,10 @@ def bcast_dev(comm, buf, root: int = 0):
     pvar.record("coll_xla_device")
     if comm.size == 1:
         return buf
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        tm.coll("bcast", comm, getattr(buf, "nbytes", 0), root=root,
+                dtype=str(getattr(buf, "dtype", "")))
     fl = _flight.FLIGHT
     if fl is None:
         return _bcast_prep(comm, buf, root)()
@@ -671,6 +688,10 @@ def allgather_dev(comm, sendbuf):
     pvar.record("coll_xla_device")
     if comm.size == 1:
         return sendbuf[None] if hasattr(sendbuf, "shape") else sendbuf
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        tm.coll("allgather", comm, getattr(sendbuf, "nbytes", 0),
+                dtype=str(getattr(sendbuf, "dtype", "")))
     fl = _flight.FLIGHT
     if fl is None:
         return _allgather_prep(comm, sendbuf)()
@@ -691,6 +712,10 @@ def gather_dev(comm, sendbuf, root: int = 0):
     # rooted: per-source ppermute-to-root rounds; non-roots allocate
     # one sendbuf-sized block per round, never the (n, ...) result
     pvar.record("coll_xla_device")
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        tm.coll("gather", comm, nbytes, root=root,
+                dtype=str(getattr(sendbuf, "dtype", "")))
     return _gather_rooted(_ctx(comm), comm, sendbuf, root)
 
 
@@ -725,6 +750,10 @@ def alltoall_dev(comm, sendbuf):
     pvar.record("coll_xla_device")
     if comm.size == 1:
         return sendbuf
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        tm.coll("alltoall", comm, getattr(sendbuf, "nbytes", 0),
+                dtype=str(getattr(sendbuf, "dtype", "")))
     fl = _flight.FLIGHT
     if fl is None:
         return _alltoall_prep(comm, sendbuf)()
@@ -767,6 +796,11 @@ def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
     pvar.record("coll_xla_device")
     if comm.size == 1:
         return sendbuf
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        tm.coll("reduce_scatter_block", comm,
+                getattr(sendbuf, "nbytes", 0),
+                dtype=str(getattr(sendbuf, "dtype", "")))
     fl = _flight.FLIGHT
     if fl is None:
         return _reduce_scatter_block_prep(comm, sendbuf, op,
@@ -876,6 +910,9 @@ def barrier_dev(comm):
     before any member's program completes. Reference: coll/accelerator
     interposes every slot incl. barrier (ompi/mca/coll/accelerator/);
     here the rendezvous itself rides ICI instead of the host."""
+    tm = _mon.TRAFFIC
+    if tm is not None and comm.size > 1:
+        tm.coll("barrier", comm, 0)
     fl = _flight.FLIGHT
     if fl is None:
         ibarrier_dev(comm).wait()
@@ -902,6 +939,13 @@ def scatterv_dev(comm, sendbuf, counts, root: int = 0, like=None):
         raise errors.MPIError(
             errors.ERR_COUNT,
             f"scatterv: {len(counts)} counts for {comm.size} ranks")
+    tm = _mon.TRAFFIC
+    if tm is not None and comm.rank == root:
+        rowb = (sendbuf.nbytes / sendbuf.shape[0]
+                if sendbuf.shape[0] else 0.0)
+        tm.coll("scatterv", comm, getattr(sendbuf, "nbytes", 0),
+                root=root, counts=counts, row_bytes=rowb,
+                dtype=str(getattr(sendbuf, "dtype", "")))
     import jax.numpy as jnp
     from jax import lax
 
@@ -1052,6 +1096,18 @@ def alltoallv_dev(comm, sendbuf, scounts, rcounts, max_count=None):
                 f"{max(max(scounts), max(rcounts))}")
     pvar.record("coll_xla_device")  # after the fallback decision, so
     # the device-path counter never counts host-staged calls
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        # actual splits, not the padded cells: bytes to peer r =
+        # scounts[r] rows. This is also the EP dispatch site — each
+        # destination shard is an expert, so scounts IS the per-expert
+        # routed-token vector (ROADMAP item 5's imbalance feed).
+        rowb = (sendbuf.nbytes / sendbuf.shape[0]
+                if sendbuf.shape[0] else 0.0)
+        tm.coll("alltoallv", comm, getattr(sendbuf, "nbytes", 0),
+                dtype=str(getattr(sendbuf, "dtype", "")),
+                counts=scounts, row_bytes=rowb)
+        tm.expert_tokens(scounts)
     rest = sendbuf.shape[1:]
     rows = []
     off = 0
@@ -1112,6 +1168,10 @@ def scan_dev(comm, sendbuf, op=op_mod.SUM,
     pvar.record("coll_xla_device")
     if comm.size == 1:
         return sendbuf
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        tm.coll("scan", comm, getattr(sendbuf, "nbytes", 0),
+                dtype=str(getattr(sendbuf, "dtype", "")))
     from ompi_tpu.parallel import collectives as C
 
     ctx = _ctx(comm)
@@ -1296,6 +1356,12 @@ def allreduce_multi_dev(comm, bufs, op=op_mod.SUM,
 
     if comm.size == 1 or not jax.tree.leaves(bufs):
         return bufs
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        leaves = jax.tree.leaves(bufs)
+        tm.coll("allreduce_multi", comm,
+                sum(getattr(b, "nbytes", 0) for b in leaves),
+                dtype=str(getattr(leaves[0], "dtype", "")))
     fl = _flight.FLIGHT
     if fl is None:
         return _allreduce_multi_prep(comm, bufs, op, deterministic)()
@@ -1662,6 +1728,13 @@ def reduce_scatter_multi_dev(comm, bufs, op=op_mod.SUM,
         from ompi_tpu.zero import layout as _zl
 
         return _zl.ShardedState.from_full(comm, bufs)
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        leaves = jax.tree.leaves(bufs)
+        tm.coll("reduce_scatter_multi", comm,
+                sum(getattr(b, "nbytes", 0) for b in leaves),
+                dtype=str(getattr(leaves[0], "dtype", ""))
+                if leaves else "")
     fl = _flight.FLIGHT
     if fl is None:
         return _reduce_scatter_multi_prep(comm, bufs, op,
@@ -1755,6 +1828,11 @@ def allgather_multi_dev(comm, state):
     if comm.size == 1:
         # n=1 shards ARE the full padded buckets: unpack locally
         return state.unpack(state.shards)
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        tm.coll("allgather_multi", comm, state.plan.nbytes,
+                dtype=state.plan.dtypes[0]
+                if state.plan.dtypes else "")
     fl = _flight.FLIGHT
     if fl is None:
         return _allgather_multi_prep(comm, state)()
@@ -1805,13 +1883,14 @@ class PartitionedAllreduceRequest:
     inactive reads as complete, per MPI."""
 
     def __init__(self, ctx, leaves, treedef, opn,
-                 det: Optional[str]) -> None:
+                 det: Optional[str], comm=None) -> None:
         from ompi_tpu.pml import request as rq
 
         self.id = next(rq._req_ids)
         self.status = rq.Status()
         self.persistent = True
         self._ctx = ctx
+        self._comm = comm  # traffic attribution (monitoring plane)
         self._treedef = treedef
         self._n = len(leaves)
         metas = _fuse_metas(leaves)
@@ -1919,6 +1998,13 @@ class PartitionedAllreduceRequest:
                        {"bucket": b, "trigger_partition": trigger,
                         "overlap": overlap, "nbytes": nb})
             _trace.hist("part_bucket_flush", nb, t1 - t0)
+        tm = _mon.TRAFFIC
+        if tm is not None and self._comm is not None:
+            # the bucket's psum IS an allreduce launch; attributed to
+            # the part context so overlap traffic stays separable
+            tm.coll("allreduce", self._comm,
+                    sum(self._metas[i][2] for i in idxs),
+                    dtype=self._metas[idxs[0]][1], ctx="part")
         pvar.record("part_bucket_flushes")
         if overlap:
             # dispatched while later partitions are still pending:
@@ -2116,7 +2202,8 @@ def pallreduce_init_dev(comm, bufs, op=op_mod.SUM,
                                             deterministic)
     opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
     return PartitionedAllreduceRequest(_ctx(comm), leaves, treedef,
-                                       opn, _det(deterministic))
+                                       opn, _det(deterministic),
+                                       comm=comm)
 
 
 class PartitionedReduceScatterRequest:
@@ -2247,6 +2334,11 @@ class PartitionedReduceScatterRequest:
                        {"bucket": b, "trigger_partition": trigger,
                         "overlap": overlap, "nbytes": nb})
             _trace.hist("zero_bucket_flush", nb, t1 - t0)
+        tm = _mon.TRAFFIC
+        if tm is not None:
+            tm.coll("reduce_scatter", self._comm,
+                    sum(self._metas[i][2] for i in idxs),
+                    dtype=self._metas[idxs[0]][1], ctx="part")
         pvar.record("zero_rs_launches")
         if overlap:
             pvar.record("zero_overlap_flushes")

@@ -32,6 +32,7 @@ import numpy as np
 
 from ompi_tpu import errors
 from ompi_tpu.core import pvar
+from ompi_tpu.monitoring import matrix as _mon
 from ompi_tpu.pml.request import PROC_NULL
 
 
@@ -171,6 +172,17 @@ def neighbor_allgather_dev(comm, sendbuf):
     ctx = X._ctx(comm)
     n = ctx.n
     my_rows = len(topo.in_neighbors(comm.rank))
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        # graph edges, not an algo model: the full sendbuf goes to
+        # every (non-PROC_NULL) out-neighbor
+        nb = getattr(sendbuf, "nbytes", 0)
+        per = {}
+        for p in topo.out_neighbors(comm.rank):
+            if p != PROC_NULL:
+                per[p] = per.get(p, 0.0) + nb
+        tm.coll("neighbor_allgather", comm, nb, per_peer=per,
+                dtype=str(getattr(sendbuf, "dtype", "")))
 
     def build():
         import jax.numpy as jnp
@@ -223,6 +235,18 @@ def neighbor_alltoall_dev(comm, sendbuf):
             errors.ERR_COUNT,
             f"neighbor_alltoall: sendbuf dim0 {sendbuf.shape[0]} != "
             f"out-degree {my_out}")
+    tm = _mon.TRAFFIC
+    if tm is not None:
+        # one sendbuf row per out-neighbor (PROC_NULL rows go nowhere)
+        rowb = (sendbuf.nbytes / sendbuf.shape[0]
+                if sendbuf.shape[0] else 0.0)
+        per = {}
+        for p in topo.out_neighbors(comm.rank):
+            if p != PROC_NULL:
+                per[p] = per.get(p, 0.0) + rowb
+        tm.coll("neighbor_alltoall", comm,
+                getattr(sendbuf, "nbytes", 0), per_peer=per,
+                dtype=str(getattr(sendbuf, "dtype", "")))
     edges, max_in, max_out = _edges_alltoall(topo, n)
     # SPMD needs uniform operand shapes: pad ragged out-degrees
     if sendbuf.shape[0] < max_out:

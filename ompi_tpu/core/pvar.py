@@ -72,11 +72,17 @@ WELL_KNOWN = (
     "prof_xfer_d2h_bytes", "prof_xfer_d2h_ns",
     "prof_compile_hits", "prof_compile_misses", "prof_compile_ns",
     "prof_compile_cache_hits", "prof_compile_cache_misses",
-    # pml/monitoring per-context traffic (combined monitoring_msgs/
-    # monitoring_bytes stay alongside)
+    # monitoring plane per-context traffic (combined monitoring_msgs/
+    # monitoring_bytes stay alongside; per-cell/per-link/per-expert
+    # families are dynamically named — monitoring_tx_*_s<i>_d<j>_<ctx>,
+    # monitoring_link_bytes_d<d>_r<a>_r<b>, monitoring_expert_tokens_e<k>)
     "monitoring_p2p_msgs", "monitoring_p2p_bytes",
     "monitoring_coll_msgs", "monitoring_coll_bytes",
+    "monitoring_osc_msgs", "monitoring_osc_bytes",
+    "monitoring_part_msgs", "monitoring_part_bytes",
     "monitoring_msgs", "monitoring_bytes",
+    "monitoring_coll_launches", "monitoring_expert_tokens",
+    "monitoring_link_imbalance_permille",
     # check/ plane (runtime MPI sanitizer): argument/signature
     # violations raised, leaked requests reported at Finalize,
     # cross-rank fingerprint exchanges performed at level 2

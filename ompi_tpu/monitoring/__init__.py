@@ -1,0 +1,165 @@
+"""Monitoring plane — topology-aware traffic matrices + link loads.
+
+Reference: ompi/mca/common/monitoring (the MPI_T traffic-matrix
+plane the pml/osc/coll monitoring components all feed) — generalized
+here into the eighth observability plane, because on a TPU the
+traffic that matters never touches the host p2p path the old
+``pml/monitoring`` stub watched.
+
+Three cooperating pieces, all opt-in via ``monitoring_level`` (or the
+short ``OMPI_TPU_MONITORING`` env knob):
+
+- :mod:`matrix` — the matrix core: per-(src, dst) message/byte/
+  latency cells split by context (p2p / coll / osc / part), fed by
+  interposition on the pml send path (:mod:`ompi_tpu.pml.monitoring`,
+  now a thin shim over this plane), the osc service-send funnel, the
+  partitioned Pready path, and **algorithmic byte accounting** on the
+  ``coll/xla`` device slots: each collective launch records the bytes
+  its algorithm moves per peer (:mod:`algo` — ring RS/AG, allreduce =
+  RS+AG, alltoall(v) actual splits), keyed by ``(op, log2-size-bucket,
+  dtype, mesh-shape)`` so ``coll/tuned``-style switchpoint tables can
+  be derived later.
+- :mod:`links` — topology attribution (level 2): matrix cells map
+  onto ICI links via ``topo.CartTopo`` coordinates and minimal-hop
+  torus routing (``CartTopo.route``), producing per-link load
+  estimates, a link-imbalance gauge
+  (``monitoring_link_imbalance_permille``), and hottest-link naming.
+- :mod:`merge` + the ``python -m ompi_tpu.monitoring report`` CLI —
+  cross-rank merge (kvstore or JSON artifacts; send-side counting
+  with a transpose check on merge) and rank×rank / per-link heatmap
+  reports with top-N hotspot ranking.
+
+Level semantics: 0 = off (every instrumented site pays one attribute
+load + one branch — the ``TRAFFIC is None`` guard, same discipline as
+``FLIGHT``/``RECORDER``/``SANITIZER``); 1 = matrices + per-cell
+pvars; 2 = + per-link attribution and Perfetto link counter tracks.
+The deprecated ``pml_monitoring`` cvar compat-maps to level 1.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ompi_tpu.core import cvar, output
+
+_out = output.stream("monitoring")
+
+_level_var = cvar.register(
+    "monitoring_level", 0, int,
+    help="Traffic-monitoring plane level: 0 off (one branch per "
+         "instrumented site), 1 per-(src,dst,ctx) traffic matrices + "
+         "pvars, 2 adds per-ICI-link attribution (CartTopo minimal-"
+         "hop routing) and Perfetto link counter tracks. "
+         "Equivalently: OMPI_TPU_MONITORING=<level>. Supersedes the "
+         "deprecated pml_monitoring cvar (compat: level 1).", level=5)
+
+_dump_var = cvar.register(
+    "monitoring_dump", "", str,
+    help="Finalize-time per-rank matrix dump path; '{rank}' expands "
+         "to the world rank (e.g. /tmp/mon_r{rank}.json). Feed the "
+         "files to `python -m ompi_tpu.monitoring report`. Empty "
+         "with pml_monitoring/monitoring_level set still logs the "
+         "matrix through the output stream.", level=6)
+
+
+def level() -> int:
+    """Requested plane level: max of the cvar, the short
+    OMPI_TPU_MONITORING env knob, and the deprecated pml_monitoring
+    compat mapping (truthy -> level 1)."""
+    lvl = int(_level_var.get())
+    raw = os.environ.get("OMPI_TPU_MONITORING", "").strip().lower()
+    if raw and raw not in ("0", "false", "no", "off"):
+        try:
+            lvl = max(lvl, int(raw))
+        except ValueError:
+            lvl = max(lvl, 1)  # any other truthy value: level 1
+    from ompi_tpu.pml import monitoring as _pml_mon
+
+    if _pml_mon._enable_var.get():
+        lvl = max(lvl, 1)
+    return lvl
+
+
+def requested() -> bool:
+    return level() > 0
+
+
+def start(rank: int = 0, nranks: int = 0) -> None:
+    """Bring the plane up (idempotent): enable the TRAFFIC matrix at
+    the requested level and install the pml interposition shim so the
+    host send path is counted too."""
+    from ompi_tpu.monitoring import matrix as _matrix
+    from ompi_tpu.pml import monitoring as _pml_mon
+
+    lvl = level()
+    if lvl <= 0:
+        return
+    if _pml_mon._enable_var.get() and not int(_level_var.get()):
+        _out.verbose(1, "pml_monitoring is deprecated; it now maps "
+                        "to monitoring_level 1 (use --mca "
+                        "monitoring_level N)")
+    if nranks <= 0:
+        from ompi_tpu.runtime import rte
+
+        nranks = rte.size
+    _matrix.enable(rank=rank, level=lvl, nranks=nranks)
+    _pml_mon.install()
+
+
+def stop() -> None:
+    """Tear the plane down: Finalize-time matrix dump (the
+    common/monitoring dump-at-finalize contract for --mca
+    pml_monitoring / monitoring_dump), then drop the guard."""
+    from ompi_tpu.monitoring import matrix as _matrix
+
+    tm = _matrix.TRAFFIC
+    if tm is None:
+        return
+    try:
+        finalize_dump()
+    except Exception as exc:  # noqa: BLE001 — dumps must not sink
+        _out.verbose(0, "monitoring dump failed: %r", exc)  # Finalize
+    _matrix.disable()
+
+
+def finalize_dump() -> str:
+    """Write this rank's matrix snapshot: JSON artifact when
+    ``monitoring_dump`` names a path (returned), and the
+    human-readable per-peer lines through the output stream either
+    way (the reference's MPI_Finalize flush)."""
+    import json
+
+    from ompi_tpu.monitoring import matrix as _matrix
+    from ompi_tpu.monitoring import merge as _merge
+
+    tm = _matrix.TRAFFIC
+    if tm is None:
+        return ""
+    doc = _merge.snapshot_doc(tm)
+    for ctx, table in sorted(doc["tables"].items()):
+        for dst, (msgs, nbytes, _ns) in sorted(table.items(),
+                                               key=lambda kv:
+                                               int(kv[0])):
+            _out.verbose(1, "rank %d -> %s [%s]: %d msgs, %d bytes",
+                         tm.rank, dst, ctx, msgs, nbytes)
+    path = _dump_var.get()
+    if not path:
+        return ""
+    path = path.replace("{rank}", str(tm.rank))
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    os.replace(tmp, path)
+    _out.verbose(1, "matrix dump written: %s", path)
+    return path
+
+
+def expert_load(counts) -> None:
+    """Record per-expert token counts on the plane
+    (``monitoring_expert_tokens{expert=...}`` OpenMetrics family) —
+    the EP/MoE serving feed of ROADMAP item 5. One branch when off."""
+    from ompi_tpu.monitoring import matrix as _matrix
+
+    tm = _matrix.TRAFFIC
+    if tm is not None:
+        tm.expert_tokens(counts)

@@ -1,0 +1,111 @@
+"""Algorithmic byte accounting for device collectives.
+
+``coll/xla`` never moves bytes through the pml, so the matrix core
+cannot observe collective traffic by interposition the way
+``common/monitoring`` does on the host path. Instead each collective
+launch *declares* the bytes its algorithm moves per peer, given the
+(op, rank, comm size, payload size). The models below follow the
+lowering the XLA TPU compiler actually uses on an ICI torus (and the
+classic algorithms the reference's ``coll/tuned`` tables assume):
+
+- ring **reduce_scatter** / **allgather**: n-1 steps, each rank sends
+  1/n of the payload to its ring successor per step -> (n-1)/n * B
+  to peer (rank+1) % n.
+- **allreduce** = reduce_scatter + allgather -> 2 * (n-1)/n * B on
+  the same ring edge (the bandwidth-optimal rotated-pincer/ring
+  family).
+- **bcast** / **reduce** / **scan**: pipelined ring/chain -> each
+  interior rank forwards the full payload B one hop.
+- **alltoall(v)**: direct pairwise exchange, *actual* splits — the v
+  variant records the exact per-destination row bytes, which is what
+  makes the EP expert-imbalance matrix honest under skew.
+- **barrier**: modeled as a 4-byte allreduce.
+
+All models count SEND-side bytes only (the merge transposes for the
+receive view), and return {} for size-1 comms and unknown ops — an
+unknown op under-counts rather than guesses.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+# Ops whose ring lowering sends (n-1)/n of the payload one hop.
+_RING_FRACTION = frozenset((
+    "allgather", "allgatherv", "allgather_multi",
+    "reduce_scatter", "reduce_scatter_block", "reduce_scatter_multi",
+))
+
+# Bandwidth-optimal allreduce = reduce_scatter + allgather.
+_RS_AG = frozenset(("allreduce", "allreduce_multi"))
+
+# Pipelined chain ops: forward the full payload one hop.
+_PIPELINE = frozenset(("bcast", "reduce", "scan", "exscan"))
+
+BARRIER_BYTES = 4
+
+
+def log2_bucket(nbytes: int) -> int:
+    """log2 size bucket for the (op, bucket, dtype, mesh) record key
+    — the granularity coll/tuned switchpoint tables select on."""
+    b = 0
+    n = int(nbytes)
+    while n > 1:
+        n >>= 1
+        b += 1
+    return b
+
+
+def per_peer(op: str, rank: int, n: int, nbytes: int,
+             root: int = 0,
+             counts: Optional[Sequence[int]] = None,
+             row_bytes: float = 0.0) -> Dict[int, float]:
+    """Bytes `rank` SENDS per peer (comm-local ranks) for one launch
+    of `op` over an n-rank comm moving `nbytes` of payload.
+
+    `counts`/`row_bytes` give alltoallv its actual splits: bytes to
+    peer r = counts[r] * row_bytes. `root` shapes the rooted ops.
+    """
+    if n <= 1:
+        return {}
+    nxt = (rank + 1) % n
+    if op in _RING_FRACTION:
+        return {nxt: nbytes * (n - 1) / n}
+    if op in _RS_AG:
+        return {nxt: 2.0 * nbytes * (n - 1) / n}
+    if op == "barrier":
+        return {nxt: 2.0 * BARRIER_BYTES * (n - 1) / n}
+    if op in _PIPELINE:
+        if op in ("scan", "exscan"):
+            # Chain, not ring: the last rank has no successor.
+            return {rank + 1: float(nbytes)} if rank < n - 1 else {}
+        if op == "bcast":
+            # Ring pipeline rooted at `root`; the rank whose successor
+            # is the root closes the ring without sending.
+            return {} if nxt == root else {nxt: float(nbytes)}
+        # reduce: chain toward the root; model the common
+        # one-hop-forward cost for every non-root rank.
+        return {} if rank == root else {nxt: float(nbytes)}
+    if op in ("gather", "gatherv"):
+        return {} if rank == root else {root: float(nbytes)}
+    if op in ("scatter", "scatterv"):
+        if rank != root:
+            return {}
+        if counts is not None:
+            return {r: counts[r] * row_bytes
+                    for r in range(n) if r != rank and counts[r]}
+        chunk = nbytes / n
+        return {r: chunk for r in range(n) if r != rank}
+    if op == "alltoall":
+        chunk = nbytes / n
+        return {r: chunk for r in range(n) if r != rank}
+    if op == "alltoallv":
+        # Explicit splits required (the skew-honest path). Neighbor
+        # collectives bypass this table entirely: their graph edges
+        # come from the comm topology, so the instrumentation sites
+        # hand the matrix explicit per-peer dicts.
+        if counts is None:
+            return {}
+        return {r: counts[r] * row_bytes
+                for r in range(n) if r != rank and counts[r]}
+    return {}

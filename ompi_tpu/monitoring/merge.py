@@ -1,0 +1,185 @@
+"""Cross-rank matrix merge — kvstore exchange + transpose check.
+
+Counting is send-side (each rank records only what it transmits), so
+the job-wide matrix assembles by stacking per-rank rows; the receive
+view is its transpose. On a clean run the p2p/coll contexts must be
+transpose-consistent for symmetric traffic patterns — the merge
+computes the worst relative |M[i][j] - M[j][i]| skew per context and
+reports it, which catches both lost counts and misattributed peers
+(the bug class the old inter-communicator fallback hid).
+
+Two transports: ranks publish JSON snapshot docs to the kvstore under
+``mon:mat:{jobid}:{rank}`` (the telemetry rollup pattern), or dump
+them as files at Finalize (``--mca monitoring_dump``) for the report
+CLI to merge offline. Schema ``ompi_tpu.monitoring.matrix/1``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+from ompi_tpu.monitoring.links import Link, LinkMap, link_name, sum_links
+
+SCHEMA = "ompi_tpu.monitoring.matrix/1"
+
+
+def snapshot_doc(tm) -> Dict[str, object]:
+    """One rank's JSON-able matrix snapshot (keys stringified for
+    JSON round-tripping; parse back with int())."""
+    with tm.lock:
+        tables = {ctx: {str(d): list(cell) for d, cell in t.items()}
+                  for ctx, t in tm.tables.items() if t}
+        coll_records = [
+            {"op": op, "bucket": bucket, "dtype": dt,
+             "mesh": list(mesh), "launches": rec[0],
+             "bytes": rec[1]}
+            for (op, bucket, dt, mesh), rec in
+            sorted(tm.coll_records.items())]
+        link_bytes = {link_name(k): v
+                      for k, v in tm.link_bytes.items()}
+        expert = {str(e): c for e, c in tm.expert.items()}
+    return {
+        "schema": SCHEMA,
+        "rank": tm.rank,
+        "nranks": tm.nranks,
+        "level": tm.level,
+        "tables": tables,
+        "coll_records": coll_records,
+        "link_bytes": link_bytes,
+        "expert_tokens": expert,
+    }
+
+
+def _key(jobid: str, rank: int) -> str:
+    return f"mon:mat:{jobid}:{rank}"
+
+
+def publish(client, jobid: str, rank: int,
+            doc: Dict[str, object]) -> None:
+    client.put(_key(jobid, rank), json.dumps(doc))
+
+
+def collect(client, jobid: str, nranks: int,
+            timeout: float = 10.0) -> List[Dict[str, object]]:
+    """Gather every rank's published snapshot (blocking get per rank,
+    kvstore-side wait)."""
+    docs = []
+    for r in range(nranks):
+        raw = client.get(_key(jobid, r), wait=timeout)
+        docs.append(json.loads(raw))
+    return docs
+
+
+def _parse_link(name: str) -> Link:
+    # inverse of links.link_name: "d0:r1-r3"
+    d, rest = name.split(":", 1)
+    a, b = rest.split("-")
+    return (int(d[1:]), int(a[1:]), int(b[1:]))
+
+
+def merge(docs: List[Dict[str, object]]) -> Dict[str, object]:
+    """Assemble per-rank snapshots into the job view.
+
+    Returns {ctx: {src: {dst: [msgs, bytes]}}} matrices, per-rank
+    send/recv byte totals, the per-context transpose skew, summed
+    link loads + imbalance + hottest link, merged collective records,
+    and merged expert-token counts.
+    """
+    for doc in docs:
+        if doc.get("schema") != SCHEMA:
+            raise ValueError(
+                f"not a monitoring matrix dump (schema="
+                f"{doc.get('schema')!r}, want {SCHEMA!r})")
+    nranks = max([int(d.get("nranks", 0)) for d in docs] +
+                 [int(d["rank"]) + 1 for d in docs])
+    mats: Dict[str, Dict[int, Dict[int, List[float]]]] = {}
+    for doc in docs:
+        src = int(doc["rank"])
+        for ctx, table in doc.get("tables", {}).items():
+            row = mats.setdefault(ctx, {}).setdefault(src, {})
+            for dst, cell in table.items():
+                got = row.setdefault(int(dst), [0, 0.0])
+                got[0] += cell[0]
+                got[1] += cell[1]
+
+    tx = [0.0] * nranks
+    rx = [0.0] * nranks
+    for rows in mats.values():
+        for src, row in rows.items():
+            for dst, (_m, b) in row.items():
+                tx[src] += b
+                if 0 <= dst < nranks:
+                    rx[dst] += b
+
+    skew = {ctx: transpose_skew(rows) for ctx, rows in mats.items()}
+
+    link_loads = sum_links(
+        [{_parse_link(k): v
+          for k, v in doc.get("link_bytes", {}).items()}
+         for doc in docs])
+    hot = LinkMap.hottest(link_loads, top=len(link_loads))
+
+    coll_records: Dict[Tuple[str, int, str, Tuple[int, ...]],
+                       List[float]] = {}
+    for doc in docs:
+        for rec in doc.get("coll_records", []):
+            key = (rec["op"], int(rec["bucket"]), rec["dtype"],
+                   tuple(rec["mesh"]))
+            got = coll_records.setdefault(key, [0, 0.0])
+            got[0] += rec["launches"]
+            got[1] += rec["bytes"]
+
+    expert: Dict[int, int] = {}
+    for doc in docs:
+        for e, c in doc.get("expert_tokens", {}).items():
+            expert[int(e)] = expert.get(int(e), 0) + int(c)
+
+    return {
+        "schema": SCHEMA + "+merged",
+        "nranks": nranks,
+        "matrices": mats,
+        "tx_bytes": tx,
+        "rx_bytes": rx,
+        "transpose_skew": skew,
+        "links": [{"name": link_name(k), "bytes": v}
+                  for k, v in hot],
+        "link_imbalance": LinkMap.imbalance(link_loads),
+        "coll_records": [
+            {"op": op, "bucket": bucket, "dtype": dt,
+             "mesh": list(mesh), "launches": rec[0],
+             "bytes": rec[1]}
+            for (op, bucket, dt, mesh), rec in
+            sorted(coll_records.items())],
+        "expert_tokens": expert,
+    }
+
+
+def transpose_skew(rows: Dict[int, Dict[int, List[float]]]) -> float:
+    """Worst relative |M[i][j] - M[j][i]| over byte cells — 0.0 for
+    transpose-consistent (symmetric-pattern) traffic; send-side
+    counting makes asymmetry here mean lost or misattributed counts
+    when the pattern itself is symmetric."""
+    worst = 0.0
+    seen = set()
+    for i, row in rows.items():
+        for j in row:
+            if (j, i) in seen:
+                continue
+            seen.add((i, j))
+            a = row.get(j, [0, 0.0])[1]
+            b = rows.get(j, {}).get(i, [0, 0.0])[1]
+            hi = max(a, b)
+            if hi > 0:
+                worst = max(worst, abs(a - b) / hi)
+    return worst
+
+
+def exchange(tm, client, jobid: str, nranks: int,
+             timeout: float = 10.0) -> Optional[Dict[str, object]]:
+    """All ranks publish; rank 0 collects and merges (the telemetry
+    rollup shape). Non-zero ranks return None."""
+    publish(client, jobid, tm.rank, snapshot_doc(tm))
+    if tm.rank != 0:
+        return None
+    return merge(collect(client, jobid, nranks, timeout))

@@ -1,0 +1,128 @@
+"""Terminal + JSON reporting over merged traffic matrices.
+
+Renders the rank×rank heatmap per context, the per-link load table
+with the hottest ICI links ranked, top-N (src, dst, ctx) hotspot
+cells, collective-launch records, and expert-token imbalance — the
+human face of ``python -m ompi_tpu.monitoring report``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+# Shade ramp for the terminal heatmap: cell byte count relative to
+# the matrix max.
+_RAMP = " .:-=+*#%@"
+
+
+def _fmt_bytes(b: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(b) < 1024 or unit == "GiB":
+            return (f"{b:.0f}{unit}" if unit == "B"
+                    else f"{b:.1f}{unit}")
+        b /= 1024
+    return f"{b:.1f}GiB"
+
+
+def heatmap_lines(rows: Dict[int, Dict[int, List[float]]],
+                  nranks: int, ctx: str) -> List[str]:
+    """rank×rank byte heatmap for one context: shaded cells plus the
+    per-row send totals (send-side counting means row r is exactly
+    what rank r transmitted)."""
+    peak = max((cell[1] for row in rows.values()
+                for cell in row.values()), default=0.0)
+    out = [f"[{ctx}] send-side bytes, {nranks}x{nranks} "
+           f"(peak cell {_fmt_bytes(peak)})"]
+    hdr = "      " + "".join(f"{d:>4d}" for d in range(nranks))
+    out.append(hdr + "   tx_total")
+    for src in range(nranks):
+        row = rows.get(src, {})
+        cells = []
+        total = 0.0
+        for dst in range(nranks):
+            b = row.get(dst, [0, 0.0])[1]
+            total += b
+            if src == dst:
+                cells.append("   -")
+            elif b <= 0:
+                cells.append("   .")
+            else:
+                shade = _RAMP[min(len(_RAMP) - 1,
+                                  int(b / peak * (len(_RAMP) - 1)))] \
+                    if peak > 0 else "."
+                cells.append(f"   {shade}")
+        out.append(f"  r{src:<3d}" + "".join(cells) +
+                   f"   {_fmt_bytes(total)}")
+    return out
+
+
+def link_lines(links: List[Dict[str, object]],
+               imbalance: float, top: int) -> List[str]:
+    if not links:
+        return ["[links] no link attribution recorded "
+                "(needs monitoring_level 2)"]
+    peak = float(links[0]["bytes"]) or 1.0
+    out = [f"[links] {len(links)} ICI links, "
+           f"imbalance max/mean = {imbalance:.2f}; "
+           f"hottest: {links[0]['name']} "
+           f"({_fmt_bytes(float(links[0]['bytes']))})"]
+    for row in links[:top]:
+        b = float(row["bytes"])
+        bar = "#" * max(1, int(b / peak * 40))
+        out.append(f"  {row['name']:>12s} {_fmt_bytes(b):>10s} {bar}")
+    return out
+
+
+def hotspot_lines(merged: Dict[str, object], top: int) -> List[str]:
+    cells = []
+    for ctx, rows in merged.get("matrices", {}).items():
+        for src, row in rows.items():
+            for dst, (msgs, b) in row.items():
+                cells.append((float(b), int(msgs), int(src),
+                              int(dst), ctx))
+    cells.sort(key=lambda c: (-c[0], c[2], c[3]))
+    out = [f"[hotspots] top {min(top, len(cells))} of "
+           f"{len(cells)} cells"]
+    for b, msgs, src, dst, ctx in cells[:top]:
+        out.append(f"  r{src} -> r{dst} [{ctx}]: "
+                   f"{_fmt_bytes(b)} in {msgs} msgs")
+    return out
+
+
+def render(merged: Dict[str, object], top: int = 5) -> str:
+    nranks = int(merged["nranks"])
+    out: List[str] = [
+        f"traffic report: {nranks} ranks, "
+        f"tx {_fmt_bytes(sum(merged['tx_bytes']))} total"]
+    for ctx in sorted(merged.get("matrices", {})):
+        out.extend(heatmap_lines(merged["matrices"][ctx], nranks,
+                                 ctx))
+        skew = merged.get("transpose_skew", {}).get(ctx)
+        if skew is not None:
+            out.append(f"  transpose skew: {skew:.3f} "
+                       "(0.0 = send/recv views agree)")
+    out.extend(link_lines(merged.get("links", []),
+                          float(merged.get("link_imbalance", 0.0)),
+                          top))
+    out.extend(hotspot_lines(merged, top))
+    recs = merged.get("coll_records", [])
+    if recs:
+        out.append(f"[collectives] {len(recs)} (op, size-bucket, "
+                   "dtype, mesh) records")
+        for rec in recs[:top]:
+            out.append(
+                f"  {rec['op']:<22s} 2^{rec['bucket']:<2d}B "
+                f"{rec['dtype'] or '?':<10s} "
+                f"mesh{tuple(rec['mesh'])!r:<10} "
+                f"{rec['launches']:.0f} launches "
+                f"{_fmt_bytes(float(rec['bytes']))}")
+    experts = merged.get("expert_tokens", {})
+    if experts:
+        total = sum(experts.values()) or 1
+        hot = max(experts.items(), key=lambda kv: kv[1])
+        out.append(f"[experts] {len(experts)} experts, "
+                   f"{total} tokens; hottest expert {hot[0]} "
+                   f"({hot[1]} tokens, "
+                   f"{hot[1] * len(experts) / total:.2f}x fair "
+                   "share)")
+    return "\n".join(out)

@@ -32,6 +32,7 @@ from ompi_tpu import op as op_mod
 from ompi_tpu import pml
 from ompi_tpu.attr import AttrHost
 from ompi_tpu.core import output, pvar
+from ompi_tpu.monitoring import matrix as _mon
 from ompi_tpu.pml.request import ANY_SOURCE, Request
 
 _out = output.stream("osc")
@@ -185,6 +186,14 @@ class Window(AttrHost):
         return events
 
     def _send(self, target: int, msg: tuple) -> None:
+        tm = _mon.TRAFFIC
+        if tm is not None:
+            # every window service message (origin requests AND the
+            # target's replies) funnels through here — the one osc
+            # interposition point; payload = the ndarrays riding the
+            # active message
+            tm.count("osc", _mon.world_rank(self.comm, target),
+                     sum(getattr(m, "nbytes", 0) for m in msg))
         pml.current().send_obj(self.comm, msg, target, _SERVICE_TAG)
 
     # ------------------------------------------------------------------

@@ -116,6 +116,17 @@ class DeviceCommunicator:
     def Shift(self, x, offset: int = 1):
         return C.shift(x, self.axis, offset)
 
+    # -- observability ----------------------------------------------------
+    def record_expert_load(self, counts) -> None:
+        """Feed per-expert token counts (e.g. the MoE router's dispatch
+        histogram, one entry per expert) into the monitoring plane's
+        ``monitoring_expert_tokens`` pvars — callers on the EP alltoall
+        path that route on-device (bypassing coll/xla's alltoallv
+        accounting) report their load skew here."""
+        from ompi_tpu import monitoring as _monitoring
+
+        _monitoring.expert_load([int(c) for c in counts])
+
     # -- launch -----------------------------------------------------------
     def run(self, fn: Callable, in_specs, out_specs, **kw):
         """shard_map `fn` over the mesh: the SPMD region inside which

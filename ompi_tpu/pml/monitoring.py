@@ -1,70 +1,81 @@
-"""pml/monitoring — interposition PML recording per-peer traffic.
+"""pml/monitoring — interposition PML feeding the monitoring plane.
 
 Reference: ompi/mca/pml/monitoring (512 LoC) + common/monitoring: a
-PML that wraps the selected one, counts messages and bytes per
-destination peer (split by point-to-point vs collective context), and
-dumps a traffic matrix at finalize or on demand. The same pattern
-carries pml/v (message logging) — any interposition layer installs via
-``pml.set_current``.
+PML that wraps the selected one and counts messages/bytes per
+destination peer. Since the monitoring plane landed this module is a
+thin shim: the matrices themselves live in
+:mod:`ompi_tpu.monitoring.matrix` (per-context, per-(src,dst), with
+link attribution at level 2), and this layer only provides the
+send-path interposition plus the historical module API.
+
+Peer translation goes through the (remote, for inter-communicators)
+group's rank table; a peer outside the group raises
+``MPIError(ERR_RANK)`` at the call — the old silent ``world = dst``
+fallback misattributed inter-communicator traffic.
 
 Usage:
     from ompi_tpu.pml import monitoring
-    monitoring.install()           # or --mca pml_monitoring 1
+    monitoring.install()           # or --mca monitoring_level 1
     ... run ...
     matrix = monitoring.matrix()   # {peer: (msgs, bytes)}
     monitoring.dump()              # human-readable to the output stream
+
+``--mca pml_monitoring 1`` still works (deprecated): it compat-maps
+to ``monitoring_level 1`` and now gets the full plane, including the
+Finalize-time matrix dump and telemetry-rollup inclusion it never
+had.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional, Tuple
 
-from ompi_tpu.core import cvar, output, pvar
+from ompi_tpu.core import cvar, output
+from ompi_tpu.monitoring import matrix as _matrix
 
 _out = output.stream("pml_monitoring")
 
+# ompi_tpu.osc._SERVICE_TAG — resolved here (not imported) because
+# osc imports the pml package; window service traffic is counted by
+# the osc epoch path with its real payload bytes, not as p2p obj sends
+_OSC_SERVICE_TAG = -64
+
+# ompi_tpu.part.host._PART_BASE — every partitioned-chunk isend rides
+# a tag at or below this ceiling, which classifies it as ctx="part"
+# here instead of a second counting site in Pready (same not-imported
+# rationale: part imports the pml package)
+_PART_TAG_CEIL = -(1 << 24)
+
 _enable_var = cvar.register(
     "pml_monitoring", False, bool,
-    help="Install the monitoring interposition PML at init "
-         "(reference: pml/monitoring).", level=7)
+    help="DEPRECATED compat alias for --mca monitoring_level 1 "
+         "(reference: pml/monitoring). The monitoring plane replaces "
+         "this cvar; it keeps working via the compat mapping.",
+    level=7)
 
 
 class MonitoringPml:
-    """Wraps the real PML; counts sends per destination world rank.
-
-    The reference monitors the send side (every message is counted
-    exactly once, by its sender); receive totals are available as the
-    transpose after an allgather of matrices."""
+    """Wraps the real PML; counts sends per destination world rank
+    into the plane's TRAFFIC matrix (send side only — every message
+    counted exactly once, by its sender; the merge transposes for the
+    receive view)."""
 
     def __init__(self, inner) -> None:
         self._inner = inner
-        # world rank -> [messages, bytes], split by context
-        self.p2p: Dict[int, list] = {}
-        self.coll: Dict[int, list] = {}
 
     # -- counting helpers -------------------------------------------------
-    def _count(self, comm, dst: int, nbytes: int,
-               collective: bool) -> None:
-        if dst < 0:  # PROC_NULL
+    @staticmethod
+    def _count(comm, dst: int, nbytes: int, collective: bool,
+               ns: int = 0, tag: int = 0) -> None:
+        tm = _matrix.TRAFFIC
+        if tm is None:
             return
-        try:
-            g = comm.remote_group if getattr(comm, "is_inter", False) \
-                else comm.group
-            world = g.ranks[dst]
-        except (IndexError, AttributeError):
-            world = dst
-        table = self.coll if collective else self.p2p
-        cell = table.setdefault(world, [0, 0])
-        cell[0] += 1
-        cell[1] += nbytes
-        pvar.record("monitoring_msgs")
-        pvar.record("monitoring_bytes", nbytes)
-        # per-context counters (reference common/monitoring splits its
-        # counting by p2p vs collective the same way); the combined
-        # pair above stays for compatibility
-        kind = "coll" if collective else "p2p"
-        pvar.record(f"monitoring_{kind}_msgs")
-        pvar.record(f"monitoring_{kind}_bytes", nbytes)
+        if tag <= _PART_TAG_CEIL:
+            ctx = "part"
+        else:
+            ctx = "coll" if collective else "p2p"
+        tm.count(ctx, _matrix.world_rank(comm, dst), nbytes, ns=ns)
 
     @staticmethod
     def _nbytes(buf, count, dtype) -> int:
@@ -76,20 +87,28 @@ class MonitoringPml:
     # -- intercepted send-side entries ------------------------------------
     def isend(self, comm, buf, count, dtype, dst, tag, **kw):
         self._count(comm, dst, self._nbytes(buf, count, dtype),
-                    kw.get("collective", False))
+                    kw.get("collective", False), tag=tag)
         return self._inner.isend(comm, buf, count, dtype, dst, tag, **kw)
 
     def send(self, comm, buf, count, dtype, dst, tag, **kw):
+        t0 = time.monotonic_ns()
+        out = self._inner.send(comm, buf, count, dtype, dst, tag, **kw)
         self._count(comm, dst, self._nbytes(buf, count, dtype),
-                    kw.get("collective", False))
-        return self._inner.send(comm, buf, count, dtype, dst, tag, **kw)
+                    kw.get("collective", False),
+                    ns=time.monotonic_ns() - t0, tag=tag)
+        return out
 
     def isend_obj(self, comm, obj, dst, tag, **kw):
-        self._count(comm, dst, 0, kw.get("collective", False))
+        if tag != _OSC_SERVICE_TAG:
+            self._count(comm, dst, 0, kw.get("collective", False))
         return self._inner.isend_obj(comm, obj, dst, tag, **kw)
 
     def send_obj(self, comm, obj, dst, tag, **kw):
-        self._count(comm, dst, 0, kw.get("collective", False))
+        # osc window service messages are counted at the epoch path
+        # (ctx="osc", with their actual payload bytes) — counting them
+        # here too would double-book every put/get/ack
+        if tag != _OSC_SERVICE_TAG:
+            self._count(comm, dst, 0, kw.get("collective", False))
         return self._inner.send_obj(comm, obj, dst, tag, **kw)
 
     # -- everything else passes through -----------------------------------
@@ -98,9 +117,16 @@ class MonitoringPml:
 
 
 def install() -> MonitoringPml:
-    """Wrap the currently-selected PML (idempotent)."""
+    """Wrap the currently-selected PML (idempotent). Enables the
+    matrix core at level 1 if the plane isn't up yet, so the direct
+    ``monitoring.install()`` API keeps working without the runtime."""
     from ompi_tpu import pml
 
+    if _matrix.TRAFFIC is None:
+        from ompi_tpu.runtime import rte
+
+        _matrix.enable(rank=rte.rank, level=1,
+                       nranks=max(rte.size, 1))
     cur = pml.current()
     if isinstance(cur, MonitoringPml):
         return cur
@@ -130,24 +156,24 @@ def uninstall() -> None:
 
 
 def matrix(collective: bool = False) -> Dict[int, Tuple[int, int]]:
-    """Send-side traffic matrix {peer_world_rank: (msgs, bytes)}."""
-    mon = installed()
-    if mon is None:
+    """Send-side traffic matrix {peer_world_rank: (msgs, bytes)} —
+    the plane's p2p (or coll) context table."""
+    tm = _matrix.TRAFFIC
+    if tm is None:
         return {}
-    table = mon.coll if collective else mon.p2p
-    return {peer: tuple(cell) for peer, cell in sorted(table.items())}
+    return dict(sorted(
+        tm.peer_totals("coll" if collective else "p2p").items()))
 
 
 def dump() -> None:
     """common/monitoring-style matrix dump to the output stream."""
-    mon = installed()
-    if mon is None:
+    tm = _matrix.TRAFFIC
+    if tm is None:
         _out.verbose(0, "monitoring not installed")
         return
-    from ompi_tpu.runtime import rte
-
-    for label, table in (("p2p", mon.p2p), ("coll", mon.coll)):
-        for peer, (msgs, nbytes) in sorted(table.items()):
+    for label in ("p2p", "coll"):
+        for peer, (msgs, nbytes) in sorted(
+                tm.peer_totals(label).items()):
             _out.verbose(
                 0, "rank %d -> %d [%s]: %d msgs, %d bytes",
-                rte.rank, peer, label, msgs, nbytes)
+                tm.rank, peer, label, msgs, nbytes)

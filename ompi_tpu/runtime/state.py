@@ -87,13 +87,21 @@ def init_instance() -> None:
         pml.select()
         # interposition layers stack over the selected PML before any
         # traffic flows (reference: pml/monitoring wraps at select)
-        from ompi_tpu.pml import monitoring as _pml_mon
         from ompi_tpu.pml import vprotocol as _pml_v
 
         if _pml_v._enable_var.get():
             _pml_v.install()
-        if _pml_mon._enable_var.get():
-            _pml_mon.install()
+        # traffic-monitoring plane (cvar monitoring_level /
+        # OMPI_TPU_MONITORING; --mca pml_monitoring compat-maps to
+        # level 1): matrix core + pml interposition shim, before any
+        # traffic flows
+        from ompi_tpu import monitoring as _monitoring
+
+        if _monitoring.requested():
+            try:
+                _monitoring.start(rank=rte.rank, nranks=rte.size)
+            except Exception as exc:  # monitoring must never sink init
+                _out.verbose(0, "monitoring enable failed: %r", exc)
         # debugger hook: SIGUSR1 match-queue dump (MPIR analog)
         from ompi_tpu.tools import msgq as _msgq
 
@@ -174,6 +182,16 @@ def _release() -> None:
 
             try:
                 _telemetry.stop()
+            except Exception:
+                pass
+            # traffic matrices dump at Finalize (the common/monitoring
+            # contract for --mca pml_monitoring / monitoring_dump) —
+            # after telemetry so the sampler's last publish already
+            # rolled the monitoring pvars up, before the pml dies
+            from ompi_tpu import monitoring as _monitoring
+
+            try:
+                _monitoring.stop()
             except Exception:
                 pass
             # sanitizer after telemetry (its leak report already ran

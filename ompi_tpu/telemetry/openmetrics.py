@@ -62,6 +62,37 @@ def _hist_split(name: str) -> Optional[Tuple[str, int, int]]:
         return None
 
 
+_MON_TX_RE = re.compile(
+    r"^monitoring_tx_(msgs|bytes)_s(\d+)_d(\d+)_([a-z0-9]+)$")
+_MON_LINK_RE = re.compile(
+    r"^monitoring_link_bytes_d(\d+)_r(\d+)_r(\d+)(_hwm)?$")
+_MON_EXPERT_RE = re.compile(r"^monitoring_expert_tokens_e(\d+)$")
+
+
+def _mon_split(name: str
+               ) -> Optional[Tuple[str, Dict[str, str], bool]]:
+    """Monitoring-plane per-cell pvar -> (family, labels, is_gauge):
+    the matrix cells (``monitoring_tx_*_s<i>_d<j>_<ctx>``), per-link
+    loads (``monitoring_link_bytes_d<d>_r<a>_r<b>``, hwm-backed so a
+    gauge) and per-expert token counts fold into labelled families
+    instead of one flat metric per cell."""
+    m = _MON_TX_RE.match(name)
+    if m:
+        return ("monitoring_tx_" + m.group(1),
+                {"src": m.group(2), "dst": m.group(3),
+                 "ctx": m.group(4)}, False)
+    m = _MON_LINK_RE.match(name)
+    if m:
+        return ("monitoring_link_bytes",
+                {"dim": m.group(1), "rank_a": m.group(2),
+                 "rank_b": m.group(3)}, True)
+    m = _MON_EXPERT_RE.match(name)
+    if m:
+        return ("monitoring_expert_tokens",
+                {"expert": m.group(1)}, False)
+    return None
+
+
 def _bin_mid(b: int) -> float:
     """Representative value for log2 bin b (midpoint of
     [2^(b-1), 2^b); b=0 holds exact zeros)."""
@@ -85,12 +116,27 @@ def render(snap: Mapping[str, int],
     lbl = _labelstr(labels)
     lines = []
     hists: Dict[str, Dict[int, Dict[int, int]]] = {}
+    mon_typed: Set[str] = set()  # TYPE emitted once per mon family
     for name in sorted(snap):
         value = snap[name]
         h = _hist_split(name)
         if h is not None:
             op, s, l = h
             hists.setdefault(op, {}).setdefault(s, {})[l] = value
+            continue
+        mon = _mon_split(name)
+        if mon is not None:
+            fam, extra, is_gauge = mon
+            metric = PREFIX + _safe(fam)
+            mlbl = _labelstr({**(labels or {}), **extra})
+            if metric not in mon_typed:
+                mon_typed.add(metric)
+                lines.append("# TYPE %s %s" % (
+                    metric, "gauge" if is_gauge else "counter"))
+            if is_gauge:
+                lines.append("%s%s %d" % (metric, mlbl, value))
+            else:
+                lines.append("%s_total%s %d" % (metric, mlbl, value))
             continue
         metric = PREFIX + _safe(name)
         if name.endswith("_hwm") or name in gauge_keys:

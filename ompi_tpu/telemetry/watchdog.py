@@ -235,6 +235,15 @@ class Watchdog:
         san = _check_san.SANITIZER
         if san is not None and san.last_mismatch is not None:
             doc["check_mismatch"] = san.last_mismatch
+        # a congested ICI link is another likely hang cause: name this
+        # rank's hottest link + its top peer (optional key, level 2)
+        from ompi_tpu.monitoring import matrix as _mon
+
+        tm = _mon.TRAFFIC
+        if tm is not None:
+            hot = tm.hotspot()
+            if hot:
+                doc["traffic_hotspot"] = hot
         from ompi_tpu.trace import recorder as _trace
 
         rec = _trace.RECORDER

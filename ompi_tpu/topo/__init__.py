@@ -127,6 +127,32 @@ class CartTopo:
     in_neighbors = neighbors
     out_neighbors = neighbors
 
+    def route(self, src: int, dst: int) -> List[Tuple[int, int, int, int]]:
+        """Minimal-hop dimension-ordered route src -> dst on the grid:
+        the hop list [(from_rank, to_rank, dim, step)] a message
+        traverses, walking each dimension in turn by +/-1 steps and
+        taking the wraparound direction on periodic dims when it is
+        strictly shorter (ties -> positive direction, matching the ICI
+        default route). This is the monitoring plane's link-attribution
+        model — dimension-ordered routing on the torus."""
+        hops: List[Tuple[int, int, int, int]] = []
+        cur = list(self.coords(src))
+        tgt = self.coords(dst)
+        here = src
+        for d, size in enumerate(self.dims):
+            delta = tgt[d] - cur[d]
+            if self.periods[d] and size > 1:
+                # shortest signed distance on the ring; tie -> +1
+                delta = (delta + size // 2 - (size % 2 == 0)) \
+                    % size - size // 2 + (size % 2 == 0)
+            step = 1 if delta > 0 else -1
+            for _ in range(abs(delta)):
+                cur[d] += step
+                nxt = self.rank_of(cur)
+                hops.append((here, nxt, d, step))
+                here = nxt
+        return hops
+
 
 class GraphTopo:
     """MPI_Graph_create topology (index/edges arrays)."""

@@ -68,6 +68,26 @@ def _xfer_counters(spans: Sequence, rank: int,
     return rows
 
 
+def _link_counters(rank: int, shift_ns: int) -> List[Dict[str, Any]]:
+    """Perfetto counter tracks from the monitoring plane's per-link
+    series (level 2): cumulative bytes over the hottest ICI link at
+    each attribution sample — renders congestion ramps next to the
+    span lanes."""
+    from ompi_tpu.monitoring import matrix as _mon
+
+    tm = _mon.TRAFFIC
+    if tm is None:
+        return []
+    rows: List[Dict[str, Any]] = []
+    for t_ns, link, cum_bytes in tm.link_series():
+        rows.append({
+            "ph": "C", "name": f"ici_link {link}",
+            "pid": rank, "tid": 0,
+            "ts": (t_ns + shift_ns) / 1e3,
+            "args": {"bytes": int(cum_bytes)}})
+    return rows
+
+
 def to_chrome(rec: Optional["_rec.Recorder"] = None,
               spans: Optional[Sequence] = None) -> Dict[str, Any]:
     """Recorder (default: the live one) -> Chrome trace dict."""
@@ -101,6 +121,7 @@ def to_chrome(rec: Optional["_rec.Recorder"] = None,
             row["args"] = sp.args
         rows.append(row)
     rows.extend(_xfer_counters(spans, rank, shift_ns))
+    rows.extend(_link_counters(rank, shift_ns))
     rows.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
     snap = pvar.snapshot()
     return {

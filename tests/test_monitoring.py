@@ -123,3 +123,213 @@ def test_profile_nested_tools():
         comm.Barrier()
         assert seen == ["inner", "outer", "outer"], seen
     """, 2, timeout=120)
+
+# -- monitoring plane (matrices + links + merge + report) ----------------
+
+
+def test_algo_per_peer_models():
+    """Ring RS/AG vs alltoall send-side byte models: the plane's
+    algorithmic accounting must match the implemented algorithms."""
+    from ompi_tpu.monitoring import algo
+    n, B = 4, 4096.0
+    # ring family: everything to the next rank, (n-1)/n of the buffer
+    rs = algo.per_peer("reduce_scatter", 1, n, B)
+    assert rs == {2: (n - 1) / n * B}, rs
+    ag = algo.per_peer("allgather", 3, n, B)
+    assert ag == {0: (n - 1) / n * B}, ag
+    # allreduce = RS + AG over the same ring
+    ar = algo.per_peer("allreduce", 0, n, B)
+    assert ar == {1: 2 * (n - 1) / n * B}, ar
+    # alltoall: B/n to every other peer (nothing to self)
+    a2a = algo.per_peer("alltoall", 1, n, B)
+    assert a2a == {0: B / n, 2: B / n, 3: B / n}, a2a
+    assert sum(a2a.values()) < sum(rs.values()) * 2
+    # rooted: non-root bcast forwards along the ring pipeline,
+    # the rank before root sends nothing
+    assert algo.per_peer("bcast", 0, n, B, root=1) == {}
+    assert algo.per_peer("bcast", 1, n, B, root=1) == {2: B}
+    # reduce chain: root terminates it
+    assert algo.per_peer("reduce", 2, n, B, root=2) == {}
+    # alltoallv uses the actual splits
+    v = algo.per_peer("alltoallv", 0, 3, 0.0,
+                      counts=[5, 0, 2], row_bytes=8.0)
+    assert v == {2: 16.0}, v  # zero-count rows drop out
+
+
+def test_linkmap_torus_wraparound():
+    """2x2 torus: opposite corners route over two links; ring of 4:
+    rank 0 -> 3 takes the wraparound link, not three hops."""
+    from ompi_tpu.monitoring.links import LinkMap, link_name
+    lm = LinkMap((2, 2))
+    hops = lm.route(0, 3)
+    assert hops == [(0, 0, 2), (1, 2, 3)], hops
+    ring = LinkMap((4,))
+    wrap = ring.route(0, 3)
+    assert wrap == [(0, 0, 3)], wrap  # one wraparound hop
+    assert link_name((0, 0, 3)) == "d0:r0-r3"
+    loads = {}
+    lm.charge(loads, 0, 3, 100.0)
+    lm.charge(loads, 0, 1, 50.0)
+    assert loads[(0, 0, 2)] == 100.0 and loads[(1, 2, 3)] == 100.0
+    assert loads[(1, 0, 1)] == 50.0
+    (hot, hb), = LinkMap.hottest(loads, top=1)
+    assert hb == 100.0 and hot in ((0, 0, 2), (1, 2, 3))
+    assert LinkMap.imbalance(loads) > 1.0
+    # 2-rank world degenerates to a single link on one dim
+    lm2 = LinkMap.for_world(2)
+    assert lm2.route(0, 1) == [(0, 0, 1)]
+
+
+def test_world_rank_invalid_peer():
+    from ompi_tpu import errors
+    from ompi_tpu.monitoring import matrix
+    from ompi_tpu.pml.request import ANY_SOURCE, PROC_NULL
+
+    class G:
+        ranks = [4, 7]
+
+    class C:
+        group = G()
+        is_inter = False
+
+    assert matrix.world_rank(C(), 1) == 7
+    assert matrix.world_rank(C(), PROC_NULL) == PROC_NULL
+    assert matrix.world_rank(C(), ANY_SOURCE) == ANY_SOURCE
+    try:
+        matrix.world_rank(C(), 5)
+        raise AssertionError("expected MPIError")
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_RANK
+
+
+def test_service_tag_constants_agree():
+    """The shim duplicates the osc/part tag constants (import-cycle
+    avoidance) — they must track the originals."""
+    from ompi_tpu import osc
+    from ompi_tpu.part import host as part_host
+    from ompi_tpu.pml import monitoring as pml_mon
+    assert pml_mon._OSC_SERVICE_TAG == osc._SERVICE_TAG
+    assert pml_mon._PART_TAG_CEIL == part_host._PART_BASE
+
+
+def test_level_zero_plane_is_off():
+    """Default sessions pay one branch: no matrix, level() == 0, and
+    expert_load is a no-op."""
+    import ompi_tpu.monitoring as monitoring
+    from ompi_tpu.monitoring import matrix
+    assert matrix.TRAFFIC is None
+    assert not monitoring.requested()
+    monitoring.expert_load([3, 5])  # must not raise or record
+
+
+def test_merge_transpose_and_report(tmp_path):
+    """Symmetric 2-rank traffic merges with zero transpose skew and
+    the report names the single hot link."""
+    import json
+    from ompi_tpu.monitoring import matrix, merge, report
+    docs = []
+    try:
+        for r in range(2):
+            matrix.enable(rank=r, level=2, nranks=2)
+            tm = matrix.TRAFFIC
+            tm.count("p2p", 1 - r, 2048, msgs=2)
+            tm.expert_tokens([10, 0, 6])
+            docs.append(merge.snapshot_doc(tm))
+            matrix.disable()
+    finally:
+        matrix.disable()
+    merged = merge.merge(docs)
+    assert merged["nranks"] == 2
+    assert merged["transpose_skew"]["p2p"] == 0.0
+    assert merged["tx_bytes"] == [2048.0, 2048.0]
+    assert merged["rx_bytes"] == [2048.0, 2048.0]
+    assert merged["links"] == [{"name": "d0:r0-r1", "bytes": 4096.0}]
+    assert merged["expert_tokens"] == {0: 20, 2: 12}
+    text = report.render(merged)
+    assert "d0:r0-r1" in text and "tx_total" in text
+    # round-trips through the CLI
+    paths = []
+    for i, d in enumerate(docs):
+        p = tmp_path / f"m{i}.json"
+        p.write_text(json.dumps(d))
+        paths.append(str(p))
+    from ompi_tpu.monitoring.__main__ import main
+    out = tmp_path / "merged.json"
+    assert main(["report", *paths, "--json", str(out)]) == 0
+    assert json.loads(out.read_text())["nranks"] == 2
+    assert main(["report", str(tmp_path / "missing.json")]) == 1
+    bad = tmp_path / "bad.json"
+    bad.write_text("garbage")
+    assert main(["report", str(bad)]) == 1
+
+
+def test_openmetrics_monitoring_labels():
+    """Per-cell/link/expert pvar families render as labelled
+    OpenMetrics series, not one flat metric per cell."""
+    from ompi_tpu.telemetry import openmetrics as om
+    snap = {
+        "monitoring_tx_bytes_s0_d1_p2p": 2048,
+        "monitoring_tx_msgs_s0_d1_p2p": 2,
+        "monitoring_link_bytes_d0_r0_r1_hwm": 4096,
+        "monitoring_expert_tokens_e3": 17,
+    }
+    text = om.render(snap, labels={"rank": "0"})
+    assert ('ompi_tpu_monitoring_tx_bytes_total'
+            '{ctx="p2p",dst="1",rank="0",src="0"} 2048') in text
+    assert ('ompi_tpu_monitoring_link_bytes'
+            '{dim="0",rank="0",rank_a="0",rank_b="1"} 4096') in text
+    assert ('ompi_tpu_monitoring_expert_tokens_total'
+            '{expert="3",rank="0"} 17') in text
+    parsed = om.parse(text)
+    assert parsed["monitoring_link_bytes"] \
+        [('{dim="0",rank="0",rank_a="0",rank_b="1"}')] == 4096
+
+
+def test_traffic_plane_two_ranks():
+    """End-to-end at monitoring_level 2: send-side totals equal the
+    actual bytes per context (p2p + partitioned), the merged matrix
+    transposes cleanly, and the Finalize-style dump round-trips."""
+    run_ranks("""
+        import json, os
+        import ompi_tpu.monitoring as monitoring
+        from ompi_tpu.core import pvar
+        from ompi_tpu.monitoring import matrix, merge
+        tm = matrix.TRAFFIC
+        assert tm is not None and tm.level == 2
+        s = pvar.session()
+        peer = 1 - rank
+        data = np.ones(256, dtype=np.float64)  # 2048 bytes
+        if rank == 0:
+            comm.Send(data, dest=peer, tag=9)
+            comm.Recv(data, source=peer, tag=9)
+        else:
+            comm.Recv(data, source=peer, tag=9)
+            comm.Send(data, dest=peer, tag=9)
+        assert s.read("monitoring_p2p_bytes") == 2048
+        # partitioned chunks classify as ctx=part via their tag range
+        sreq = comm.Psend_init(data, 4, peer, tag=3)
+        rreq = comm.Precv_init(np.empty_like(data), 4, peer, tag=3)
+        sreq.start(); rreq.start()
+        for i in range(4):
+            sreq.Pready(i)
+        from ompi_tpu.pml import request as rq
+        rq.wait_all([sreq, rreq])
+        assert s.read("monitoring_part_bytes") == 2048, \\
+            s.read("monitoring_part_bytes")
+        assert s.read("monitoring_p2p_bytes") == 2048  # unchanged
+        # merged view: symmetric traffic -> zero transpose skew
+        docs = comm.allgather(merge.snapshot_doc(tm))
+        if rank == 0:
+            merged = merge.merge(docs)
+            assert merged["transpose_skew"]["p2p"] == 0.0
+            assert merged["transpose_skew"]["part"] == 0.0
+            assert merged["tx_bytes"] == [4096.0, 4096.0], merged
+            assert any(l["name"] == "d0:r0-r1"
+                       for l in merged["links"]), merged
+        path = monitoring.finalize_dump()
+        assert path and os.path.exists(path)
+        doc = json.load(open(path))
+        assert doc["schema"] == merge.SCHEMA and doc["rank"] == rank
+    """, 2, mca={"monitoring_level": "2",
+                 "monitoring_dump": "/tmp/mon_test_{rank}.json"},
+        timeout=180)
