@@ -528,6 +528,76 @@ def _bench_monitoring():
     }
 
 
+def _bench_ingest():
+    """Streamed vs serial cold start (BENCH_r05: 471s of 488s wall
+    was serial upload-then-compile). Serial arm: to_device every
+    leaf, block, then compile. Streamed arm: IngestEngine
+    upload_and_compile — multi-stream double-buffered H2D with the
+    compile running concurrently on the dedicated stream. Each arm
+    jits a distinct-constant function so the in-process jit cache
+    can't hand the second arm a free compile."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu.accelerator import current as acc_current
+    from ompi_tpu.core import pvar
+    from ompi_tpu.ingest import engine as ingest_engine
+
+    nleaves, leaf_elems = 8, 1 << 20  # 8 x 4 MB f32 = 32 MB
+    rng = np.random.default_rng(7)
+    tree = {f"w{i}": rng.standard_normal(leaf_elems).astype(np.float32)
+            for i in range(nleaves)}
+    total_bytes = sum(a.nbytes for a in tree.values())
+
+    def make_compile(tag):
+        # distinct constant per arm -> distinct jaxpr -> cold compile
+        c = jnp.float32(1.0 + tag)
+
+        def fn():
+            f = jax.jit(lambda x: jnp.tanh(x @ x.T) * c
+                        + jnp.arange(256, dtype=jnp.float32))
+            out = f(jnp.ones((256, 256), jnp.float32))
+            jax.block_until_ready(out)
+        return fn
+
+    acc = acc_current()
+    t0 = time.perf_counter()
+    dev = {k: acc.to_device(v) for k, v in tree.items()}
+    jax.block_until_ready(dev)
+    make_compile(0)()
+    serial_s = time.perf_counter() - t0
+
+    sess = pvar.session()
+    eng = ingest_engine.IngestEngine()
+    try:
+        t0 = time.perf_counter()
+        req, ev = eng.upload_and_compile(tree, make_compile(1))
+        req.gate(["w0"])
+        first_leaf_s = time.perf_counter() - t0
+        req.wait()
+        upload_s = time.perf_counter() - t0
+        ev.wait()
+        streamed_s = time.perf_counter() - t0
+        got = req.tree()
+        identical = all(
+            np.array_equal(np.asarray(got[k]), tree[k]) for k in tree)
+    finally:
+        eng.close()
+    return {
+        "serial_cold_s": round(serial_s, 3),
+        "streamed_cold_s": round(streamed_s, 3),
+        "first_leaf_s": round(first_leaf_s, 3),
+        "upload_s": round(upload_s, 3),
+        "cold_start_speedup": round(serial_s / max(streamed_s, 1e-9), 3),
+        "overlap_s": round(
+            sess.read("prof_phase_overlap_ns") / 1e9, 3),
+        "ingest_h2d_GBs": round(
+            total_bytes / max(upload_s, 1e-9) / 1e9, 2),
+        "bit_identical": bool(identical),
+    }
+
+
 #: microbench extras compared across rounds once a TPU round records
 #: them in bench_baseline.json: (section, key, higher_is_better)
 _EXTRA_BASELINE_KEYS = (
@@ -540,6 +610,9 @@ _EXTRA_BASELINE_KEYS = (
     ("zero", "zero_cycle_32x256k_ms", False),
     ("zero", "fused_cycle_speedup", True),
     ("zero", "rs_launches_per_cycle", False),
+    ("ingest", "streamed_cold_s", False),
+    ("ingest", "cold_start_speedup", True),
+    ("ingest", "ingest_h2d_GBs", True),
 )
 
 
@@ -660,6 +733,13 @@ def main() -> None:
             _phase("zero microbench done")
         except Exception as e:
             _phase(f"zero microbench skipped: {e!r}")
+    ingest = None
+    if "--ingest" in sys.argv:
+        try:
+            ingest = _bench_ingest()
+            _phase("ingest microbench done")
+        except Exception as e:
+            _phase(f"ingest microbench skipped: {e!r}")
     if trace_path is not None:
         from ompi_tpu.trace import export as trace_export
         from ompi_tpu.trace import recorder as trace_rec
@@ -696,7 +776,8 @@ def main() -> None:
             vs_extra = _vs_extras(base.get("extra"),
                                   {"dispatch": dispatch,
                                    "overlap": overlap,
-                                   "zero": zero})
+                                   "zero": zero,
+                                   "ingest": ingest})
         except Exception:
             pass
 
@@ -726,11 +807,20 @@ def main() -> None:
             "staging_d2h_chunked_GBs":
                 None if d2h_chunked is None else round(d2h_chunked, 2),
             "staging_h2d_GBs": None if h2d is None else round(h2d, 2),
+            # d2h regression flag (BENCH_r05's 0.01 GB/s finding): the
+            # framework's chunked readback must hold >= half the raw
+            # jax.device_get control on the same (possibly degraded)
+            # link — a ~20x gap means the chunked path regressed, not
+            # the platform
+            "staging_d2h_ok": (
+                None if d2h is None or d2h_raw is None or d2h_raw <= 0
+                else bool(d2h >= 0.5 * d2h_raw)),
             "dispatch": dispatch,
             "overlap": overlap,
             "telemetry": telemetry,
             "monitoring": monitoring,
             "zero": zero,
+            "ingest": ingest,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),
             # wall attribution from the prof-plane phase ledger

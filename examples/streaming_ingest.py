@@ -1,0 +1,89 @@
+"""Streaming ingest — overlap the H2D parameter upload with XLA
+compilation and start step 1 before the upload finishes.
+
+The serial cold start does three things back to back: device_put the
+whole checkpoint, wait, compile the train step, wait, run step 1.
+BENCH_r05 measured that sequence at 471s of a 488s wall. The ingest
+plane pipelines all three: the pytree is cut into ``ingest_chunk_bytes``
+units streamed over ``ingest_streams`` upload streams through a ring
+of ``ingest_depth`` reusable staging buffers, the compile runs
+concurrently on a dedicated stream, and the returned request is
+*partially available* — ``gate(keys)`` blocks only on the leaves the
+first step touches, so step 1 starts while the tail is still in
+flight (``Parrived`` is the same MPI-4 probe the partitioned-recv
+request exposes; both implement part.partial.PartialAvailability).
+
+Run:  python -m ompi_tpu.runtime.launcher -n 2 \
+          --mca ingest_enable 1 --mca ingest_chunk_bytes 65536 \
+          --mca prof_enable 1 \
+          examples/streaming_ingest.py
+
+(The small unit size splits this toy checkpoint into enough units to
+make the pipeline visible; real checkpoints dwarf the 4 MiB default.)
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ompi_tpu import mpi
+from ompi_tpu.core import pvar
+from ompi_tpu.ingest import engine as ingest_engine
+from ompi_tpu.prof import ledger as prof
+
+comm = mpi.Init()
+rank, size = comm.rank, comm.size
+
+eng = ingest_engine.INGEST
+if eng is None:  # run without the launcher/mca: bring it up locally
+    eng = ingest_engine.enable(rank=rank)
+
+# a toy "checkpoint": embedding + a few layers + head
+rng = np.random.default_rng(1234 + rank)
+params = {
+    "embed": rng.standard_normal((512, 128)).astype(np.float32),
+    "layer0": rng.standard_normal((128, 128)).astype(np.float32),
+    "layer1": rng.standard_normal((128, 128)).astype(np.float32),
+    "head": rng.standard_normal((128, 512)).astype(np.float32),
+}
+
+
+def compile_step():
+    """Stands in for the jit lower/compile of the train step — runs
+    on the ingest plane's dedicated compile stream, concurrently with
+    the upload (the prof ledger's overlap accounting proves it)."""
+    return jax.jit(
+        lambda e, w: jnp.tanh(e @ w)).lower(
+            jnp.ones((4, 128), jnp.float32),
+            jnp.ones((128, 128), jnp.float32)).compile()
+
+
+sess = pvar.session()
+t0 = time.perf_counter()
+req, compiled = eng.upload_and_compile(params, compile_step)
+
+# step 1 reads only the embedding + first layer: gate on exactly that
+req.gate(["embed", "layer0"])
+step_fn = compiled.wait(60)
+out = step_fn(req.leaf("embed")[:4], req.leaf("layer0"))
+jax.block_until_ready(out)
+early = "before" if not req.test() else "after"
+print(f"[rank {rank}] step 1 ran {early} the upload finished "
+      f"({time.perf_counter() - t0:.3f}s in)")
+
+req.wait()                      # drain the tail
+dev_params = req.tree()         # full pytree, bit-identical
+for k, v in params.items():
+    np.testing.assert_array_equal(np.asarray(dev_params[k]), v)
+
+comm.Barrier()
+if rank == 0:
+    print(f"uploaded {sess.read('ingest_bytes')} bytes in "
+          f"{sess.read('ingest_units')} units over {eng.n_streams} "
+          f"streams (early starts: "
+          f"{sess.read('ingest_early_starts')}, compile overlaps: "
+          f"{sess.read('ingest_compile_overlaps')}, "
+          f"ledger overlap: {prof.overlap_seconds():.3f}s)")
+mpi.Finalize()

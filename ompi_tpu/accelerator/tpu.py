@@ -94,17 +94,70 @@ class TpuAccelerator(Accelerator):
     #: transient), which must not OOM multi-GB staged buffers
     H2D_CHUNK_LIMIT_BYTES = 1 << 30
 
+    #: D2H readback floor: BENCH_r05 measured the 8 MiB-chunk d2h
+    #: mitigation at 0.01 GB/s == the raw single-shot path — readback
+    #: is latency-bound on tunneled platforms, so small chunks only
+    #: multiply the per-read latency (~100x under h2d). The floor is
+    #: therefore much HIGHER than H2D_CHUNK_BYTES and the chunk count
+    #: much lower: only multi-hundred-MB reads split, into few big
+    #: contiguous slices whose copy_to_host_async reads overlap.
+    D2H_CHUNK_BYTES = 32 << 20
+    D2H_MAX_CHUNKS = 4
+
     def to_host(self, buf):
-        # single-stream: D2H readback is serialized device-side (chunked
-        # threaded reads measure *slower*; see bench.py staging notes)
         jax = self._ensure()
-        if _prof.PROFILER is None:
-            return self._np.asarray(jax.device_get(buf))
-        t0 = _prof.now()
-        out = self._np.asarray(jax.device_get(buf))
-        _prof.PROFILER.xfer("d2h", out.nbytes, t0, _prof.now(),
-                            site="to_host")
+        np = self._np
+        prof = _prof.PROFILER
+        t_all = _prof.now() if prof is not None else 0
+        nbytes = int(getattr(buf, "nbytes", 0) or 0)
+        sharding = getattr(buf, "sharding", None)
+        if (nbytes >= 2 * self.D2H_CHUNK_BYTES
+                and hasattr(buf, "reshape")
+                and (sharding is None
+                     or len(sharding.device_set) == 1)):
+            out = self._to_host_chunked(buf, nbytes, prof)
+            if out is not None:
+                if prof is not None:
+                    prof.xfer("d2h", out.nbytes, t_all, _prof.now(),
+                              site="to_host",
+                              chunks=min(self.D2H_MAX_CHUNKS,
+                                         nbytes
+                                         // self.D2H_CHUNK_BYTES))
+                return out
+        if prof is None:
+            return np.asarray(jax.device_get(buf))
+        out = np.asarray(jax.device_get(buf))
+        prof.xfer("d2h", out.nbytes, t_all, _prof.now(),
+                  site="to_host")
         return out
+
+    def _to_host_chunked(self, buf, nbytes: int, prof):
+        """Concurrent chunked readback of one large single-device
+        array: block-gather to a flat view first (every read is then
+        one contiguous DMA, not a strided gather), start every
+        chunk's copy_to_host_async before materializing any, then
+        concatenate. None: backend lacks the async-copy API — caller
+        falls back to the single-shot path."""
+        np = self._np
+        flat = buf.reshape(-1)
+        nch = min(self.D2H_MAX_CHUNKS,
+                  max(2, nbytes // self.D2H_CHUNK_BYTES))
+        bounds = [int(flat.size * i // nch) for i in range(nch + 1)]
+        parts = [flat[bounds[i]:bounds[i + 1]] for i in range(nch)]
+        try:
+            for p in parts:
+                p.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — backend-dependent API
+            return None
+        hparts = []
+        for ci, p in enumerate(parts):
+            tc = _prof.now() if prof is not None else 0
+            h = np.asarray(p)
+            if prof is not None:
+                prof.xfer_chunk("d2h", h.nbytes, tc, _prof.now(),
+                                chunk=ci, stream=ci)
+            hparts.append(h)
+        return np.concatenate(hparts).reshape(buf.shape)
 
     def to_device(self, host_array, like=None):
         jax = self._ensure()
@@ -180,6 +233,50 @@ class TpuAccelerator(Accelerator):
             if getattr(self, "_d2h", None) is None:
                 self._d2h = self.create_stream()
         return self._d2h
+
+    # -- H2D upload pool (the ingest plane's substrate) -------------------
+    def h2d_streams(self, n: int):
+        """Ordered H2D upload streams, created lazily and REUSED —
+        the ingest engine asks for its ``ingest_streams`` worth every
+        upload and must get the same executors back (ring-buffer
+        reuse relies on per-stream FIFO order across uploads)."""
+        with self._lock:
+            pool = getattr(self, "_h2d_pool", None)
+            if pool is None:
+                pool = self._h2d_pool = []
+            while len(pool) < n:
+                pool.append(self.create_stream())
+            return pool[:n]
+
+    def close_h2d_streams(self) -> None:
+        with self._lock:
+            pool, self._h2d_pool = getattr(
+                self, "_h2d_pool", None) or [], None
+        for st in pool:
+            st.destroy()
+
+    def put_chunk(self, chunk, device=None):
+        """One raw async H2D put of a staged flat view. Deliberately
+        unprofiled here: the ingest engine owns the accounting (one
+        ``xfer`` per unit at retire time — a put-side span would
+        double-count the same bytes).
+
+        The CPU backend may make ``device_put`` ZERO-COPY — the
+        returned array aliases the staging view the ingest ring is
+        about to repack. When the result shares the host pointer, a
+        real device copy is forced so ``block_until_ready`` =="this
+        staging slot is reusable" holds on every backend."""
+        jax = self._ensure()
+        out = (jax.device_put(chunk, device) if device is not None
+               else jax.device_put(chunk))
+        try:
+            alias = (out.unsafe_buffer_pointer()
+                     == chunk.__array_interface__["data"][0])
+        except Exception:  # noqa: BLE001 — backend-dependent API
+            alias = False
+        if alias:
+            out = jax.numpy.array(out, copy=True)
+        return out
 
     def alloc(self, shape, dtype):
         jax = self._ensure()

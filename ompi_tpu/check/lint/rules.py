@@ -51,8 +51,8 @@ CATALOG: Dict[str, str] = {
         "freed, closed, returned, stored, or passed on",
     "bare-public-raise":
         "raise ValueError/TypeError on an MPI API path (coll/, osc/, "
-        "shmem/, part/) — raise errors.MPIError(ERR_*) so the comm "
-        "errhandler sees it (a bare ValueError bypasses "
+        "shmem/, part/, ingest/) — raise errors.MPIError(ERR_*) so "
+        "the comm errhandler sees it (a bare ValueError bypasses "
         "_with_errhandler dispatch)",
     "unregistered-pvar":
         "pvar recorded under a literal name missing from "
@@ -60,9 +60,9 @@ CATALOG: Dict[str, str] = {
         "will not export it at 0 (dynamic f-string families are "
         "exempt)",
     "unguarded-observability":
-        "direct call through an observability guard global "
-        "(FLIGHT/RECORDER/SANITIZER) with no enclosing None check — "
-        "hot paths must bind the guard once and branch on it",
+        "direct call through an observability guard global (FLIGHT/"
+        "RECORDER/SANITIZER/TRAFFIC/INGEST) with no enclosing None "
+        "check — hot paths must bind the guard once and branch on it",
     "parse-error":
         "the file does not parse; nothing else can be checked",
 }
@@ -121,11 +121,12 @@ FREE_NAMES = frozenset(("free", "Free", "close", "Close",
 
 #: module globals carrying the one-branch disabled guard convention
 GUARD_GLOBALS = frozenset(("FLIGHT", "RECORDER", "SANITIZER",
-                           "TRAFFIC"))
+                           "TRAFFIC", "INGEST"))
 
 #: path components marking the MPI-convention public API surface for
-#: bare-public-raise (the satellite scope: coll/, osc/, shmem/, part/)
-PUBLIC_API_DIRS = frozenset(("coll", "osc", "shmem", "part"))
+#: bare-public-raise (coll/, osc/, shmem/, part/, ingest/)
+PUBLIC_API_DIRS = frozenset(("coll", "osc", "shmem", "part",
+                             "ingest"))
 
 
 # -- shared walking helpers ----------------------------------------------

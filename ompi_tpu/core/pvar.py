@@ -68,6 +68,10 @@ WELL_KNOWN = (
     # compilation cache hit/miss accounting (compile_cache_dir cvar)
     "prof_phase_staging_ns", "prof_phase_compile_ns",
     "prof_phase_train_ns", "prof_phase_teardown_ns",
+    # cross-thread phase overlap (ingest: staging || compile run
+    # concurrently, so per-phase walls may sum past the job wall —
+    # this counter quantifies the legitimately-double-counted span)
+    "prof_phase_overlap_ns",
     "prof_xfer_h2d_bytes", "prof_xfer_h2d_ns",
     "prof_xfer_d2h_bytes", "prof_xfer_d2h_ns",
     "prof_compile_hits", "prof_compile_misses", "prof_compile_ns",
@@ -83,6 +87,14 @@ WELL_KNOWN = (
     "monitoring_msgs", "monitoring_bytes",
     "monitoring_coll_launches", "monitoring_expert_tokens",
     "monitoring_link_imbalance_permille",
+    # ingest/ plane (streaming H2D upload): uploads kicked off, units
+    # + bytes landed, Parrived probes answered True, first steps
+    # released before the tail finished (the pipeline win), gate wall,
+    # units abandoned by cancel/error, compiles that provably ran
+    # while an upload was in flight, per-stream put-queue depth hwm
+    "ingest_uploads", "ingest_units", "ingest_bytes",
+    "ingest_parrived", "ingest_early_starts", "ingest_gate_ns",
+    "ingest_cancelled", "ingest_compile_overlaps", "ingest_inflight",
     # check/ plane (runtime MPI sanitizer): argument/signature
     # violations raised, leaked requests reported at Finalize,
     # cross-rank fingerprint exchanges performed at level 2

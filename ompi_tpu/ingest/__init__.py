@@ -1,0 +1,45 @@
+"""ompi_tpu.ingest — the streaming ingest plane (ninth subsystem).
+
+Turns the serial ``device_put``-everything-then-compile cold start
+(BENCH_r04/r05: 442–471s of a ~488s wall before step 1 — ROADMAP
+item 1, THE production-latency item) into a pipeline:
+
+- **chunked multi-stream upload** with double-buffered pinned staging
+  rings (cvars ``ingest_streams`` / ``ingest_chunk_bytes`` /
+  ``ingest_depth``) over the accelerator component's H2D stream pool;
+- **compile/upload overlap** — ``_Ctx`` fn/plan compilation and the
+  jax persistent-cache warm path run on a dedicated stream
+  concurrently with the upload, proven by the prof ledger's
+  ``prof_phase_overlap_ns`` accounting;
+- **partial availability** — the MPI-4 ``Pready``/``Parrived`` model
+  (shared with :mod:`ompi_tpu.part` via
+  :class:`~ompi_tpu.part.partial.PartialAvailability`): an
+  :class:`~ompi_tpu.ingest.plan.IngestPlan` partitions the pytree
+  into upload units, the request exposes per-unit completion, and
+  :meth:`~ompi_tpu.ingest.engine.IngestRequest.gate` starts step 1 on
+  the units it actually touches while the tail uploads.
+
+Enable with ``--mca ingest_enable 1`` (or ``OMPI_TPU_INGEST=1``); the
+live engine is the one-branch guard global
+``ompi_tpu.ingest.engine.INGEST``. Off by default; a standalone
+:class:`~ompi_tpu.ingest.engine.IngestEngine` works without the plane
+(bench/tests construct their own).
+"""
+
+from __future__ import annotations
+
+from ompi_tpu.ingest.engine import (  # noqa: F401  (public re-exports)
+    IngestEngine, IngestRequest, default_put, disable, enable,
+    requested,
+)
+from ompi_tpu.ingest.plan import IngestPlan, Unit  # noqa: F401
+
+
+def start(rank: int = 0) -> "IngestEngine":
+    """Plane bring-up (runtime/state.init_instance)."""
+    return enable(rank=rank)
+
+
+def stop() -> None:
+    """Plane teardown (runtime/state._release)."""
+    disable()

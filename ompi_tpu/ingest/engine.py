@@ -1,0 +1,566 @@
+"""Streaming ingest engine — pipelined, partially-available H2D upload.
+
+BENCH_r04/r05: 442–471s of a ~488s wall is serial
+device_put-everything-then-compile before step 1. This engine turns
+that cold start into a pipeline with three mechanisms:
+
+1. **Multi-stream, double-buffered upload.** The
+   :class:`~ompi_tpu.ingest.plan.IngestPlan` cuts the pytree into
+   units of at most ``ingest_chunk_bytes``, assigned round-robin to
+   ``ingest_streams`` ordered upload streams (the accelerator
+   component's H2D stream pool). Each stream packs units into a ring
+   of ``ingest_depth`` reusable pinned staging buffers
+   (``host_register``-ed once, never reallocated per chunk) and
+   dispatches the async ``device_put`` — a slot is reused only after
+   the put that last borrowed it completed, so at most ``depth`` puts
+   per stream are in flight against live host memory.
+
+2. **Compile/upload overlap.** :meth:`IngestEngine.overlap_compile`
+   runs the XLA compile (``_Ctx`` fn/plan builds, ``jax.jit`` lower/
+   compile, the persistent-cache warm path — ``wire_compile_cache``
+   is applied first) on a dedicated stream concurrently with the
+   uploads, under the prof ledger's ``compile`` phase while the
+   upload workers run under ``staging`` — the ledger's cross-thread
+   overlap accounting (``prof_phase_overlap_ns``) then *proves* the
+   two proceeded together.
+
+3. **``Pready``-style partial availability.** The returned
+   :class:`IngestRequest` implements the shared
+   :class:`~ompi_tpu.part.partial.PartialAvailability` mixin:
+   ``Parrived(i)`` probes one upload unit, ``gate(keys)`` blocks only
+   on the leaves step 1 actually touches (recording
+   ``ingest_early_starts`` when it releases while the tail is still
+   uploading), and ``leaf()``/``tree()`` assemble device arrays
+   bit-identical to the one-shot ``to_device`` path.
+
+Guard discipline: the module global ``INGEST`` is the one-branch
+disabled guard (lint ``GUARD_GLOBALS``), brought up by
+``runtime.state.init_instance`` when ``ingest_enable`` /
+``OMPI_TPU_INGEST`` asks for it and torn down (buffers unregistered,
+streams drained) in ``_release``.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ompi_tpu import errors
+from ompi_tpu.core import cvar, output, pvar
+from ompi_tpu.ingest.plan import IngestPlan
+from ompi_tpu.part import partial as _partial
+from ompi_tpu.prof import ledger as _prof
+
+_out = output.stream("ingest")
+
+_enable_var = cvar.register(
+    "ingest_enable", False, bool,
+    help="Bring the streaming ingest plane up at instance init: "
+         "multi-stream double-buffered H2D upload + compile overlap "
+         "+ Parrived-gated first step (equivalently: any truthy "
+         "OMPI_TPU_INGEST env value).",
+    level=4)
+_streams_var = cvar.register(
+    "ingest_streams", 4, int,
+    help="Concurrent H2D upload streams the ingest engine drives "
+         "(the accelerator component's stream pool).", level=5)
+_chunk_var = cvar.register(
+    "ingest_chunk_bytes", 4 << 20, int,
+    help="Upload unit ceiling: each pytree leaf is cut into units of "
+         "at most this many bytes (the Parrived granularity).",
+    level=5)
+_depth_var = cvar.register(
+    "ingest_depth", 2, int,
+    help="Staging buffers per upload stream (2 = classic double "
+         "buffering: pack unit k+1 while unit k's put is in flight).",
+    level=7)
+
+#: THE disabled guard (one-branch convention, lint GUARD_GLOBALS):
+#: consumers do ``if engine.INGEST is not None: ...``.
+INGEST: Optional["IngestEngine"] = None
+
+
+def default_put(view, device=None):
+    """One raw H2D put of a flat staging view — the accelerator
+    component's ``put_chunk`` when it has one, plain
+    ``jax.device_put`` otherwise. Module-level so tests and the smoke
+    lane can wrap it with a deliberately slow simulated device."""
+    from ompi_tpu import accelerator
+
+    put = getattr(accelerator.current(), "put_chunk", None)
+    if put is not None:
+        return put(view, device)
+    try:
+        import jax
+    except Exception as exc:
+        raise errors.MPIError(
+            errors.ERR_NOT_SUPPORTED,
+            f"ingest upload needs an accelerator put path: {exc!r}")
+    out = (jax.device_put(view, device) if device is not None
+           else jax.device_put(view))
+    # CPU-backend device_put may be ZERO-COPY, aliasing the staging
+    # view the drain loop is about to repack — force a real copy so
+    # block_until_ready == "slot reusable" holds on every backend
+    try:
+        alias = (out.unsafe_buffer_pointer()
+                 == view.__array_interface__["data"][0])
+    except Exception:  # noqa: BLE001 — backend-dependent API
+        alias = False
+    if alias:
+        out = jax.numpy.array(out, copy=True)
+    return out
+
+
+class IngestRequest(_partial.PartialAvailability):
+    """Handle on one streamed upload (the partitioned-recv analog:
+    units arrive independently; probe with ``Parrived``, gate the
+    first step with :meth:`gate`, assemble with :meth:`leaf` /
+    :meth:`tree`, drain with :meth:`wait`)."""
+
+    _PARRIVED_PVAR = "ingest_parrived"
+
+    def __init__(self, engine: "IngestEngine", plan: IngestPlan,
+                 device=None) -> None:
+        self._engine = engine
+        self.plan = plan
+        self.device = device
+        self.n_units = plan.n_units
+        self._events = [threading.Event()
+                        for _ in range(plan.n_units)]
+        self._chunks: List[Any] = [None] * plan.n_units
+        self._done_ns = [0] * plan.n_units
+        self._dev_leaves: Dict[int, Any] = {}
+        self._lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self._cancelled = False
+        self._started = False
+        self._pending = plan.n_units
+        self._all_done = threading.Event()
+        self._streams_left = 0
+        #: deepest per-stream put queue observed (tests pin <= depth)
+        self.inflight_hwm = 0
+        if plan.n_units == 0:
+            self._all_done.set()
+
+    # -- PartialAvailability hooks ----------------------------------------
+    @property
+    def completed(self) -> bool:
+        """Every unit landed successfully (a cancelled or failed
+        upload never reads complete — the error surfaces at the next
+        probe/gate/wait instead)."""
+        return (self._all_done.is_set() and self._error is None
+                and not self._cancelled)
+
+    def _partial_started(self) -> bool:
+        return self._started
+
+    def _partial_probe(self, idx: int) -> bool:
+        if not 0 <= idx < self.n_units:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                f"Parrived({idx}): unit index out of "
+                f"[0,{self.n_units})")
+        if not self._events[idx].is_set():
+            return False
+        if self._chunks[idx] is None and self.plan.units[idx].nbytes:
+            self._raise()
+        return True
+
+    # -- completion surface ------------------------------------------------
+    def test(self) -> bool:
+        """Nonblocking: all units resolved (success or not)."""
+        return self._all_done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> "IngestRequest":
+        """Drain the whole upload; raises the recorded MPIError on a
+        failed or cancelled upload."""
+        if not self._all_done.wait(timeout):
+            raise errors.MPIError(
+                errors.ERR_PENDING,
+                f"ingest wait timed out after {timeout}s with "
+                f"{self._pending}/{self.n_units} units outstanding")
+        if self._error is not None or self._cancelled:
+            self._raise()
+        return self
+
+    def gate(self, keys=None,
+             timeout: Optional[float] = None) -> "IngestRequest":
+        """Block until the leaves the first step touches are resident
+        (all of them when ``keys`` is None). THE pipeline win: when
+        the gate releases while the tail is still uploading, step 1
+        starts early — counted in ``ingest_early_starts``."""
+        t0 = _prof.now()
+        units = (self.plan.units if keys is None
+                 else self.plan.units_for(keys))
+        for u in units:
+            if not self._events[u.idx].wait(timeout):
+                raise errors.MPIError(
+                    errors.ERR_PENDING,
+                    f"ingest gate timed out on unit {u.idx} "
+                    f"(leaf {u.leaf})")
+            if self._chunks[u.idx] is None and u.nbytes:
+                self._raise()
+        pvar.record("ingest_gate_ns", _prof.now() - t0)
+        if not self._all_done.is_set():
+            pvar.record("ingest_early_starts")
+        return self
+
+    def unit_done_ns(self, idx: int) -> int:
+        """monotonic_ns timestamp unit ``idx`` landed (0: not yet)."""
+        return self._done_ns[idx]
+
+    def cancel(self) -> None:
+        """Abandon the upload: workers stop at the next unit
+        boundary, unfinished units resolve void, and every later
+        probe/gate/wait raises MPIError (no buffer is left checked
+        out — the staging rings stay with the engine)."""
+        self._cancelled = True
+
+    # -- assembly ----------------------------------------------------------
+    def leaf(self, key):
+        """The device array for one leaf (blocks on just that leaf's
+        units). Reassembly is concatenate-of-flat-chunks + reshape —
+        bit-identical to a one-shot ``to_device`` of the leaf."""
+        li = self.plan.leaf_index(key)
+        with self._lock:
+            got = self._dev_leaves.get(li)
+        if got is not None:
+            return got
+        units = self.plan.leaf_units[li]
+        for u in units:
+            self._events[u.idx].wait()
+            if self._chunks[u.idx] is None and u.nbytes:
+                self._raise()
+        if self._cancelled or self._error is not None:
+            self._raise()
+        arr = self.plan.leaves[li]
+        import jax.numpy as jnp
+
+        chunks = [self._chunks[u.idx] for u in units]
+        dev = (chunks[0] if len(chunks) == 1
+               else jnp.concatenate(chunks)).reshape(arr.shape)
+        with self._lock:
+            return self._dev_leaves.setdefault(li, dev)
+
+    def tree(self):
+        """The whole pytree on device (blocks until fully uploaded);
+        unflattened with the plan's treedef."""
+        self.wait()
+        leaves = [self.leaf(i) for i in range(len(self.plan.leaves))]
+        td = self.plan.treedef
+        return leaves if td is None else td.unflatten(leaves)
+
+    # -- internals ---------------------------------------------------------
+    def _raise(self):
+        err = self._error
+        if isinstance(err, errors.MPIError):
+            raise err
+        if err is not None:
+            raise errors.MPIError(
+                errors.ERR_INTERN, f"ingest upload failed: {err!r}")
+        raise errors.MPIError(
+            errors.ERR_REQUEST, "ingest upload cancelled")
+
+    def _resolve(self, idx: int, chunk=None, t_ns: int = 0) -> None:
+        with self._lock:
+            if self._events[idx].is_set():
+                return
+            self._chunks[idx] = chunk
+            self._done_ns[idx] = t_ns
+            self._events[idx].set()
+            self._pending -= 1
+            if self._pending == 0:
+                self._all_done.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = exc
+
+
+class IngestEngine:
+    """Process-wide upload pipeline: stream pool + staging rings +
+    the dedicated compile-overlap stream. One engine serves many
+    uploads; rings are engine-owned and reused (stream FIFO order
+    serializes drains per stream, so ring sharing is safe)."""
+
+    def __init__(self, rank: int = 0, streams: Optional[int] = None,
+                 chunk_bytes: Optional[int] = None,
+                 depth: Optional[int] = None,
+                 put: Optional[Callable] = None) -> None:
+        self.rank = rank
+        self.n_streams = max(1, int(
+            _streams_var.get() if streams is None else streams))
+        self.chunk_bytes = max(1, int(
+            _chunk_var.get() if chunk_bytes is None else chunk_bytes))
+        self.depth = max(1, int(
+            _depth_var.get() if depth is None else depth))
+        #: injectable put (tests/smoke wrap default_put with a slow
+        #: simulated device); None -> default_put
+        self._put = put
+        self._lock = threading.Lock()
+        self._streams: Optional[list] = None
+        self._own_streams = False
+        self._compile_stream = None
+        self._bufs: Optional[list] = None
+        self._buf_bytes = 0
+        self._buf_regs: List[int] = []
+        self._active: List[IngestRequest] = []
+        self._closed = False
+
+    # -- upload ------------------------------------------------------------
+    def upload(self, tree, device=None) -> IngestRequest:
+        """Kick off the streamed upload of a pytree; returns the
+        partially-available request immediately."""
+        if self._closed:
+            raise errors.MPIError(
+                errors.ERR_OTHER,
+                "ingest engine closed — no uploads after teardown")
+        plan = IngestPlan.from_tree(tree, self.chunk_bytes,
+                                    self.n_streams)
+        req = IngestRequest(self, plan, device=device)
+        req._started = True
+        pvar.record("ingest_uploads")
+        if plan.n_units == 0:
+            return req
+        streams = self._ensure_streams()
+        bufs = self._ensure_bufs(plan.max_unit_bytes)
+        per_stream = [plan.stream_units(s)
+                      for s in range(self.n_streams)]
+        req._streams_left = sum(1 for u in per_stream if u)
+        with self._lock:
+            self._active.append(req)
+        for s, units in enumerate(per_stream):
+            if units:
+                streams[s].submit(
+                    self._make_drain(req, s, units, bufs[s]))
+        return req
+
+    def upload_and_compile(self, tree, compile_fn: Callable,
+                           device=None):
+        """The pipelined cold start: kick the upload, then run
+        ``compile_fn`` concurrently on the compile stream. Returns
+        ``(request, compile_event)``."""
+        req = self.upload(tree, device=device)
+        ev = self.overlap_compile(compile_fn)
+        return req, ev
+
+    def overlap_compile(self, fn: Callable, *args, **kwargs):
+        """Run ``fn`` on the dedicated compile stream — concurrently
+        with any in-flight uploads — under the ledger's ``compile``
+        phase, with jax's persistent compilation cache wired first
+        (the PR 6 warm path). Returns the stream Event."""
+        if self._closed:
+            raise errors.MPIError(
+                errors.ERR_OTHER, "ingest engine closed")
+        with self._lock:
+            if self._compile_stream is None:
+                from ompi_tpu.accelerator.stream import Stream
+
+                self._compile_stream = Stream("ingest-compile")
+            st = self._compile_stream
+
+        def job():
+            from ompi_tpu import prof as _prof_pkg
+
+            _prof_pkg.wire_compile_cache()
+            live_before = bool(self._live_uploads())
+            with _prof.phase("compile"):
+                out = fn(*args, **kwargs)
+            if live_before and self._live_uploads():
+                # the compile provably ran start-to-finish while an
+                # upload was in flight — the overlap this plane buys
+                pvar.record("ingest_compile_overlaps")
+            return out
+
+        return st.submit(job)
+
+    def inflight(self) -> int:
+        """Uploads with at least one stream still draining (0 after a
+        clean teardown — the no-leak invariant tests pin)."""
+        with self._lock:
+            return len(self._active)
+
+    def close(self) -> None:
+        """Teardown: cancel live uploads, drain workers, destroy
+        engine-owned streams, unregister every staging buffer."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            active = list(self._active)
+        for r in active:
+            r.cancel()
+        for r in active:
+            r._all_done.wait(30)
+        if self._own_streams:
+            for st in self._streams or []:
+                st.destroy()
+        if self._compile_stream is not None:
+            self._compile_stream.destroy()
+        from ompi_tpu import accelerator
+
+        acc = accelerator.current()
+        for h in self._buf_regs:
+            acc.host_unregister(h)
+        with self._lock:
+            self._buf_regs = []
+            self._bufs = None
+            self._streams = None
+            self._compile_stream = None
+            self._active = []
+
+    # -- internals ---------------------------------------------------------
+    def _ensure_streams(self) -> list:
+        with self._lock:
+            if self._streams is None:
+                from ompi_tpu import accelerator
+
+                acc = accelerator.current()
+                pool = getattr(acc, "h2d_streams", None)
+                if pool is not None:
+                    # accelerator-owned pool: shared across engines,
+                    # lifecycle stays with the component
+                    self._streams = pool(self.n_streams)
+                else:
+                    from ompi_tpu.accelerator.stream import Stream
+
+                    self._streams = [Stream(f"ingest-h2d-{i}")
+                                     for i in range(self.n_streams)]
+                    self._own_streams = True
+            return self._streams
+
+    def _ensure_bufs(self, need_bytes: int) -> list:
+        import numpy as np
+
+        from ompi_tpu import accelerator
+
+        with self._lock:
+            need = max(int(need_bytes), 1)
+            if self._bufs is not None and self._buf_bytes >= need:
+                return self._bufs
+            acc = accelerator.current()
+            for h in self._buf_regs:
+                acc.host_unregister(h)
+            self._bufs = [[np.empty(need, dtype=np.uint8)
+                           for _ in range(self.depth)]
+                          for _ in range(self.n_streams)]
+            self._buf_bytes = need
+            self._buf_regs = [acc.host_register(b)
+                              for ring in self._bufs for b in ring]
+            return self._bufs
+
+    def _live_uploads(self) -> List[IngestRequest]:
+        with self._lock:
+            return [r for r in self._active
+                    if not r._all_done.is_set()]
+
+    def _stream_idle(self, req: IngestRequest) -> None:
+        with self._lock:
+            req._streams_left -= 1
+            if req._streams_left <= 0:
+                try:
+                    self._active.remove(req)
+                except ValueError:
+                    pass
+
+    def _make_drain(self, req: IngestRequest, s: int, units: list,
+                    ring: list) -> Callable[[], None]:
+        import numpy as np
+
+        def drain() -> None:
+            put = self._put or default_put
+            prof = _prof.PROFILER
+            #: (unit, device chunk, ring slot, t0) — submission order
+            inflight: collections.deque = collections.deque()
+
+            def retire(entry) -> None:
+                u, dev, _slot, t0 = entry
+                bu = getattr(dev, "block_until_ready", None)
+                if bu is not None:
+                    bu()
+                t1 = _prof.now()
+                if prof is not None:
+                    prof.xfer("h2d", u.nbytes, t0, t1, site="ingest",
+                              stream=s, chunk=u.idx)
+                req._resolve(u.idx, chunk=dev, t_ns=t1)
+                pvar.record("ingest_units")
+                pvar.record("ingest_bytes", u.nbytes)
+
+            try:
+                with _prof.phase("staging"):
+                    for k, u in enumerate(units):
+                        if req._cancelled or req._error is not None:
+                            break
+                        slot = k % self.depth
+                        # double-buffer gate: a ring slot is reusable
+                        # only once the put that last borrowed it has
+                        # completed (and never more than depth puts
+                        # outstanding on this stream)
+                        while inflight and (
+                                inflight[0][2] == slot
+                                or len(inflight) >= self.depth):
+                            retire(inflight.popleft())
+                        buf = ring[slot]
+                        flat = req.plan.leaves[u.leaf].reshape(-1)
+                        n = u.hi - u.lo
+                        view = buf[:u.nbytes].view(flat.dtype)[:n]
+                        np.copyto(view, flat[u.lo:u.hi])
+                        t0 = _prof.now()
+                        dev = put(view, req.device)
+                        inflight.append((u, dev, slot, t0))
+                        if len(inflight) > req.inflight_hwm:
+                            req.inflight_hwm = len(inflight)
+                        pvar.record_hwm("ingest_inflight",
+                                        len(inflight))
+                    while inflight:
+                        retire(inflight.popleft())
+            except BaseException as exc:  # noqa: BLE001 — surfaced at wait/gate
+                req._fail(exc)
+                _out.verbose(1, "ingest stream %d failed: %r", s, exc)
+            finally:
+                voided = 0
+                for u in units:
+                    if not req._events[u.idx].is_set():
+                        req._resolve(u.idx)
+                        voided += 1
+                if voided:
+                    pvar.record("ingest_cancelled", voided)
+                self._stream_idle(req)
+
+        return drain
+
+
+# -- plane lifecycle (runtime/state wiring) -------------------------------
+
+def requested() -> bool:
+    """cvar ingest_enable (incl. OMPI_TPU_INGEST_ENABLE env) or the
+    short-form OMPI_TPU_INGEST env knob."""
+    if _enable_var.get():
+        return True
+    raw = os.environ.get("OMPI_TPU_INGEST", "").strip().lower()
+    return raw not in ("", "0", "false", "no", "off")
+
+
+def enable(rank: Optional[int] = None) -> IngestEngine:
+    """Bring the plane up (idempotent)."""
+    global INGEST
+    if INGEST is None:
+        INGEST = IngestEngine(rank=0 if rank is None else rank)
+        _out.verbose(2, "ingest up: %d stream(s), %d B units, "
+                     "depth %d", INGEST.n_streams,
+                     INGEST.chunk_bytes, INGEST.depth)
+    elif rank is not None:
+        INGEST.rank = rank
+    return INGEST
+
+
+def disable() -> Optional[IngestEngine]:
+    """Tear the plane down (buffers unregistered, streams drained)."""
+    global INGEST
+    eng, INGEST = INGEST, None
+    if eng is not None:
+        eng.close()
+    return eng

@@ -1,0 +1,182 @@
+"""IngestPlan — deterministic partition of a pytree into upload units.
+
+The ingest analog of the partitioned-send buffer split
+(part/host.py): the param/data pytree is flattened, every leaf is cut
+into contiguous flat element ranges of at most ``ingest_chunk_bytes``
+each, and the resulting units are assigned round-robin to the upload
+streams. Everything is a pure function of (leaf shapes/dtypes,
+chunk_bytes, n_streams) — two ranks building the plan from the same
+pytree agree on every unit boundary, which is what lets the gating
+surface ("step 1 touches leaves 0 and 3") be stated in terms of plan
+indices.
+
+Units are the ``Parrived`` granularity: one unit == one staged
+device_put == one completion event on the request.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ompi_tpu import errors
+
+
+class Unit:
+    """One upload unit: elements [lo, hi) of flat leaf ``leaf``."""
+
+    __slots__ = ("idx", "leaf", "lo", "hi", "nbytes", "stream")
+
+    def __init__(self, idx: int, leaf: int, lo: int, hi: int,
+                 nbytes: int, stream: int) -> None:
+        self.idx = idx
+        self.leaf = leaf
+        self.lo = lo
+        self.hi = hi
+        self.nbytes = nbytes
+        self.stream = stream
+
+    def key(self) -> Tuple[int, int, int, int, int, int]:
+        return (self.idx, self.leaf, self.lo, self.hi, self.nbytes,
+                self.stream)
+
+    def __repr__(self) -> str:
+        return (f"Unit(idx={self.idx}, leaf={self.leaf}, "
+                f"[{self.lo},{self.hi}), {self.nbytes}B, "
+                f"stream={self.stream})")
+
+
+def _flatten(tree):
+    """(leaves, treedef, keystrs) via jax when available; a bare
+    list/tuple/dict of arrays degrades to a None treedef so the plan
+    (and bit-identity tests) work without pulling jax in."""
+    try:
+        from jax import tree_util as jtu
+    except Exception:  # pragma: no cover - jax is baked into the image
+        if isinstance(tree, dict):
+            keys = sorted(tree)
+            return [tree[k] for k in keys], None, [f"['{k}']"
+                                                   for k in keys]
+        if isinstance(tree, (list, tuple)):
+            return list(tree), None, [f"[{i}]"
+                                      for i in range(len(tree))]
+        return [tree], None, [""]
+    flat, treedef = jtu.tree_flatten(tree)
+    try:
+        keystrs = [jtu.keystr(kp) for kp, _ in
+                   jtu.tree_flatten_with_path(tree)[0]]
+    except Exception:  # older jax without the keypath API
+        keystrs = [f"[{i}]" for i in range(len(flat))]
+    return flat, treedef, keystrs
+
+
+class IngestPlan:
+    """Deterministic unit decomposition of one pytree upload."""
+
+    def __init__(self, leaves: Sequence[Any], chunk_bytes: int,
+                 n_streams: int, treedef=None,
+                 keystrs: Optional[List[str]] = None) -> None:
+        if chunk_bytes < 1:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                f"ingest_chunk_bytes must be >= 1 (got {chunk_bytes})")
+        if n_streams < 1:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                f"ingest_streams must be >= 1 (got {n_streams})")
+        self.chunk_bytes = int(chunk_bytes)
+        self.n_streams = int(n_streams)
+        self.treedef = treedef
+        self.keystrs = keystrs or [f"[{i}]"
+                                   for i in range(len(leaves))]
+        #: host-side leaves, contiguous (views where already so; note
+        #: ascontiguousarray only on the copy path — it would promote
+        #: 0-d scalars to 1-d and lose the shape)
+        self.leaves: List[np.ndarray] = []
+        for lf in leaves:
+            arr = np.asarray(lf)
+            if not arr.flags.c_contiguous:
+                arr = np.ascontiguousarray(arr).reshape(arr.shape)
+            self.leaves.append(arr)
+        self.units: List[Unit] = []
+        #: leaf index -> this leaf's units, in flat order
+        self.leaf_units: List[List[Unit]] = []
+        idx = 0
+        for li, arr in enumerate(self.leaves):
+            mine: List[Unit] = []
+            size = int(arr.size)
+            itemsize = max(1, int(arr.itemsize))
+            if size == 0:
+                # zero-size leaves still get ONE unit so Parrived /
+                # gating indices stay total over the tree
+                u = Unit(idx, li, 0, 0, 0, idx % self.n_streams)
+                self.units.append(u)
+                mine.append(u)
+                idx += 1
+                self.leaf_units.append(mine)
+                continue
+            chunk_elems = max(1, self.chunk_bytes // itemsize)
+            nch = -(-size // chunk_elems)  # ceil
+            base, rem = divmod(size, nch)
+            lo = 0
+            for c in range(nch):
+                hi = lo + base + (1 if c < rem else 0)
+                u = Unit(idx, li, lo, hi, (hi - lo) * itemsize,
+                         idx % self.n_streams)
+                self.units.append(u)
+                mine.append(u)
+                idx += 1
+                lo = hi
+            self.leaf_units.append(mine)
+        self.n_units = len(self.units)
+        self.total_bytes = sum(u.nbytes for u in self.units)
+        #: largest single unit — sizes the engine's staging buffers
+        self.max_unit_bytes = max(
+            (u.nbytes for u in self.units), default=0)
+        self._key_index: Dict[str, int] = {
+            k: i for i, k in enumerate(self.keystrs)}
+
+    @classmethod
+    def from_tree(cls, tree, chunk_bytes: int,
+                  n_streams: int) -> "IngestPlan":
+        leaves, treedef, keystrs = _flatten(tree)
+        return cls(leaves, chunk_bytes, n_streams, treedef=treedef,
+                   keystrs=keystrs)
+
+    def leaf_index(self, key) -> int:
+        """Resolve a leaf reference: an int index, an exact jax
+        keystr (``"['w0']"``), or the bare dict-key/field shorthand
+        (``"w0"``)."""
+        if isinstance(key, int):
+            if not 0 <= key < len(self.leaves):
+                raise errors.MPIError(
+                    errors.ERR_ARG,
+                    f"leaf index {key} out of "
+                    f"[0,{len(self.leaves)})")
+            return key
+        if key in self._key_index:
+            return self._key_index[key]
+        sugar = f"['{key}']"
+        if sugar in self._key_index:
+            return self._key_index[sugar]
+        raise errors.MPIError(
+            errors.ERR_ARG,
+            f"unknown leaf {key!r} (known: {self.keystrs})")
+
+    def units_for(self, keys) -> List[Unit]:
+        """The units covering the given leaves (gating input)."""
+        out: List[Unit] = []
+        for key in keys:
+            out.extend(self.leaf_units[self.leaf_index(key)])
+        return out
+
+    def stream_units(self, stream: int) -> List[Unit]:
+        """This stream's units, in submission order."""
+        return [u for u in self.units if u.stream == stream]
+
+    def signature(self) -> Tuple:
+        """Hashable identity: equal signatures <=> identical plans
+        (the determinism contract the tests pin)."""
+        return (self.chunk_bytes, self.n_streams,
+                tuple(u.key() for u in self.units))

@@ -22,6 +22,13 @@ late ones. Three layers live under this name:
   for training loops, and :class:`ZeroGradientSync`, the same surface
   over ``Preduce_scatter_init`` yielding sharded gradients for the
   zero/ optimizer cycle.
+- :mod:`ompi_tpu.part.partial` — :class:`PartialAvailability`, the
+  shared ``Parrived``/``Parrived_range``/``Parrived_list`` probe
+  mixin (MPI 4.0 §4.2 erroneous-call policy included). The recv
+  request implements it for wire partitions; the streaming ingest
+  plane (:mod:`ompi_tpu.ingest`) implements it for host->device
+  upload units, so "start on the first ready shards" reads the same
+  both places.
 
 ``ompi_tpu.pml.part`` remains as a compat shim over ``part.host``.
 """
@@ -34,3 +41,4 @@ from ompi_tpu.part.host import (  # noqa: F401
 from ompi_tpu.part.overlap import (  # noqa: F401
     GradientSync, ZeroGradientSync,
 )
+from ompi_tpu.part.partial import PartialAvailability  # noqa: F401

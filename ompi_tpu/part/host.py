@@ -37,6 +37,7 @@ import numpy as np
 
 from ompi_tpu import errors, pml
 from ompi_tpu.core import pvar
+from ompi_tpu.part import partial as _partial
 from ompi_tpu.pml import request as rq
 from ompi_tpu.telemetry import flight as _flight
 from ompi_tpu.trace import recorder as _trace
@@ -206,9 +207,15 @@ class PartitionedSendRequest(_PartitionedBase):
         return self.status
 
 
-class PartitionedRecvRequest(_PartitionedBase):
+class PartitionedRecvRequest(_PartitionedBase,
+                             _partial.PartialAvailability):
     """MPI_Precv_init handle: Start() posts all partition receives,
-    Parrived(i) polls one, completion = all arrived."""
+    Parrived(i) / Parrived_range / Parrived_list poll (the probe
+    family is the shared :class:`~ompi_tpu.part.partial.
+    PartialAvailability` surface the ingest plane reuses), completion
+    = all arrived."""
+
+    _PARRIVED_PVAR = "part_parrived"
 
     def start(self) -> None:
         self._check_start()
@@ -233,18 +240,16 @@ class PartitionedRecvRequest(_PartitionedBase):
                 "precv_epoch", getattr(self.comm, "cid", -1),
                 sum(int(c.nbytes) for c in self._chunks))
 
-    def Parrived(self, idx: int) -> bool:
-        if not self._started:
+    def _partial_started(self) -> bool:
+        return self._started
+
+    def _partial_probe(self, idx: int) -> bool:
+        if not 0 <= idx < self.partitions:
             raise errors.MPIError(
-                errors.ERR_REQUEST,
-                f"Parrived({idx}): request never started — no "
-                "partition receives are posted (MPI 4.0 §4.2)")
-        if self.completed:
-            return True
-        ok = self._reqs[idx].test()
-        if ok:
-            pvar.record("part_parrived")
-        return ok
+                errors.ERR_ARG,
+                f"Parrived({idx}): partition index out of "
+                f"[0,{self.partitions})")
+        return self._reqs[idx].test()
 
     def _epoch_done(self) -> bool:
         return all(r.test() for r in self._reqs)

@@ -1,0 +1,71 @@
+"""Shared partial-availability semantics (the MPI-4 Parrived family).
+
+Two subsystems expose "the payload arrives in independently-completing
+pieces": the partitioned-recv request (:mod:`ompi_tpu.part.host`,
+partitions arriving off the wire) and the streaming-ingest upload
+request (:mod:`ompi_tpu.ingest.engine`, pytree units landing on the
+device). Both offer the same MPI-4 probe surface, so it lives here
+once:
+
+- ``Parrived(i)`` — nonblocking: has piece ``i`` completed?
+- ``Parrived_range(lo, hi)`` / ``Parrived_list(idxs)`` — grouped
+  probes, mirroring ``Pready_range`` / ``Pready_list`` on the send
+  side (MPI 4.0 §4.2.4).
+- Probing a request that was never started is erroneous and raises
+  :class:`~ompi_tpu.errors.MPIError` (MPI 4.0 §4.2: ``MPI_Parrived``
+  on an inactive never-started request).
+
+Concrete classes implement three hooks — ``_partial_started()``
+(ever activated?), ``_partial_probe(idx)`` (one nonblocking
+completion poll; index validation lives here too), and the class
+attribute ``_PARRIVED_PVAR`` naming the counter a successful probe
+records (``part_parrived`` on the wire path, ``ingest_parrived`` on
+the upload path) — plus the live ``completed`` property every request
+class in this codebase already carries.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from ompi_tpu import errors
+from ompi_tpu.core import pvar
+
+
+class PartialAvailability:
+    """Mixin: the MPI-4 ``Parrived`` probe family over pluggable
+    completion hooks."""
+
+    #: counter recorded on each successful probe (None: record nothing)
+    _PARRIVED_PVAR: Optional[str] = None
+
+    # -- hooks the concrete request implements ---------------------------
+    def _partial_started(self) -> bool:
+        raise NotImplementedError
+
+    def _partial_probe(self, idx: int) -> bool:
+        raise NotImplementedError
+
+    # -- the shared MPI-4 surface -----------------------------------------
+    def Parrived(self, idx: int) -> bool:
+        if not self._partial_started():
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                f"Parrived({idx}): request never started — nothing "
+                "is in flight to probe (MPI 4.0 §4.2)")
+        # no completed-request fast path: an out-of-range index is
+        # erroneous even after everything arrived, and the probe
+        # counter must reflect every answered probe
+        ok = self._partial_probe(idx)
+        name = self._PARRIVED_PVAR
+        if ok and name is not None:
+            pvar.record(name)
+        return ok
+
+    def Parrived_range(self, lo: int, hi: int) -> bool:
+        """True when every piece in [lo, hi] (inclusive, like
+        ``Pready_range``) has completed."""
+        return all(self.Parrived(i) for i in range(lo, hi + 1))
+
+    def Parrived_list(self, idxs: Iterable[int]) -> bool:
+        return all(self.Parrived(i) for i in idxs)

@@ -25,8 +25,8 @@ from typing import Optional
 
 from ompi_tpu.core import cvar, pvar
 from ompi_tpu.prof.ledger import (  # noqa: F401  (public re-exports)
-    PROFILER, Profiler, current_phase, disable, enable, phase,
-    phase_seconds, requested,
+    PROFILER, Profiler, current_phase, disable, enable,
+    overlap_seconds, phase, phase_seconds, requested,
 )
 
 _cache_dir_var = cvar.register(

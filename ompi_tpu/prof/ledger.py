@@ -111,10 +111,14 @@ class Profiler:
     def __init__(self, rank: int = 0) -> None:
         self.rank = rank
         self._lock = threading.Lock()
-        #: thread ident -> phase-name stack (innermost last)
+        #: thread ident -> stack of (phase name, t0) (innermost last)
         self._stacks: Dict[int, list] = {}
         self._totals_ns: Dict[str, int] = {}
         self._counts: Dict[str, int] = {}
+        #: wall covered by concurrently-open DIFFERENT-name phases on
+        #: different threads (ingest: staging || compile) — why
+        #: phase_staging_s + phase_compile_s may exceed wall_s
+        self._overlap_ns = 0
         win = max(1, int(_window_var.get()))
         #: per-direction rolling (nbytes, dur_ns) window
         self._windows: Dict[str, collections.deque] = {
@@ -129,23 +133,46 @@ class Profiler:
 
     def _push(self, name: str) -> int:
         ident = threading.get_ident()
+        t0 = now()
         with self._lock:
-            self._stacks.setdefault(ident, []).append(name)
-        return now()
+            self._stacks.setdefault(ident, []).append((name, t0))
+        return t0
 
     def _pop(self, name: str, t0: int) -> None:
         t1 = now()
         ident = threading.get_ident()
+        ov = 0
         with self._lock:
             stack = self._stacks.get(ident)
-            if stack and stack[-1] == name:
+            if stack and stack[-1][0] == name:
                 stack.pop()
             if not stack:
                 self._stacks.pop(ident, None)
             self._totals_ns[name] = \
                 self._totals_ns.get(name, 0) + (t1 - t0)
             self._counts[name] = self._counts.get(name, 0) + 1
+            # cross-thread overlap: wall this phase shared with a
+            # DIFFERENT-name phase still open on another thread. The
+            # earlier-closing side accounts the pair (the survivor
+            # will only overlap against phases open at ITS close), so
+            # each concurrent pair counts once; same-name phases on
+            # two threads (N staging workers) deliberately don't
+            # count — that is parallelism inside one phase, not
+            # phase-vs-phase overlap.
+            other_t0 = None
+            for oid, ostack in self._stacks.items():
+                if oid == ident:
+                    continue
+                for oname, ot0 in ostack:
+                    if oname != name and (other_t0 is None
+                                          or ot0 < other_t0):
+                        other_t0 = ot0
+            if other_t0 is not None:
+                ov = max(0, t1 - max(t0, other_t0))
+                self._overlap_ns += ov
         pvar.record("prof_phase_%s_ns" % name, t1 - t0)
+        if ov > 0:
+            pvar.record("prof_phase_overlap_ns", ov)
         rec = _trace.RECORDER
         if rec is not None:
             rec.record(name, "prof", t0, t1)
@@ -159,10 +186,10 @@ class Profiler:
             for key in (ident, self._main_ident):
                 stack = self._stacks.get(key)
                 if stack:
-                    return stack[-1]
+                    return stack[-1][0]
             for stack in self._stacks.values():
                 if stack:
-                    return stack[-1]
+                    return stack[-1][0]
         return None
 
     def phase_seconds(self) -> Dict[str, float]:
@@ -174,6 +201,13 @@ class Profiler:
     def phase_counts(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._counts)
+
+    def overlap_seconds(self) -> float:
+        """Wall seconds spent under >= 2 concurrently-open
+        different-name phases (closed pairs only): how far
+        ``sum(phase_seconds())`` may legitimately exceed the wall."""
+        with self._lock:
+            return self._overlap_ns / 1e9
 
     # -- transfer accounting ----------------------------------------------
     def xfer(self, direction: str, nbytes: int, t0: int, t1: int,
@@ -247,6 +281,11 @@ def current_phase() -> Optional[str]:
 def phase_seconds() -> Dict[str, float]:
     p = PROFILER
     return {} if p is None else p.phase_seconds()
+
+
+def overlap_seconds() -> float:
+    p = PROFILER
+    return 0.0 if p is None else p.overlap_seconds()
 
 
 # -- enable / disable ----------------------------------------------------

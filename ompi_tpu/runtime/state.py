@@ -74,6 +74,18 @@ def init_instance() -> None:
         from ompi_tpu.accelerator import current as _accel_current
         _accel_current()
 
+        # streaming ingest plane (cvar ingest_enable / OMPI_TPU_INGEST):
+        # right after accelerator selection so the upload stream pool
+        # and staging rings bind to the selected component, before any
+        # comm construction kicks off staging traffic
+        from ompi_tpu import ingest as _ingest
+
+        if _ingest.requested():
+            try:
+                _ingest.start(rank=rte.rank)
+            except Exception as exc:  # ingest must never sink init
+                _out.verbose(0, "ingest enable failed: %r", exc)
+
         # multi-controller device plane (opt-in; collective over the
         # world, must precede comm construction so coll/xla can qualify
         # during any comm's coll table selection)
@@ -200,6 +212,15 @@ def _release() -> None:
 
             try:
                 _check.stop()
+            except Exception:
+                pass
+            # ingest teardown before the pml dies: cancels any tail
+            # upload, drains the stream workers, unregisters the
+            # staging rings (the no-leaked-buffers contract)
+            from ompi_tpu import ingest as _ingest
+
+            try:
+                _ingest.stop()
             except Exception:
                 pass
             from ompi_tpu import pml

@@ -1,0 +1,526 @@
+"""Streaming ingest plane (ingest/ + the accelerator H2D pool + the
+shared part/partial mixin + prof overlap accounting).
+
+The acceptance contract: the chunk plan is a deterministic pure
+function of (leaf metadata, chunk_bytes, n_streams); the streamed
+upload is BITWISE identical to a one-shot ``to_device`` across mixed
+dtypes/shapes (scalars and non-contiguous leaves included); a staging
+ring slot is never repacked while the put that last borrowed it can
+still read it (pinned under a deliberately slow fake device); the
+first step gates on only the units it touches (``ingest_early_starts``
+when it releases before the tail); cancellation and mid-upload errors
+surface as MPIError with no leaked staging registrations; ``Parrived``
+follows the MPI 4.0 partitioned semantics shared with part/; and the
+prof ledger + report CLI quantify staging||compile overlap instead of
+silently double-counting it.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ompi_tpu import errors
+from ompi_tpu.core import pvar
+from ompi_tpu.ingest import engine as ie
+from ompi_tpu.ingest.plan import IngestPlan
+from ompi_tpu.part import partial as part_partial
+from ompi_tpu.prof import ledger
+from tests.harness import run_ranks
+
+
+@pytest.fixture
+def no_prof():
+    ledger.disable()
+    yield
+    ledger.disable()
+
+
+def _mixed_tree():
+    rng = np.random.default_rng(11)
+    return {
+        "w": rng.standard_normal(50000).astype(np.float32),
+        "b": np.float32(3.5),                      # 0-d scalar leaf
+        "i": rng.integers(0, 1 << 30, 4097).astype(np.int64),
+        "h": rng.standard_normal((33, 7)).astype(np.float16),
+        "nc": np.asarray(rng.standard_normal((30, 10)).T),  # F-order
+        "z": np.empty((0, 4), np.float32),         # zero-size leaf
+    }
+
+
+# -- plan ----------------------------------------------------------------
+
+def test_plan_deterministic_and_bounded():
+    tree = _mixed_tree()
+    p1 = IngestPlan.from_tree(tree, 4096, 3)
+    p2 = IngestPlan.from_tree(tree, 4096, 3)
+    assert p1.signature() == p2.signature()
+    # different params -> different plan
+    assert p1.signature() != IngestPlan.from_tree(
+        tree, 8192, 3).signature()
+    for u in p1.units:
+        assert u.nbytes <= 4096
+        assert 0 <= u.stream < 3
+    # round-robin stream assignment by unit index
+    assert [u.stream for u in p1.units] == \
+        [i % 3 for i in range(p1.n_units)]
+    # units tile every leaf exactly: contiguous [lo, hi) cover
+    for li, units in enumerate(p1.leaf_units):
+        size = p1.leaves[li].size
+        lo = 0
+        for u in units:
+            assert u.lo == lo
+            lo = u.hi
+        assert lo == size
+    # zero-size leaves still get exactly one unit (total indices)
+    zi = p1.leaf_index("z")
+    assert len(p1.leaf_units[zi]) == 1
+    assert p1.leaf_units[zi][0].nbytes == 0
+    assert p1.total_bytes == sum(
+        np.asarray(v).nbytes for v in tree.values())
+
+
+def test_plan_leaf_index_resolution_and_errors():
+    p = IngestPlan.from_tree({"w0": np.zeros(4, np.float32)}, 64, 2)
+    li = p.leaf_index("w0")          # bare dict-key sugar
+    assert p.leaf_index("['w0']") == li  # exact jax keystr
+    assert p.leaf_index(li) == li        # int passthrough
+    with pytest.raises(errors.MPIError) as e:
+        p.leaf_index("nope")
+    assert e.value.error_class == errors.ERR_ARG
+    with pytest.raises(errors.MPIError) as e:
+        p.leaf_index(99)
+    assert e.value.error_class == errors.ERR_ARG
+    with pytest.raises(errors.MPIError):
+        IngestPlan.from_tree({}, 0, 1)   # chunk_bytes < 1
+    with pytest.raises(errors.MPIError):
+        IngestPlan.from_tree({}, 64, 0)  # n_streams < 1
+
+
+# -- bit identity --------------------------------------------------------
+
+def test_streamed_upload_bit_identical_to_one_shot():
+    """Across mixed dtypes/shapes, scalars, non-contiguous and
+    zero-size leaves, over multiple stream/chunk geometries."""
+    import jax
+
+    tree = _mixed_tree()
+    one_shot = {k: jax.device_put(np.asarray(v))
+                for k, v in tree.items()}
+    for streams, chunk in [(1, 1 << 20), (3, 4096), (4, 8192)]:
+        eng = ie.IngestEngine(streams=streams, chunk_bytes=chunk)
+        try:
+            got = eng.upload(tree).tree()
+            for k in tree:
+                a, b = np.asarray(got[k]), np.asarray(one_shot[k])
+                assert a.dtype == b.dtype and a.shape == b.shape, k
+                np.testing.assert_array_equal(a, b, err_msg=k)
+        finally:
+            eng.close()
+
+
+def test_leaf_assembly_blocks_only_that_leaf():
+    gate = threading.Event()
+
+    def put(view, device=None):
+        # leaf "slow" is ~100KB -> its units wait on the gate
+        if view.nbytes > 4096:
+            gate.wait(10)
+        return ie.default_put(view, device)
+
+    tree = {"fast": np.arange(16, dtype=np.float32),
+            "slow": np.arange(100000, dtype=np.float32)}
+    eng = ie.IngestEngine(streams=2, chunk_bytes=1 << 20, put=put)
+    try:
+        req = eng.upload(tree)
+        fast = req.leaf("fast")          # must not wait for "slow"
+        np.testing.assert_array_equal(
+            np.asarray(fast), tree["fast"])
+        assert not req.test()
+        gate.set()
+        got = req.tree()
+        np.testing.assert_array_equal(
+            np.asarray(got["slow"]), tree["slow"])
+        assert req.leaf("fast") is fast  # assembled leaves cached
+    finally:
+        gate.set()
+        eng.close()
+
+
+# -- double buffering ----------------------------------------------------
+
+class _SlowChunk:
+    """Fake device array: block_until_ready sleeps (an in-flight DMA)
+    and only THEN snapshots the staging view — if the drain loop ever
+    repacked the ring slot early, the snapshot shows foreign bytes."""
+
+    def __init__(self, view):
+        self._view = view
+        self.value = None
+
+    def block_until_ready(self):
+        time.sleep(0.002)
+        self.value = np.array(self._view)  # copy at "DMA completion"
+        return self
+
+
+def test_double_buffer_never_repacks_live_slot():
+    a = np.arange(20000, dtype=np.float32)
+    eng = ie.IngestEngine(streams=2, chunk_bytes=4096, depth=2,
+                          put=lambda v, device=None: _SlowChunk(v))
+    try:
+        req = eng.upload(a).wait()
+        for u in req.plan.units:
+            np.testing.assert_array_equal(
+                req._chunks[u.idx].value, a[u.lo:u.hi],
+                err_msg=f"unit {u.idx} saw a repacked slot")
+        # the ring bounds the put queue: never more than depth puts
+        # in flight per stream
+        assert 1 <= req.inflight_hwm <= eng.depth
+    finally:
+        eng.close()
+
+
+def test_depth_one_serializes():
+    a = np.arange(8000, dtype=np.float32)
+    eng = ie.IngestEngine(streams=1, chunk_bytes=1024, depth=1,
+                          put=lambda v, device=None: _SlowChunk(v))
+    try:
+        req = eng.upload(a).wait()
+        assert req.inflight_hwm == 1
+        for u in req.plan.units:
+            np.testing.assert_array_equal(
+                req._chunks[u.idx].value, a[u.lo:u.hi])
+    finally:
+        eng.close()
+
+
+# -- first-step gating ---------------------------------------------------
+
+def test_gate_releases_before_tail_and_counts_early_start(no_prof):
+    release = threading.Event()
+
+    def put(view, device=None):
+        # w0's single unit (64B) flows; the big leaf blocks
+        if view.nbytes > 1024:
+            release.wait(10)
+        return ie.default_put(view, device)
+
+    tree = {"w0": np.arange(16, dtype=np.float32),
+            "w1": np.arange(50000, dtype=np.float32)}
+    s = pvar.session()
+    eng = ie.IngestEngine(streams=2, chunk_bytes=1 << 20, put=put)
+    try:
+        req = eng.upload(tree)
+        req.gate(["w0"], timeout=10)     # returns while w1 uploads
+        assert not req.completed
+        assert s.read("ingest_early_starts") == 1
+        assert s.read("ingest_gate_ns") > 0
+        release.set()
+        req.wait(10)
+        assert req.completed
+        # gating after completion: no additional early start
+        req.gate(["w0"])
+        assert s.read("ingest_early_starts") == 1
+    finally:
+        release.set()
+        eng.close()
+
+
+def test_gate_timeout_raises_pending():
+    hold = threading.Event()
+    eng = ie.IngestEngine(
+        streams=1, chunk_bytes=1 << 20,
+        put=lambda v, device=None: (hold.wait(10),
+                                    ie.default_put(v))[1])
+    try:
+        req = eng.upload(np.arange(64, dtype=np.float32))
+        with pytest.raises(errors.MPIError) as e:
+            req.gate(timeout=0.05)
+        assert e.value.error_class == errors.ERR_PENDING
+    finally:
+        hold.set()
+        eng.close()
+
+
+# -- cancellation / error / teardown -------------------------------------
+
+def test_put_error_surfaces_as_mpierror_and_voids_units(no_prof):
+    def bad_put(view, device=None):
+        raise RuntimeError("simulated DMA failure")
+
+    s = pvar.session()
+    eng = ie.IngestEngine(streams=2, chunk_bytes=1024, put=bad_put)
+    try:
+        req = eng.upload(np.arange(4096, dtype=np.float32))
+        with pytest.raises(errors.MPIError) as e:
+            req.wait(10)
+        assert e.value.error_class == errors.ERR_INTERN
+        assert "simulated DMA failure" in str(e.value)
+        assert not req.completed
+        assert s.read("ingest_cancelled") > 0
+        with pytest.raises(errors.MPIError):
+            req.leaf(0)
+    finally:
+        eng.close()
+
+
+def test_cancel_then_teardown_leaks_nothing(no_prof):
+    from ompi_tpu import accelerator
+
+    hold = threading.Event()
+
+    def put(view, device=None):
+        hold.wait(10)
+        return ie.default_put(view, device)
+
+    acc = accelerator.current()
+    regs_before = len(getattr(acc, "_host_regs", {}) or {})
+    s = pvar.session()
+    eng = ie.IngestEngine(streams=2, chunk_bytes=1024, put=put)
+    req = eng.upload(np.arange(4096, dtype=np.float32))
+    req.cancel()
+    hold.set()
+    with pytest.raises(errors.MPIError) as e:
+        req.wait(10)
+    assert e.value.error_class == errors.ERR_REQUEST
+    assert "cancelled" in str(e.value)
+    assert s.read("ingest_cancelled") > 0
+    eng.close()
+    # every staging registration returned; no upload left checked out
+    assert eng._buf_regs == [] and eng._bufs is None
+    assert len(getattr(acc, "_host_regs", {}) or {}) == regs_before
+    assert eng.inflight() == 0
+    with pytest.raises(errors.MPIError) as e:
+        eng.upload(np.zeros(4, np.float32))
+    assert e.value.error_class == errors.ERR_OTHER
+
+
+# -- Parrived (shared part/partial mixin) --------------------------------
+
+def test_parrived_semantics_shared_with_part():
+    from ompi_tpu.part.host import PartitionedRecvRequest
+
+    # ONE availability surface: both request types are the mixin
+    assert issubclass(ie.IngestRequest,
+                      part_partial.PartialAvailability)
+    assert issubclass(PartitionedRecvRequest,
+                      part_partial.PartialAvailability)
+
+    eng = ie.IngestEngine(streams=2, chunk_bytes=2048)
+    try:
+        req = eng.upload(np.arange(4096, dtype=np.float32)).wait()
+        assert all(req.Parrived(i) for i in range(req.n_units))
+        assert req.Parrived_range(0, req.n_units - 1)
+        assert req.Parrived_list([0, req.n_units - 1])
+        with pytest.raises(errors.MPIError) as e:
+            req.Parrived(req.n_units)
+        assert e.value.error_class == errors.ERR_ARG
+    finally:
+        eng.close()
+    # probing a request that was never started is erroneous
+    # (MPI 4.0 §4.2) — the mixin enforces it for both planes
+    plan = IngestPlan.from_tree(np.zeros(4, np.float32), 64, 1)
+    fresh = ie.IngestRequest(eng, plan)
+    with pytest.raises(errors.MPIError) as e:
+        fresh.Parrived(0)
+    assert e.value.error_class == errors.ERR_REQUEST
+
+
+def test_parrived_records_pvar():
+    s = pvar.session()
+    eng = ie.IngestEngine(streams=1, chunk_bytes=1 << 20)
+    try:
+        req = eng.upload(np.arange(8, dtype=np.float32)).wait()
+        req.Parrived(0)
+        assert s.read("ingest_parrived") >= 1
+    finally:
+        eng.close()
+
+
+# -- compile overlap -----------------------------------------------------
+
+def test_overlap_compile_runs_during_upload(no_prof):
+    ledger.enable(rank=0)
+    s = pvar.session()
+    release = threading.Event()
+
+    def put(view, device=None):
+        release.wait(10)
+        return ie.default_put(view, device)
+
+    eng = ie.IngestEngine(streams=2, chunk_bytes=1024, put=put)
+    try:
+        req = eng.upload(np.arange(4096, dtype=np.float32))
+        done = {}
+
+        def compile_fn():
+            time.sleep(0.03)
+            done["ran"] = True
+            return 42
+
+        ev = eng.overlap_compile(compile_fn)
+        ev.wait(10)                      # compile finished...
+        assert done["ran"] and not req.test()  # ...upload still live
+        assert s.read("ingest_compile_overlaps") == 1
+        release.set()
+        req.wait(10)
+        # the ledger saw staging and compile as concurrent phases
+        assert s.read("prof_phase_overlap_ns") > 0
+        assert ledger.overlap_seconds() > 0
+    finally:
+        release.set()
+        eng.close()
+        ledger.disable()
+
+
+def test_upload_and_compile_pipeline(no_prof):
+    eng = ie.IngestEngine(streams=2, chunk_bytes=4096)
+    try:
+        tree = {"p": np.arange(10000, dtype=np.float32)}
+        req, ev = eng.upload_and_compile(tree, lambda: "compiled")
+        assert ev.wait(10) == "compiled"
+        got = req.tree()
+        np.testing.assert_array_equal(np.asarray(got["p"]),
+                                      tree["p"])
+    finally:
+        eng.close()
+
+
+# -- chunked D2H (the BENCH_r05 0.01 GB/s regression) --------------------
+
+def test_chunked_d2h_bit_identical(monkeypatch, no_prof):
+    import jax
+
+    from ompi_tpu.accelerator import tpu as tpu_mod
+
+    acc = tpu_mod.TpuAccelerator()
+    monkeypatch.setattr(tpu_mod.TpuAccelerator,
+                        "D2H_CHUNK_BYTES", 4096)
+    rng = np.random.default_rng(3)
+    for shape in [(4096,), (64, 33), (7, 11, 13)]:
+        host = rng.standard_normal(shape).astype(np.float32)
+        dev = jax.device_put(host)
+        out = acc.to_host(dev)
+        assert out.shape == host.shape and out.dtype == host.dtype
+        np.testing.assert_array_equal(out, np.asarray(dev))
+
+
+def test_chunked_d2h_chunk_count_bounded(monkeypatch, no_prof):
+    """nch stays within [2, D2H_MAX_CHUNKS] and bounds tile the flat
+    array exactly — the floor-raise that fixed the 0.01 GB/s read."""
+    from ompi_tpu.accelerator import tpu as tpu_mod
+
+    assert tpu_mod.TpuAccelerator.D2H_CHUNK_BYTES == 32 << 20
+    assert tpu_mod.TpuAccelerator.D2H_MAX_CHUNKS == 4
+    for nbytes in [64 << 20, 128 << 20, 1 << 30]:
+        nch = min(tpu_mod.TpuAccelerator.D2H_MAX_CHUNKS,
+                  max(2, nbytes
+                      // tpu_mod.TpuAccelerator.D2H_CHUNK_BYTES))
+        assert 2 <= nch <= 4
+
+
+# -- prof overlap accounting ---------------------------------------------
+
+def test_ledger_cross_thread_overlap(no_prof):
+    ledger.enable(rank=0)
+    s = pvar.session()
+    t0 = threading.Event()
+
+    def worker():
+        with ledger.phase("staging"):
+            t0.set()
+            time.sleep(0.04)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t0.wait(5)
+    with ledger.phase("compile"):
+        time.sleep(0.02)
+    t.join()
+    ns = s.read("prof_phase_overlap_ns")
+    assert 10_000_000 < ns < 60_000_000  # ~20ms of true overlap
+    assert abs(ledger.overlap_seconds() - ns / 1e9) < 1e-9
+
+
+def test_ledger_same_phase_threads_do_not_overlap(no_prof):
+    """Two threads in the SAME phase are parallelism within the
+    phase, not phase overlap."""
+    ledger.enable(rank=0)
+    s = pvar.session()
+
+    def worker():
+        with ledger.phase("staging"):
+            time.sleep(0.02)
+
+    ts = [threading.Thread(target=worker) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert s.read("prof_phase_overlap_ns") == 0
+
+
+def test_report_phase_overlap_sweep_and_render():
+    from ompi_tpu.prof import __main__ as prof_cli
+
+    mk = lambda pid, name, ts, dur: {
+        "ph": "X", "cat": "prof", "pid": pid, "tid": 0,
+        "name": name, "ts": ts, "dur": dur}
+    doc = {"traceEvents": [
+        # rank 0: staging [0, 100ms), compile [40ms, 90ms) -> 50ms
+        mk(0, "staging", 0.0, 100e3),
+        mk(0, "compile", 40e3, 50e3),
+        # rank 1: disjoint phases -> 0 overlap
+        mk(1, "staging", 0.0, 30e3),
+        mk(1, "compile", 30e3, 30e3),
+    ]}
+    rep = prof_cli.attribution(doc)
+    ov = rep["phase_overlap"]
+    assert ov["max_s"] == pytest.approx(0.05)
+    assert ov["per_rank_s"]["0"] == pytest.approx(0.05)
+    assert ov["per_rank_s"]["1"] == 0.0
+    assert ov["mean_s"] == pytest.approx(0.025)
+    text = prof_cli._render(rep)
+    assert "phase overlap" in text
+
+
+# -- lifecycle (runtime/state bring-up) ----------------------------------
+
+def test_requested_env_and_cvar(monkeypatch):
+    monkeypatch.delenv("OMPI_TPU_INGEST", raising=False)
+    monkeypatch.delenv("OMPI_TPU_INGEST_ENABLE", raising=False)
+    assert ie.requested() is False
+    monkeypatch.setenv("OMPI_TPU_INGEST", "1")
+    assert ie.requested() is True
+    monkeypatch.setenv("OMPI_TPU_INGEST", "off")
+    assert ie.requested() is False
+
+
+def test_enable_disable_idempotent():
+    try:
+        eng = ie.enable(rank=3)
+        assert ie.INGEST is eng and eng.rank == 3
+        assert ie.enable() is eng        # idempotent
+        assert ie.enable(rank=5) is eng and eng.rank == 5
+    finally:
+        assert ie.disable() is eng
+    assert ie.INGEST is None
+    assert ie.disable() is None          # double-disable is a no-op
+
+
+def test_two_rank_bringup_via_mca():
+    """init_instance brings the plane up from the cvar and tears it
+    down at Finalize — the INGEST guard holds rank identity."""
+    run_ranks("""
+    from ompi_tpu.ingest import engine as ingest_engine
+    assert ingest_engine.INGEST is not None
+    assert ingest_engine.INGEST.rank == rank
+    r = ingest_engine.INGEST.upload(
+        {"w": np.arange(1000, dtype=np.float32) + rank})
+    got = r.tree()
+    np.testing.assert_array_equal(
+        np.asarray(got["w"]),
+        np.arange(1000, dtype=np.float32) + rank)
+    """, 2, mca={"ingest_enable": "1"})
