@@ -34,6 +34,16 @@ import time
 
 _T0 = time.time()
 
+if "--pallas" in sys.argv and "xla_force_host_platform_device_count" \
+        not in os.environ.get("XLA_FLAGS", ""):
+    # the pallas switchpoint card races algorithms across >= 2
+    # devices; on a CPU host fork 4 virtual devices BEFORE jax first
+    # initializes (the TPU path brings its own device count and the
+    # flag only affects the host platform)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4")
+
 
 def _phase(msg: str) -> None:
     """Progress breadcrumbs on stderr (stdout stays one JSON line).
@@ -598,6 +608,114 @@ def _bench_ingest():
     }
 
 
+def _bench_pallas():
+    """coll/pallas switchpoint card (``--pallas``): the hand-rolled
+    ring / bidir / linear allreduce kernels raced against the XLA
+    lowering per (payload size, dtype) over the platform's devices.
+    Emits the per-bucket winner table plus ready-to-ingest
+    ``coll_pallas_switchpoints`` entries (keyed op, log2 bucket,
+    dtype, mesh shape; 'xla' where the lowering still wins) and a
+    ``bit_identical_linear`` flag re-proving the pallas linear fold
+    against coll/xla's 'linear' on the bench shapes. On a CPU host
+    the kernels run interpret-mode — schedule-correctness and
+    dispatch-cost numbers, not ICI bandwidth; the DMA-kernel numbers
+    need a real TPU round."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ompi_tpu import op as op_mod
+    from ompi_tpu.coll import pallas_kernels as K
+    from ompi_tpu.monitoring import algo as malgo
+    from ompi_tpu.parallel import collectives as C
+    from ompi_tpu.util import jaxcompat as jc
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise RuntimeError(
+            "pallas bench needs >= 2 devices (bench.py forces 4 host "
+            "devices when --pallas is passed before jax initializes)")
+    devs = devs[:4] if len(devs) >= 4 else devs[:2]
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("rk",))
+    mesh_shape = [n]
+    interp = devs[0].platform != "tpu"
+    fnc = C.combine_fn(op_mod.SUM)
+
+    algos = {
+        "xla": lambda x: C.allreduce(x, "rk", op_mod.SUM),
+        "ring": lambda x: K.ring_allreduce(x, "rk", fnc,
+                                           interpret=interp),
+        "bidir": lambda x: K.ring_allreduce(x, "rk", fnc,
+                                            interpret=interp,
+                                            bidir=True),
+        "linear": lambda x: K.linear_allreduce(x, "rk", fnc,
+                                               interpret=interp),
+    }
+
+    def compiled(call):
+        return jax.jit(jc.shard_map(
+            lambda x: call(x[0]), mesh=mesh, in_specs=P("rk"),
+            out_specs=P(), check_vma=False))
+
+    sizes = ((1 << 14, 1 << 17, 1 << 20) if interp
+             else (1 << 16, 1 << 20, 1 << 24))
+    reps = 3 if interp else 20
+    rows, switchpoints = [], []
+    bit_ok = True
+    best = 0.0
+    for dtn in ("float32", "bfloat16"):
+        dt = jnp.dtype(dtn)
+        for nbytes in sizes:
+            elems = nbytes // dt.itemsize
+            base = (np.arange(elems, dtype=np.float32)
+                    % 251 * 0.125 - 15.0)
+            g = jax.device_put(
+                np.stack([base * (r + 1) for r in range(n)]).astype(
+                    dt), NamedSharding(mesh, P("rk")))
+            row = {"op": "allreduce", "dtype": dtn, "nbytes": nbytes,
+                   "log2": malgo.log2_bucket(nbytes)}
+            outs = {}
+            for name, call in algos.items():
+                fn = compiled(call)
+                out = fn(g)
+                jax.block_until_ready(out)  # compile + warm
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    out = fn(g)
+                jax.block_until_ready(out)
+                row[f"{name}_ms"] = round(
+                    (time.perf_counter() - t0) / reps * 1e3, 3)
+                outs[name] = np.asarray(out)
+            # the reproducibility contract, re-proven on bench shapes:
+            # pallas linear fold == coll/xla 'linear' bit for bit
+            lin = compiled(lambda x: C.allreduce(
+                x, "rk", op_mod.SUM, deterministic="linear"))(g)
+            u = np.uint32 if dt.itemsize == 4 else np.uint16
+            bit_ok = bool(bit_ok and (
+                outs["linear"].view(u)
+                == np.asarray(lin).view(u)).all())
+            winner = min(algos, key=lambda a: row[f"{a}_ms"])
+            row["winner"] = winner
+            if winner != "xla":
+                best = max(best,
+                           row["xla_ms"] / max(row[f"{winner}_ms"],
+                                               1e-9))
+            rows.append(row)
+            switchpoints.append(
+                {"op": "allreduce", "dtype": dtn, "mesh": mesh_shape,
+                 "log2": row["log2"], "algorithm": winner})
+    return {
+        "mesh": mesh_shape,
+        "interpret": interp,
+        "table": rows,
+        "switchpoints": switchpoints,
+        "bit_identical_linear": bit_ok,
+        "best_speedup_vs_xla": round(best, 3),
+    }
+
+
 #: microbench extras compared across rounds once a TPU round records
 #: them in bench_baseline.json: (section, key, higher_is_better)
 _EXTRA_BASELINE_KEYS = (
@@ -613,6 +731,7 @@ _EXTRA_BASELINE_KEYS = (
     ("ingest", "streamed_cold_s", False),
     ("ingest", "cold_start_speedup", True),
     ("ingest", "ingest_h2d_GBs", True),
+    ("pallas", "best_speedup_vs_xla", True),
 )
 
 
@@ -740,6 +859,13 @@ def main() -> None:
             _phase("ingest microbench done")
         except Exception as e:
             _phase(f"ingest microbench skipped: {e!r}")
+    pallas = None
+    if "--pallas" in sys.argv:
+        try:
+            pallas = _bench_pallas()
+            _phase("pallas microbench done")
+        except Exception as e:
+            _phase(f"pallas microbench skipped: {e!r}")
     if trace_path is not None:
         from ompi_tpu.trace import export as trace_export
         from ompi_tpu.trace import recorder as trace_rec
@@ -777,7 +903,8 @@ def main() -> None:
                                   {"dispatch": dispatch,
                                    "overlap": overlap,
                                    "zero": zero,
-                                   "ingest": ingest})
+                                   "ingest": ingest,
+                                   "pallas": pallas})
         except Exception:
             pass
 
@@ -821,6 +948,7 @@ def main() -> None:
             "monitoring": monitoring,
             "zero": zero,
             "ingest": ingest,
+            "pallas": pallas,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),
             # wall attribution from the prof-plane phase ledger

@@ -61,6 +61,9 @@ SLOTS = (
     "reduce_scatter_multi_dev", "reduce_scatter_multi_init_dev",
     "allgather_multi_dev", "allgather_multi_init_dev",
     "preduce_scatter_init_dev",
+    # coll/pallas fused compute+comm kernels: reduce_scatter fused
+    # with the ZeRO shard update, matmul-overlapped allgather (TP)
+    "fused_rs_update_dev", "allgather_matmul_dev",
 )
 
 
@@ -144,8 +147,8 @@ def comm_select(comm) -> None:
 
 def _register_builtin() -> None:
     from ompi_tpu.coll import (  # noqa: F401
-        accelerator, adapt, basic, han, inter, libnbc, sync, tuned,
-        xla,
+        accelerator, adapt, basic, han, inter, libnbc, pallas, sync,
+        tuned, xla,
     )
 
 

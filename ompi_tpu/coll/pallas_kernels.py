@@ -1,0 +1,698 @@
+"""coll/pallas_kernels — hand-rolled ring collective kernels in Pallas.
+
+The kernel library under :mod:`ompi_tpu.coll.pallas`: ring and
+bidirectional-ring reduce_scatter / allgather / allreduce, the
+rank-order "linear" fold, and the two fused compute+comm kernels
+(reduce_scatter fused with the ZeRO shard update, matmul-overlapped
+allgather). Every function runs inside ``shard_map`` tracing with the
+comm's mesh axis bound, exactly like :mod:`ompi_tpu.parallel.ring` —
+and follows the *same chunk schedule*, so 'ring' results are bitwise
+equal to the ppermute rings and 'linear' results are bitwise equal to
+``coll/xla``'s rank-order fold.
+
+Transport gate (``interpret=``):
+
+- **TPU** (``interpret=False``): one monolithic ``pl.pallas_call``
+  per collective — double-buffered VMEM scratch, a DMA semaphore pair
+  per buffer slot, and ``pltpu.make_async_remote_copy`` to the ring
+  neighbor (the SNIPPETS exemplar pattern). A barrier-semaphore
+  handshake with both neighbors opens the kernel so no rank DMAs into
+  a peer that has not entered it. The fused kernels consume the final
+  combined chunk in-register (update epilogue / per-hop matmul)
+  instead of round-tripping HBM.
+- **CPU / interpret** (``interpret=True``): no jax release can
+  emulate inter-device DMA in the interpreter, so the *hop* is a
+  ``lax.ppermute`` while every *combine / fold / matmul / update*
+  runs as a ``pl.pallas_call(..., interpret=True)`` kernel. The
+  accumulation order is identical to the DMA schedule, which is what
+  lets tier-1 and the smoke lane prove ring correctness (and
+  bit-identity vs ``coll/xla``) without hardware.
+
+Real-TPU cycle numbers for the DMA path are a carry-over (ROADMAP);
+the schedule, buffering and semaphore protocol are validated here in
+interpret mode.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ompi_tpu.util import jaxcompat
+
+#: barrier-semaphore collective ids for the monolithic DMA kernels
+#: (concurrently-live kernels must not share one)
+CID_RS, CID_AG, CID_FUSED, CID_MATMUL, CID_LINEAR = 1, 2, 3, 4, 5
+
+
+def _pl():
+    return jaxcompat.pallas()
+
+
+def _pltpu():
+    return jaxcompat.pallas_tpu()
+
+
+def _compiler_params(pltpu, collective_id: int):
+    """TPU compiler params across jax versions (CompilerParams vs the
+    older TPUCompilerParams spelling); the barrier semaphore requires
+    a collective_id and the remote DMAs must not be DCE'd."""
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    try:
+        return cls(has_side_effects=True, collective_id=collective_id)
+    except TypeError:
+        return cls(collective_id=collective_id)
+
+
+def _perm(n: int, d: int):
+    return [(i, (i + d) % n) for i in range(n)]
+
+
+def _hop(x, axis: str, n: int, d: int):
+    """One ring hop toward the +d neighbor (interpret-mode transport)."""
+    return lax.ppermute(x, axis, perm=_perm(n, d))
+
+
+# ---------------------------------------------------------------------------
+# kernel bodies — shared verbatim between the interpret path and the
+# epilogues of the monolithic DMA kernels
+
+
+def _combine_body(fn: Callable):
+    def kernel(a_ref, b_ref, o_ref):
+        o_ref[...] = fn(a_ref[...], b_ref[...])
+
+    return kernel
+
+
+def _fold_body(n: int, fn: Callable):
+    """acc = g[0]; acc = fn(acc, g[i]) for i in 1..n-1 — the exact
+    statically-unrolled rank-order fold of coll/xla's 'linear' mode."""
+
+    def kernel(g_ref, o_ref):
+        acc = g_ref[0]
+        for i in range(1, n):
+            acc = fn(acc, g_ref[i])
+        o_ref[...] = acc
+
+    return kernel
+
+
+def _roll_body(x_ref, s_ref, o_ref):
+    """Rotate hop-ordered blocks into rank order (the allgather
+    reassembly step; shift comes in as a (1,) scalar operand)."""
+    o_ref[...] = jnp.roll(x_ref[...], s_ref[0], axis=0)
+
+
+def _matmul_body(out_dtype):
+    def kernel(x_ref, w_ref, o_ref):
+        o_ref[...] = jnp.dot(x_ref[...], w_ref[...],
+                             preferred_element_type=out_dtype)
+
+    return kernel
+
+
+def _apply_update(g, p, v, lr: float, mu: float, inv: Optional[float]):
+    """The ZeroOptimizer.step shard update, constants cast to the
+    shard dtype exactly as the unfused path does. The unfused
+    sequence dispatches each elementwise op as its OWN program, so
+    every intermediate is correctly rounded; fused into one program
+    the backend may still contract mul+add pairs into FMAs (LLVM
+    contracts straight through optimization_barrier — the barriers
+    only keep the op ORDER fixed), so the fused epilogue is
+    equivalent to the unfused step to within one ulp, not bitwise.
+    coll/pallas therefore runs this epilogue eagerly (outside the
+    kernel) when ``deterministic='linear'`` demands bit-identity."""
+    if inv is not None:
+        g = lax.optimization_barrier(g * jnp.asarray(inv, g.dtype))
+    vn = None
+    if v is not None:
+        t = lax.optimization_barrier(jnp.asarray(mu, v.dtype) * v)
+        vn = lax.optimization_barrier(t + g)
+        g = vn
+    step = lax.optimization_barrier(jnp.asarray(lr, p.dtype) * g)
+    pn = p - step
+    return pn, vn
+
+
+def _combine_update_body(fn, lr, mu, inv, with_mom: bool):
+    """Final ring combine fused with the ZeRO shard update: the
+    reduced chunk is consumed in-register by the optimizer epilogue."""
+
+    if with_mom:
+        def kernel(a_ref, b_ref, p_ref, v_ref, po_ref, vo_ref):
+            g = fn(a_ref[...], b_ref[...])
+            pn, vn = _apply_update(g, p_ref[...], v_ref[...],
+                                   lr, mu, inv)
+            po_ref[...] = pn
+            vo_ref[...] = vn
+
+        return kernel
+
+    def kernel(a_ref, b_ref, p_ref, po_ref):
+        g = fn(a_ref[...], b_ref[...])
+        pn, _ = _apply_update(g, p_ref[...], None, lr, mu, inv)
+        po_ref[...] = pn
+
+    return kernel
+
+
+def _fold_slice_body(n: int, k: int, fn):
+    """Rank-order fold + own-chunk slice (linear reduce_scatter in one
+    kernel — same fold-then-slice order as C.reduce_scatter 'linear')."""
+
+    def kernel(g_ref, r_ref, o_ref):
+        full = g_ref[0]
+        for i in range(1, n):
+            full = fn(full, g_ref[i])
+        o_ref[...] = lax.dynamic_slice_in_dim(full, r_ref[0] * k, k,
+                                              axis=0)
+
+    return kernel
+
+
+def _fold_slice_update_body(n: int, k: int, fn, lr, mu, inv,
+                            with_mom: bool):
+    """Linear fused kernel: rank-order fold, own-chunk slice, and the
+    ZeRO update epilogue in one pallas_call."""
+
+    if with_mom:
+        def kernel(g_ref, r_ref, p_ref, v_ref, po_ref, vo_ref):
+            full = g_ref[0]
+            for i in range(1, n):
+                full = fn(full, g_ref[i])
+            g = lax.dynamic_slice_in_dim(full, r_ref[0] * k, k, axis=0)
+            pn, vn = _apply_update(g, p_ref[...], v_ref[...],
+                                   lr, mu, inv)
+            po_ref[...] = pn
+            vo_ref[...] = vn
+
+        return kernel
+
+    def kernel(g_ref, r_ref, p_ref, po_ref):
+        full = g_ref[0]
+        for i in range(1, n):
+            full = fn(full, g_ref[i])
+        g = lax.dynamic_slice_in_dim(full, r_ref[0] * k, k, axis=0)
+        pn, _ = _apply_update(g, p_ref[...], None, lr, mu, inv)
+        po_ref[...] = pn
+
+    return kernel
+
+
+def _call(body, out_shape, *args):
+    """interpret-mode pallas_call over whole-array blocks."""
+    pl = _pl()
+    return pl.pallas_call(body, out_shape=out_shape, interpret=True)(
+        *args)
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# reduce_scatter
+
+
+def ring_reduce_scatter(x, axis: str, fn: Callable, *,
+                        interpret: bool = True, direction: int = 1):
+    """Ring reduce_scatter, chunk schedule identical to
+    :func:`ompi_tpu.parallel.ring.ring_reduce_scatter` (carry starts
+    at chunk r-d, step s folds ``fn(carry, own)`` with own chunk
+    r-(s+2)d): dim 0 of x (size n*k) shrinks to k; rank r ends with
+    chunk r reduced in ring-visit order. direction=-1 runs the
+    mirror-image (counterclockwise) ring."""
+    n = jaxcompat.axis_size(axis)
+    if n == 1:
+        return x
+    assert x.shape[0] % n == 0, (
+        f"ring_reduce_scatter: dim0 {x.shape[0]} not divisible by {n}")
+    k = x.shape[0] // n
+    if not interpret:
+        return _dma_reduce_scatter(x, axis, n, k, fn, direction)
+    chunks = x.reshape((n, k) + x.shape[1:])
+    r = lax.axis_index(axis)
+    carry = lax.dynamic_index_in_dim(chunks, (r - direction) % n,
+                                     keepdims=False)
+    for s in range(n - 1):
+        carry = _hop(carry, axis, n, direction)
+        own = lax.dynamic_index_in_dim(
+            chunks, (r - (s + 2) * direction) % n, keepdims=False)
+        carry = _call(_combine_body(fn),
+                      _sds(carry.shape, carry.dtype), carry, own)
+    return carry
+
+
+def bidir_reduce_scatter(x, axis: str, fn: Callable, *,
+                         interpret: bool = True):
+    """Bidirectional ring reduce_scatter: the front half of every
+    chunk's rows travels the clockwise ring, the back half the
+    counterclockwise ring — both ICI link directions carry payload
+    simultaneously. Deterministic (fixed schedule) but its fold order
+    is its own; callers pick it only when no bit-identity mode was
+    requested. Requires >= 2 rows per chunk (fall back to ring below
+    that)."""
+    n = jaxcompat.axis_size(axis)
+    if n == 1:
+        return x
+    k = x.shape[0] // n
+    h = k // 2
+    assert h >= 1, "bidir_reduce_scatter: need >= 2 rows per chunk"
+    rest = x.shape[1:]
+    chunks = x.reshape((n, k) + rest)
+    front = chunks[:, :h].reshape((n * h,) + rest)
+    back = chunks[:, h:].reshape((n * (k - h),) + rest)
+    cf = ring_reduce_scatter(front, axis, fn, interpret=interpret,
+                             direction=1)
+    cb = ring_reduce_scatter(back, axis, fn, interpret=interpret,
+                             direction=-1)
+    return jnp.concatenate([cf, cb], axis=0)
+
+
+def linear_reduce_scatter(x, axis: str, fn: Callable, *,
+                          interpret: bool = True):
+    """'linear' reduce_scatter: gather every rank's contribution,
+    fold in exact rank order, slice the own chunk — one pallas
+    kernel, elementwise bit-identical to coll/xla's
+    allreduce-linear + slice path."""
+    n = jaxcompat.axis_size(axis)
+    if n == 1:
+        return x
+    k = x.shape[0] // n
+    g = _gather_stack(x, axis, n, interpret)
+    r = lax.axis_index(axis).astype(jnp.int32)[None]
+    body = _fold_slice_body(n, k, fn)
+    out_shape = _sds((k,) + x.shape[1:], x.dtype)
+    if interpret:
+        return _call(body, out_shape, g, r)
+    pl = _pl()
+    return pl.pallas_call(body, out_shape=out_shape)(g, r)
+
+
+# ---------------------------------------------------------------------------
+# allgather
+
+
+def ring_allgather(x, axis: str, *, interpret: bool = True,
+                   direction: int = 1):
+    """Ring allgather: local [k, ...] -> [n*k, ...] with rank i's
+    block at chunk i (the parallel/ring.py placement). The interpret
+    path collects blocks in hop order and rotates them into rank
+    order with one pallas roll kernel."""
+    n = jaxcompat.axis_size(axis)
+    if n == 1:
+        return x
+    if not interpret:
+        return _dma_allgather(x, axis, n, direction)
+    r = lax.axis_index(axis)
+    blocks = [x]
+    blk = x
+    for _ in range(n - 1):
+        blk = _hop(blk, axis, n, direction)
+        blocks.append(blk)
+    # hop order: block j is rank (r - j*d)'s. Rotate into rank order:
+    # d=+1 -> reverse then roll by r+1; d=-1 -> roll by r.
+    if direction == 1:
+        arr = jnp.stack(blocks[::-1])
+        shift = (r + 1).astype(jnp.int32)[None]
+    else:
+        arr = jnp.stack(blocks)
+        shift = r.astype(jnp.int32)[None]
+    out = _call(_roll_body, _sds(arr.shape, arr.dtype), arr, shift)
+    return out.reshape((n * x.shape[0],) + x.shape[1:])
+
+
+def bidir_allgather(x, axis: str, *, interpret: bool = True):
+    """Bidirectional ring allgather: front rows clockwise, back rows
+    counterclockwise; each direction moves half the payload."""
+    n = jaxcompat.axis_size(axis)
+    if n == 1:
+        return x
+    k = x.shape[0]
+    h = k // 2
+    assert h >= 1, "bidir_allgather: need >= 2 rows per block"
+    rest = x.shape[1:]
+    gf = ring_allgather(x[:h], axis, interpret=interpret, direction=1)
+    gb = ring_allgather(x[h:], axis, interpret=interpret, direction=-1)
+    gf = gf.reshape((n, h) + rest)
+    gb = gb.reshape((n, k - h) + rest)
+    return jnp.concatenate([gf, gb], axis=1).reshape((n * k,) + rest)
+
+
+def _gather_stack(x, axis: str, n: int, interpret: bool):
+    """[n, *x.shape] stack of every rank's block (rank i at index i) —
+    the 'linear' transport. Interpret mode uses lax.all_gather (the
+    very op coll/xla's linear fold gathers with, so operands are
+    bitwise identical); the DMA path rings the flat payload around."""
+    if interpret:
+        return lax.all_gather(x, axis)
+    full = _dma_allgather(x.reshape((1,) + x.shape), axis, n, 1)
+    return full.reshape((n,) + x.shape)
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+
+
+def ring_allreduce(x, axis: str, fn: Callable, *,
+                   interpret: bool = True, bidir: bool = False):
+    """Bandwidth-optimal allreduce = reduce_scatter + allgather over
+    the flattened payload, zero-padded to a multiple of n — the exact
+    pad/slice framing of parallel.ring.ring_allreduce, so the 'ring'
+    result is bitwise equal to coll/xla's ring mode."""
+    n = jaxcompat.axis_size(axis)
+    if n == 1:
+        return x
+    shape = x.shape
+    flat = x.reshape(-1)
+    m = flat.shape[0]
+    pad = (-m) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), x.dtype)])
+    if bidir and flat.shape[0] // n >= 2:
+        chunk = bidir_reduce_scatter(flat, axis, fn,
+                                     interpret=interpret)
+        full = bidir_allgather(chunk, axis, interpret=interpret)
+    else:
+        chunk = ring_reduce_scatter(flat, axis, fn,
+                                    interpret=interpret)
+        full = ring_allgather(chunk, axis, interpret=interpret)
+    return full[:m].reshape(shape)
+
+
+def linear_allreduce(x, axis: str, fn: Callable, *,
+                     interpret: bool = True):
+    """'linear' allreduce: gather all contributions, fold in exact
+    rank order 0..n-1 inside one pallas kernel — bit-identical to
+    coll/xla's ``_allreduce_linear`` (same gathered operands, same
+    statically-unrolled fold)."""
+    n = jaxcompat.axis_size(axis)
+    if n == 1:
+        return x
+    g = _gather_stack(x, axis, n, interpret)
+    body = _fold_body(n, fn)
+    if interpret:
+        return _call(body, _sds(x.shape, x.dtype), g)
+    pl = _pl()
+    return pl.pallas_call(body, out_shape=_sds(x.shape, x.dtype))(g)
+
+
+# ---------------------------------------------------------------------------
+# fused: reduce_scatter + ZeRO shard update
+
+
+def ring_reduce_scatter_update(x, axis: str, fn: Callable, p, v, *,
+                               lr: float, mu: float,
+                               inv: Optional[float],
+                               interpret: bool = True):
+    """Ring reduce_scatter whose FINAL combine step is fused with the
+    ZeRO stage-1/2 shard update: the reduced gradient chunk is
+    consumed in-register by ``p -= lr * (mu*v + g*inv)`` instead of
+    round-tripping HBM. x is the flat padded bucket (n*k,), p/v the
+    (k,) param/momentum shards (v may be None). Returns (p', v')."""
+    n = jaxcompat.axis_size(axis)
+    k = x.shape[0] // n
+    with_mom = v is not None
+    if not interpret:
+        return _dma_reduce_scatter_update(x, axis, n, k, fn, p, v,
+                                          lr=lr, mu=mu, inv=inv)
+    chunks = x.reshape((n, k))
+    r = lax.axis_index(axis)
+    carry = lax.dynamic_index_in_dim(chunks, (r - 1) % n,
+                                     keepdims=False)
+    for s in range(n - 2):
+        carry = _hop(carry, axis, n, 1)
+        own = lax.dynamic_index_in_dim(chunks, (r - 2 - s) % n,
+                                       keepdims=False)
+        carry = _call(_combine_body(fn),
+                      _sds(carry.shape, carry.dtype), carry, own)
+    # last hop: combine + update in ONE kernel
+    carry = _hop(carry, axis, n, 1)
+    own = lax.dynamic_index_in_dim(chunks, (r - n) % n, keepdims=False)
+    body = _combine_update_body(fn, lr, mu, inv, with_mom)
+    if with_mom:
+        return _call(body, (_sds(p.shape, p.dtype),
+                            _sds(v.shape, v.dtype)),
+                     carry, own, p, v)
+    pn, = _call(body, (_sds(p.shape, p.dtype),), carry, own, p)
+    return pn, None
+
+
+def linear_reduce_scatter_update(x, axis: str, fn: Callable, p, v, *,
+                                 lr: float, mu: float,
+                                 inv: Optional[float],
+                                 interpret: bool = True):
+    """'linear' fused variant: rank-order fold + own-chunk slice +
+    update in one kernel — bit-identical to the unfused
+    reduce_scatter('linear') -> average -> momentum -> SGD sequence."""
+    n = jaxcompat.axis_size(axis)
+    k = x.shape[0] // n
+    with_mom = v is not None
+    g = _gather_stack(x, axis, n, interpret)
+    r = lax.axis_index(axis).astype(jnp.int32)[None]
+    body = _fold_slice_update_body(n, k, fn, lr, mu, inv, with_mom)
+    if with_mom:
+        out_shape = (_sds(p.shape, p.dtype), _sds(v.shape, v.dtype))
+        args = (g, r, p, v)
+    else:
+        out_shape = (_sds(p.shape, p.dtype),)
+        args = (g, r, p)
+    if interpret:
+        outs = _call(body, out_shape, *args)
+    else:
+        pl = _pl()
+        outs = pl.pallas_call(body, out_shape=out_shape)(*args)
+    return (outs[0], outs[1]) if with_mom else (outs[0], None)
+
+
+# ---------------------------------------------------------------------------
+# fused: matmul-overlapped allgather (tensor parallelism)
+
+
+def allgather_matmul(x, w, axis: str, *, interpret: bool = True):
+    """allgather(x) @ w with the per-block matmul overlapping the
+    next ring hop (the tensor-parallel row-gather fusion): x is the
+    local (m, d) block of a row-sharded activation, w the local
+    (d, f) weight; returns the full (n*m, f) product. Each arriving
+    block is multiplied while the following block is in flight —
+    never materializing the gathered (n*m, d) activation."""
+    n = jaxcompat.axis_size(axis)
+    out_dtype = jnp.result_type(x.dtype, w.dtype)
+    if n == 1:
+        return _call(_matmul_body(out_dtype),
+                     _sds((x.shape[0], w.shape[1]), out_dtype), x, w)
+    if not interpret:
+        return _dma_allgather_matmul(x, w, axis, n, out_dtype)
+    m, f = x.shape[0], w.shape[1]
+    r = lax.axis_index(axis)
+    body = _matmul_body(out_dtype)
+    prods = [_call(body, _sds((m, f), out_dtype), x, w)]
+    blk = x
+    for _ in range(n - 1):
+        blk = _hop(blk, axis, n, 1)
+        prods.append(_call(body, _sds((m, f), out_dtype), blk, w))
+    arr = jnp.stack(prods[::-1])  # hop order -> rank order (cw ring)
+    shift = (r + 1).astype(jnp.int32)[None]
+    out = _call(_roll_body, _sds(arr.shape, arr.dtype), arr, shift)
+    return out.reshape((n * m, f))
+
+
+# ---------------------------------------------------------------------------
+# monolithic DMA kernels (TPU path — interpret=False)
+#
+# Shared protocol: a barrier-semaphore handshake with both ring
+# neighbors opens every kernel; payload then moves through a
+# double-buffered VMEM scratch (2 slots, one DMA send/recv semaphore
+# pair each) via make_async_remote_copy to the +d neighbor. Slot s%2
+# alternation plus the blocking wait each step keeps reuse safe: a
+# slot is rewritten two steps after the neighbor consumed it.
+
+
+def _neighbor_handshake(pltpu, my, n: int, d: int):
+    nxt = (my + d) % n
+    prv = (my - d) % n
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, 1, device_id=(nxt,),
+                           device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_signal(barrier, 1, device_id=(prv,),
+                           device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_wait(barrier, 2)
+    return nxt
+
+
+def _dma_reduce_scatter(x, axis: str, n: int, k: int, fn: Callable,
+                        d: int):
+    pl, pltpu = _pl(), _pltpu()
+    chunk_shape = (k,) + x.shape[1:]
+
+    def kernel(x_ref, o_ref, comm_buf, send_sem, recv_sem):
+        my = lax.axis_index(axis)
+        nxt = _neighbor_handshake(pltpu, my, n, d)
+        comm_buf[0] = x_ref[pl.ds(((my - d) % n) * k, k)]
+        for s in range(n - 1):
+            slot, nslot = s % 2, (s + 1) % 2
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_buf.at[slot],
+                dst_ref=comm_buf.at[nslot],
+                send_sem=send_sem.at[slot],
+                recv_sem=recv_sem.at[nslot],
+                device_id=(nxt,),
+                device_id_type=pltpu.DeviceIdType.MESH)
+            rdma.start()
+            rdma.wait()
+            own = x_ref[pl.ds(((my - (s + 2) * d) % n) * k, k)]
+            comm_buf[nslot] = fn(comm_buf[nslot], own)
+        o_ref[...] = comm_buf[(n - 1) % 2]
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=_sds(chunk_shape, x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2,) + chunk_shape, x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=_compiler_params(pltpu, CID_RS),
+    )(x)
+
+
+def _dma_allgather(x, axis: str, n: int, d: int):
+    pl, pltpu = _pl(), _pltpu()
+    k = x.shape[0]
+    out_shape = (n * k,) + x.shape[1:]
+
+    def kernel(x_ref, o_ref, comm_buf, send_sem, recv_sem):
+        my = lax.axis_index(axis)
+        nxt = _neighbor_handshake(pltpu, my, n, d)
+        o_ref[pl.ds(my * k, k)] = x_ref[...]
+        comm_buf[0] = x_ref[...]
+        for s in range(n - 1):
+            slot, nslot = s % 2, (s + 1) % 2
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_buf.at[slot],
+                dst_ref=comm_buf.at[nslot],
+                send_sem=send_sem.at[slot],
+                recv_sem=recv_sem.at[nslot],
+                device_id=(nxt,),
+                device_id_type=pltpu.DeviceIdType.MESH)
+            rdma.start()
+            rdma.wait()
+            src = (my - (s + 1) * d) % n
+            o_ref[pl.ds(src * k, k)] = comm_buf[nslot]
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=_sds(out_shape, x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, k) + x.shape[1:], x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=_compiler_params(pltpu, CID_AG),
+    )(x)
+
+
+def _dma_reduce_scatter_update(x, axis: str, n: int, k: int,
+                               fn: Callable, p, v, *, lr, mu, inv):
+    pl, pltpu = _pl(), _pltpu()
+    with_mom = v is not None
+
+    def body(x_ref, p_ref, v_ref, po_ref, vo_ref, comm_buf,
+             send_sem, recv_sem):
+        my = lax.axis_index(axis)
+        nxt = _neighbor_handshake(pltpu, my, n, 1)
+        comm_buf[0] = x_ref[pl.ds(((my - 1) % n) * k, k)]
+        for s in range(n - 1):
+            slot, nslot = s % 2, (s + 1) % 2
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_buf.at[slot],
+                dst_ref=comm_buf.at[nslot],
+                send_sem=send_sem.at[slot],
+                recv_sem=recv_sem.at[nslot],
+                device_id=(nxt,),
+                device_id_type=pltpu.DeviceIdType.MESH)
+            rdma.start()
+            rdma.wait()
+            own = x_ref[pl.ds(((my - 2 - s) % n) * k, k)]
+            comm_buf[nslot] = fn(comm_buf[nslot], own)
+        # fused epilogue: the reduced chunk never leaves VMEM
+        g = comm_buf[(n - 1) % 2]
+        pn, vn = _apply_update(g, p_ref[...],
+                               v_ref[...] if with_mom else None,
+                               lr, mu, inv)
+        po_ref[...] = pn
+        if with_mom:
+            vo_ref[...] = vn
+
+    if with_mom:
+        def kernel(x_ref, p_ref, v_ref, po_ref, vo_ref, *scratch):
+            body(x_ref, p_ref, v_ref, po_ref, vo_ref, *scratch)
+
+        out_shape = (_sds(p.shape, p.dtype), _sds(v.shape, v.dtype))
+        args = (x, p, v)
+    else:
+        def kernel(x_ref, p_ref, po_ref, *scratch):
+            body(x_ref, p_ref, None, po_ref, None, *scratch)
+
+        out_shape = (_sds(p.shape, p.dtype),)
+        args = (x, p)
+
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((2, k), x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=_compiler_params(pltpu, CID_FUSED),
+    )(*args)
+    return (outs[0], outs[1]) if with_mom else (outs[0], None)
+
+
+def _dma_allgather_matmul(x, w, axis: str, n: int, out_dtype):
+    pl, pltpu = _pl(), _pltpu()
+    m, f = x.shape[0], w.shape[1]
+
+    def kernel(x_ref, w_ref, o_ref, comm_buf, send_sem, recv_sem):
+        my = lax.axis_index(axis)
+        nxt = _neighbor_handshake(pltpu, my, n, 1)
+        comm_buf[0] = x_ref[...]
+        for s in range(n - 1):
+            slot, nslot = s % 2, (s + 1) % 2
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_buf.at[slot],
+                dst_ref=comm_buf.at[nslot],
+                send_sem=send_sem.at[slot],
+                recv_sem=recv_sem.at[nslot],
+                device_id=(nxt,),
+                device_id_type=pltpu.DeviceIdType.MESH)
+            rdma.start()
+            # overlap: multiply the block that arrived last hop (own
+            # block at s=0) while this hop's DMA is in flight
+            src = (my - s) % n
+            o_ref[pl.ds(src * m, m)] = jnp.dot(
+                comm_buf[slot], w_ref[...],
+                preferred_element_type=out_dtype)
+            rdma.wait()
+        last = (my - (n - 1)) % n
+        o_ref[pl.ds(last * m, m)] = jnp.dot(
+            comm_buf[(n - 1) % 2], w_ref[...],
+            preferred_element_type=out_dtype)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=_sds((n * m, f), out_dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2,) + x.shape, x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=_compiler_params(pltpu, CID_MATMUL),
+    )(x, w)

@@ -95,6 +95,13 @@ WELL_KNOWN = (
     "ingest_uploads", "ingest_units", "ingest_bytes",
     "ingest_parrived", "ingest_early_starts", "ingest_gate_ns",
     "ingest_cancelled", "ingest_compile_overlaps", "ingest_inflight",
+    # coll/pallas (hand-rolled ring collectives): kernel launches,
+    # fused compute+comm kernel launches (ZeRO update / allgather-
+    # matmul), staged fallthroughs to coll/xla, and bytes moved per
+    # algorithm family (the switchpoint-tuning signal bench.py
+    # --pallas reads back)
+    "pallas_launches", "pallas_fused_launches", "pallas_fallthrough",
+    "pallas_ring_bytes", "pallas_bidir_bytes", "pallas_linear_bytes",
     # check/ plane (runtime MPI sanitizer): argument/signature
     # violations raised, leaked requests reported at Finalize,
     # cross-rank fingerprint exchanges performed at level 2

@@ -56,6 +56,36 @@ def log2_bucket(nbytes: int) -> int:
     return b
 
 
+def pallas_per_peer(op: str, algorithm: str, rank: int, n: int,
+                    nbytes: int) -> Dict[int, float]:
+    """Bytes `rank` SENDS per peer for one coll/pallas launch — the
+    explicit hand-rolled schedules, not the XLA-lowering model above:
+
+    - ``ring``: every step sends 1/n of the payload to the clockwise
+      successor -> (n-1)/n * B to (rank+1) % n (doubled for allreduce
+      = reduce_scatter + allgather, exactly like the ring model).
+    - ``bidir``: half the rows travel each ring direction -> the same
+      total split evenly between (rank+1) % n and (rank-1) % n.
+    - ``linear``: the rank-order fold gathers every contribution, so
+      this rank ships its full block n-1 times along the ring edge
+      (the ``lax.all_gather`` transport coll/xla's fold uses too).
+
+    coll/pallas passes the result as ``TrafficMatrix.coll``'s
+    ``per_peer=`` override so level-2 ICI link attribution stays
+    exact for the new backend instead of falling back to the
+    XLA-lowering guess."""
+    if n <= 1:
+        return {}
+    nxt, prv = (rank + 1) % n, (rank - 1) % n
+    if algorithm == "linear":
+        return {nxt: float(nbytes) * (n - 1)}
+    mult = 2.0 if op in _RS_AG else 1.0
+    total = mult * nbytes * (n - 1) / n
+    if algorithm == "bidir":
+        return {nxt: total / 2.0, prv: total / 2.0}
+    return {nxt: total}
+
+
 def per_peer(op: str, rank: int, n: int, nbytes: int,
              root: int = 0,
              counts: Optional[Sequence[int]] = None,

@@ -39,3 +39,38 @@ def shard_map(fn, *, mesh, in_specs, out_specs, **kw):
         if "check_vma" in kw:
             kw["check_rep"] = kw.pop("check_vma")
     return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def pallas():
+    """The ``jax.experimental.pallas`` module across jax versions
+    (newer releases promote it to ``jax.pallas``)."""
+    try:
+        import jax.pallas as pl  # promoted surface, jax >= 0.8
+    except ImportError:
+        from jax.experimental import pallas as pl
+    return pl
+
+
+def pallas_tpu():
+    """The Pallas TPU extension module (``pltpu``: remote-DMA copies,
+    DMA/barrier semaphores, TPU memory spaces) across jax versions."""
+    try:
+        import jax.pallas.tpu as pltpu  # promoted surface
+    except ImportError:
+        from jax.experimental.pallas import tpu as pltpu
+    return pltpu
+
+
+def pallas_remote_dma_ok() -> bool:
+    """Whether this jax build can *execute* ``make_async_remote_copy``
+    kernels on the current default backend. True only on real TPU —
+    the CPU interpreter in every jax release to date cannot emulate
+    inter-device DMA, which is why :mod:`ompi_tpu.coll.pallas_kernels`
+    gates its transport (monolithic DMA kernel on TPU, per-step
+    interpret kernels + ``ppermute`` hops elsewhere)."""
+    import jax
+
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False

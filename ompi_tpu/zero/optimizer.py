@@ -74,13 +74,24 @@ class ZeroOptimizer:
       production of later gradients.
     - ``grad_average=True`` divides the reduced gradient shard by the
       comm size (data-parallel mean); False keeps the MPI SUM.
+    - ``fused=True`` (stage 2, no overlap): routes the whole
+      shard-grad + update through the comm's ``fused_rs_update_dev``
+      slot when a component provides it (coll/pallas: ONE kernel per
+      bucket reduce_scatters the gradients and consumes the reduced
+      chunk in-register with the average/momentum/SGD epilogue). The
+      slot returns None for unsupported cases, in which case — or
+      when no component installs the slot at all — the step falls
+      back to the unfused sequence below, the same staged-fallthrough
+      shape the device collectives use. Bit-identical to unfused
+      under ``deterministic='linear'``.
     """
 
     def __init__(self, comm, params, lr: float = 1e-3,
                  momentum: float = 0.0, stage: int = 2,
                  deterministic: Optional[str] = None,
                  overlap: bool = False,
-                 grad_average: bool = True) -> None:
+                 grad_average: bool = True,
+                 fused: bool = False) -> None:
         if stage not in (1, 2):
             raise errors.MPIError(
                 errors.ERR_ARG,
@@ -93,12 +104,20 @@ class ZeroOptimizer:
                 "ZeroOptimizer: overlap rides the partitioned "
                 "reduce_scatter — stage 2 only (stage 1 allreduces "
                 "full gradients)")
+        if fused and (stage != 2 or overlap):
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                "ZeroOptimizer: fused consumes the reduce_scattered "
+                "gradient in-kernel — stage 2 only, and mutually "
+                "exclusive with overlap (the partitioned request "
+                "already owns the reduce_scatter)")
         self._comm = comm
         self._lr = float(lr)
         self._mu = float(momentum)
         self._stage = stage
         self._det = deterministic
         self._avg = bool(grad_average)
+        self._fused = bool(fused)
         # every rank holds the full initial params: the shard is a
         # local slice, no collective
         self._pshards = _layout.ShardedState.from_full(comm, params)
@@ -143,6 +162,19 @@ class ZeroOptimizer:
         returns the new replicated parameter pytree."""
         import numpy as np
 
+        if self._fused and "fused_rs_update_dev" in self._comm.coll.fns:
+            mom = self.state.slots.get("momentum")
+            fused = self._comm.coll.fused_rs_update_dev(
+                self._comm, grads, self._pshards, mom,
+                lr=self._lr, mu=self._mu, avg=self._avg,
+                deterministic=self._det)
+            if fused is not None:  # None = unsupported case: run the
+                # unfused sequence below (staged fallthrough)
+                self._pshards, new_mom = fused
+                self.state.params = self._pshards
+                if new_mom is not None:
+                    self.state.slots["momentum"] = new_mom
+                return self._comm.Allgather_multi(self._pshards)
         # constants cast to the shard dtype: a bare python float would
         # upcast numpy f32 shards to f64 (dtype drift across the
         # host/device paths would break the bit-identity contract)

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# coll/pallas smoke lane: 2-rank CPU run of examples/pallas_collectives.py.
+# The example asserts the backend's contracts itself — pallas providers
+# own the slots, 'linear'/'ring' allreduce bit-identical to coll/xla,
+# int16 staged fallthrough, fused ZeRO bitwise under 'linear' — so the
+# lane runs it (interpret-mode kernels; the DMA path needs a TPU),
+# checks the success line, and keeps the JSON summary as an artifact.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+outdir="${1:-pallas_smoke_out}"
+mkdir -p "$outdir"
+
+out=$(JAX_PLATFORMS=cpu \
+  OMPI_TPU_PALLAS_ARTIFACT="$outdir/pallas_summary.json" \
+  python -m ompi_tpu.runtime.launcher -n 2 \
+  --timeout 120 \
+  --mca device_plane on \
+  --mca coll_pallas on \
+  examples/pallas_collectives.py)
+echo "$out"
+echo "$out" | grep -q "linear/ring bitwise" \
+  || { echo "pallas smoke: missing bit-identity line" >&2; exit 1; }
+echo "$out" | grep -Eq "[1-9][0-9]* kernel launches" \
+  || { echo "pallas smoke: no pallas kernel launches" >&2; exit 1; }
+echo "$out" | grep -Eq "[1-9][0-9]* staged fallthroughs" \
+  || { echo "pallas smoke: fallthrough path never exercised" >&2; exit 1; }
+[ -s "$outdir/pallas_summary.json" ] \
+  || { echo "pallas smoke: summary artifact missing" >&2; exit 1; }
+python - "$outdir/pallas_summary.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+assert d["bitwise_linear"] and d["bitwise_ring"], d
+assert d["fused_zero_bitwise"], d
+assert d["pallas_launches"] > 0 and d["pallas_fused_launches"] > 0, d
+EOF
+echo "pallas smoke OK"
