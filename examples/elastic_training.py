@@ -1,0 +1,86 @@
+"""Elastic training — surviving a rank failure without losing the run.
+
+A ZeRO-sharded run (examples/zero_optimizer.py) spreads optimizer
+state across ranks, so losing one rank normally loses 1/n of the
+momentum and the whole job. ``ElasticContext`` wraps the same train
+loop in a recovery driver: every step it snapshots this rank's shard
+chunks and mirrors them to the next rank in a buddy ring, so when a
+peer dies the survivors revoke the communicator, shrink it, *agree* on
+the last step everyone completed, rebuild the ZeroPlan for the smaller
+world, and re-shard the optimizer state **in memory** from the
+surviving chunks — pure layout arithmetic, no checkpoint read, and
+bit-identical to a cold restore by construction. Only when memory
+cannot cover the loss (e.g. adjacent buddies die together) does it
+fall back to the latest on-disk checkpoint.
+
+This example injects the failure deterministically: the ``--mca``
+flags below arm ``elastic/inject.py`` so rank 2 SIGKILLs itself
+entering step 3. The two survivors shrink, re-shard, replay from the
+agreed step, and finish all 8 steps with identical parameters.
+
+Run:  python -m ompi_tpu.runtime.launcher -n 3 --mca ft 1 \
+          --mca elastic_inject_kill_step 3 \
+          --mca elastic_inject_rank 2 \
+          examples/elastic_training.py
+
+Drop the two inject flags for a plain fault-free run, or see
+``ElasticContext.spawn_replacement`` / ``hot_join`` for growing the
+job back to full size at a step boundary. scripts/elastic_smoke.sh is
+the CI version of this scenario.
+"""
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+
+from ompi_tpu import elastic, mpi
+from ompi_tpu.core import pvar
+
+comm = mpi.Init()
+start_size = comm.size
+
+# all ranks must agree on the checkpoint directory (fallback path for
+# failures the in-memory story cannot cover)
+ckpt_dir = os.path.join(tempfile.gettempdir(), "ompi_tpu_elastic_example")
+
+params = {
+    "w": np.arange(24, dtype=np.float32).reshape(4, 6) / 11.0,
+    "b": np.linspace(-2.0, 2.0, 9).astype(np.float32),
+}
+
+
+def grad_fn(p, step, c):
+    # deterministic stand-in for a backward pass: the gradient depends
+    # only on the parameters and the step, never on the world size, so
+    # the post-recovery replay reproduces the fault-free trajectory
+    return jax.tree.map(
+        lambda a: 0.01 * a + np.full_like(a, 0.125 * (step + 1)), p)
+
+
+ctx = elastic.ElasticContext(comm, params, lr=0.125, momentum=0.5,
+                             checkpoint_dir=ckpt_dir, checkpoint_every=2)
+out = ctx.run(grad_fn, 8)
+
+# every survivor replayed to the same parameters — reduce a digest of
+# the first leaf and compare against the local value
+probe = float(np.asarray(jax.tree.leaves(out)[0]).sum())
+total = ctx.comm.allreduce(probe)
+np.testing.assert_allclose(total, probe * ctx.comm.size, rtol=0, atol=0)
+
+snap = pvar.snapshot()
+if ctx.comm.rank == 0:
+    if ctx.shrinks:
+        print(f"recovered: {start_size} -> {ctx.comm.size} ranks, "
+              f"resumed at step {ctx.last_resume} from "
+              f"{ctx.restored_from}, finished step {ctx.step_done}")
+        print(f"pvars: elastic_shrinks={snap.get('elastic_shrinks', 0)} "
+              f"reshard_bytes={snap.get('elastic_reshard_bytes', 0)} "
+              f"recovery_ns={snap.get('elastic_recovery_ns', 0)}")
+    else:
+        print(f"fault-free run: {ctx.comm.size} ranks, "
+              f"finished step {ctx.step_done}")
+    print(f"params digest probe {probe:.6f} identical on all "
+          f"{ctx.comm.size} survivors")
+mpi.Finalize()

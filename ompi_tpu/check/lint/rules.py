@@ -51,7 +51,8 @@ CATALOG: Dict[str, str] = {
         "freed, closed, returned, stored, or passed on",
     "bare-public-raise":
         "raise ValueError/TypeError on an MPI API path (coll/, osc/, "
-        "shmem/, part/, ingest/) — raise errors.MPIError(ERR_*) so "
+        "shmem/, part/, ingest/, elastic/) — raise "
+        "errors.MPIError(ERR_*) so "
         "the comm errhandler sees it (a bare ValueError bypasses "
         "_with_errhandler dispatch)",
     "unregistered-pvar":
@@ -124,9 +125,9 @@ GUARD_GLOBALS = frozenset(("FLIGHT", "RECORDER", "SANITIZER",
                            "TRAFFIC", "INGEST"))
 
 #: path components marking the MPI-convention public API surface for
-#: bare-public-raise (coll/, osc/, shmem/, part/, ingest/)
+#: bare-public-raise (coll/, osc/, shmem/, part/, ingest/, elastic/)
 PUBLIC_API_DIRS = frozenset(("coll", "osc", "shmem", "part",
-                             "ingest"))
+                             "ingest", "elastic"))
 
 
 # -- shared walking helpers ----------------------------------------------

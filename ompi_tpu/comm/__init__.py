@@ -301,6 +301,11 @@ class Communicator(AttrHost):
         self.__dict__.pop("_coll_xla_a2av_meta", None)
         # partitioned-p2p pairing epochs (part/host) die with the cid
         self.__dict__.pop("_part_epochs", None)
+        # ULFM agreement/shrink epochs die with the cid too — a
+        # reused cid must not alias a dead comm's epoch sequence
+        from ompi_tpu.ft import release_comm as _ft_release
+
+        _ft_release(self.cid)
         with _comms_lock:
             _comms.pop(self.cid, None)
         # the check-plane sanitizer flags any later call on this comm

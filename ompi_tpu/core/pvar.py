@@ -102,6 +102,23 @@ WELL_KNOWN = (
     # --pallas reads back)
     "pallas_launches", "pallas_fused_launches", "pallas_fallthrough",
     "pallas_ring_bytes", "pallas_bidir_bytes", "pallas_linear_bytes",
+    # ft/ failure plane: heartbeats emitted by the detector thread,
+    # faults/revocations applied on the progress engine, and the
+    # eventful-sweep wall (the hot no-news path is untimed — the
+    # sweep runs on every progress tick)
+    "ft_heartbeats", "ft_faults_observed", "ft_revokes_applied",
+    "ft_sweep_ns",
+    # elastic/ plane (shrink/regrow recovery): shrinks survived,
+    # replacement ranks admitted, bytes allgathered for the in-memory
+    # re-shard, recovery wall, checkpoint fallbacks taken vs
+    # snapshots written, and deterministic kills the inject harness
+    # fired (recorded in the doomed process)
+    "elastic_shrinks", "elastic_hot_joins", "elastic_reshard_bytes",
+    "elastic_recovery_ns", "elastic_fallback_restores",
+    "elastic_checkpoints", "elastic_injected_kills",
+    # kvstore client: initial-connect retries burned before the store
+    # answered (hot-joining ranks race store startup/recovery)
+    "kvstore_connect_retries",
     # check/ plane (runtime MPI sanitizer): argument/signature
     # violations raised, leaked requests reported at Finalize,
     # cross-rank fingerprint exchanges performed at level 2

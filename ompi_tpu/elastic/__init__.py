@@ -1,0 +1,19 @@
+"""Elastic training — rank-failure shrink/regrow over ZeRO state.
+
+The availability story (ROADMAP item 3): compose the ULFM plane
+(revoke/shrink/agree + heartbeat detector, Bland et al.'s User Level
+Failure Mitigation), ZeRO sharded optimizer state (Rajbhandari et
+al., SC'20), sharded checkpoints, and the streaming ingest plane into
+one driver — a mid-step rank death becomes a short, observable
+recovery (in-memory re-shard from the survivors' chunks) instead of
+a job loss, and a replacement rank hot-joins at a step boundary with
+state streamed in. See elastic/context for the driver,
+elastic/reshard for the layout arithmetic the bit-identity guarantee
+rides on, and elastic/inject for the deterministic fault harness
+tier-1 and CI use.
+"""
+
+from ompi_tpu.elastic import inject, reshard  # noqa: F401
+from ompi_tpu.elastic.context import (  # noqa: F401
+    ElasticContext, ElasticStep, hot_join, is_joiner, recovery_info,
+    spawn_replacement)

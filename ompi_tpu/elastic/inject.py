@@ -1,0 +1,75 @@
+"""elastic/inject — deterministic fault injection for recovery tests.
+
+Real rank death is a SIGKILL mid-step: no shutdown path runs, no
+heartbeat is withdrawn gracefully, the launcher's waitpid and the
+store's staleness promotion are what notice. :func:`maybe_kill`
+reproduces exactly that at a configured (step, world rank), so the
+whole detect -> revoke -> shrink -> re-shard -> resume chain is
+exercised in tier-1 and CI instead of only on real hardware.
+
+:class:`ChaosClient` is the store-RPC side of the harness: a kvstore
+client that adds deterministic latency and/or drops the first N RPCs
+(raising the same ``OSError`` a reset connection would), used by the
+kvstore retry/resilience tests.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+from ompi_tpu.core import cvar, pvar
+from ompi_tpu.runtime import kvstore, rte
+
+_kill_step_var = cvar.register(
+    "elastic_inject_kill_step", -1, int,
+    help="Training step at which the injected rank failure fires "
+         "(-1 disables). Deterministic: the same run always dies at "
+         "the same step.", level=9)
+_kill_rank_var = cvar.register(
+    "elastic_inject_rank", -1, int,
+    help="World rank that SIGKILLs itself at "
+         "elastic_inject_kill_step — no shutdown path runs, exactly "
+         "like a real crash.", level=9)
+
+
+def armed(step: int) -> bool:
+    """True when the injection is configured to fire for THIS process
+    at ``step`` (world-rank match, so the decision is identical on
+    every run of the same config)."""
+    ks = _kill_step_var.get()
+    return ks >= 0 and step == ks and rte.rank == _kill_rank_var.get()
+
+
+def maybe_kill(step: int) -> None:
+    """Die by SIGKILL if the injection is armed for (step, this rank).
+    Called at the top of every elastic step — the failure lands before
+    the step's first collective, so survivors observe it as a peer
+    that never entered."""
+    if not armed(step):
+        return
+    pvar.record("elastic_injected_kills")
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+class ChaosClient(kvstore.Client):
+    """Store client with deterministic RPC chaos: per-RPC latency and
+    drop-the-first-N (an ``OSError``, what a reset TCP connection
+    surfaces as). Tests point a detector or retry loop at this to
+    prove resilience without real network faults."""
+
+    def __init__(self, addr, latency_s: float = 0.0,
+                 drop_first: int = 0) -> None:
+        self.latency_s = float(latency_s)
+        self.drops_left = int(drop_first)
+        super().__init__(addr)
+
+    def _rpc(self, *msg, timeout=None):
+        if self.drops_left > 0:
+            self.drops_left -= 1
+            raise OSError("injected store-RPC drop (elastic chaos "
+                          "shim)")
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        return super()._rpc(*msg, timeout=timeout)

@@ -1,0 +1,99 @@
+"""elastic/reshard — ZeroPlan re-shard arithmetic for shrink/regrow.
+
+The load-bearing invariant (zero/layout.ZeroPlan): bucket composition
+depends ONLY on (metas, bucket_bytes) — the comm size ``n`` changes
+just the pad tail (``padded = ceil(elems/n)*n``) and the per-rank
+shard length. So moving sharded optimizer state between comm sizes is
+pure layout arithmetic, no collective and no disk:
+
+    old chunks (rank order) -> concat -> strip pad to ``elems[b]``
+        -> re-pad for the new n -> slice the new rank's chunk
+
+:func:`full_flats` does the first half from whatever per-old-rank
+chunks survived (a rank's own snapshot, its buddy replica, or the
+global view of a sharded checkpoint); :func:`pack` does the second
+half onto the survivor plan. Both are deterministic in their inputs,
+which is what makes the in-memory path bit-identical to restoring the
+last sharded checkpoint into the shrunken comm (the elastic tier-1
+acceptance check).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ompi_tpu import errors
+from ompi_tpu.zero import layout as _layout
+
+
+def host_chunks(state: _layout.ShardedState) -> List[np.ndarray]:
+    """Host copies of one rank's shard chunks (the unit the buddy ring
+    replicates and snapshots retain — decoupled from the live arrays
+    the optimizer keeps mutating)."""
+    return [np.array(np.asarray(s), copy=True) for s in state.shards]
+
+
+def full_flats(chunks_by_rank: Dict[int, Sequence[np.ndarray]],
+               elems: Sequence[int]) -> List[np.ndarray]:
+    """Old padded-flat buckets rebuilt from per-old-rank chunks,
+    stripped of the pad tail. ``chunks_by_rank`` must cover the full
+    old comm 0..n_old-1 — the caller decides recoverability (and falls
+    back to the checkpoint when a dead rank's chunk has no live
+    owner)."""
+    if not chunks_by_rank:
+        raise errors.MPIError(
+            errors.ERR_INTERN,
+            "elastic reshard: no surviving shard chunks to rebuild "
+            "from")
+    n_old = max(chunks_by_rank) + 1
+    missing = [r for r in range(n_old) if r not in chunks_by_rank]
+    if missing:
+        raise errors.MPIError(
+            errors.ERR_INTERN,
+            f"elastic reshard: old ranks {missing} have no surviving "
+            "shard chunk (recoverability must be checked before "
+            "rebuilding)")
+    flats = []
+    for b, e in enumerate(elems):
+        full = np.concatenate([
+            np.asarray(chunks_by_rank[r][b]) for r in range(n_old)])
+        if full.size < e:
+            raise errors.MPIError(
+                errors.ERR_INTERN,
+                f"elastic reshard: bucket {b} rebuilt {full.size} "
+                f"elements for a {e}-element bucket (chunks from a "
+                "different plan?)")
+        flats.append(full[:e])
+    return flats
+
+
+def pack(plan: _layout.ZeroPlan, template: _layout.ShardedState,
+         flats: Sequence[np.ndarray], rank: int
+         ) -> _layout.ShardedState:
+    """Re-pad stripped bucket flats for ``plan.n`` and slice ``rank``'s
+    chunk — the scatter half of the re-shard. ``template`` supplies
+    metas/treedef (same leaves, so the same bucket composition)."""
+    if len(flats) != len(plan.buckets):
+        raise errors.MPIError(
+            errors.ERR_INTERN,
+            f"elastic reshard: {len(flats)} bucket flats for a "
+            f"{len(plan.buckets)}-bucket plan")
+    shards = []
+    for b, flat in enumerate(flats):
+        flat = np.asarray(flat)
+        if flat.size != plan.elems[b]:
+            raise errors.MPIError(
+                errors.ERR_INTERN,
+                f"elastic reshard: bucket {b} flat has {flat.size} "
+                f"elements, plan expects {plan.elems[b]}")
+        pad = plan.padded[b] - plan.elems[b]
+        if pad:
+            flat = np.pad(flat, (0, pad))
+        k = plan.shard_elems[b]
+        shards.append(np.array(flat[rank * k:(rank + 1) * k],
+                               copy=True))
+    return _layout.ShardedState(plan, template.metas,
+                                template.treedef, shards, rank,
+                                plan.n)

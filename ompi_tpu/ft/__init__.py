@@ -32,6 +32,16 @@ _agree_epochs: Dict[int, int] = {}
 _shrink_epochs: Dict[int, int] = {}
 
 
+def release_comm(cid: int) -> None:
+    """Drop the per-comm agreement/shrink epoch counters when a comm
+    is freed (hooked from ``Communicator.free`` like the coll/xla
+    cache release): cids are reused, and a new comm inheriting a dead
+    comm's epochs would pair its first agree/shrink with a stale
+    store tag."""
+    _agree_epochs.pop(cid, None)
+    _shrink_epochs.pop(cid, None)
+
+
 def _revoke_key(comm) -> str:
     return f"ft:revoked:{rte.jobid}:{comm.cid}"
 

@@ -25,7 +25,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional, Set
 
-from ompi_tpu.core import cvar, output, progress
+from ompi_tpu.core import cvar, output, progress, pvar
 from ompi_tpu.runtime import kvstore, rte
 
 _out = output.stream("ft")
@@ -113,6 +113,7 @@ class Detector:
                 # seq (None while telemetry is off — same 2-tuple
                 # wire message as before)
                 self._client.heartbeat(rte.rank, _flight.hb_payload())
+                pvar.record("ft_heartbeats")
                 self.dead = self._client.faults(self.hb_timeout)
                 epoch = self._client.inc(
                     f"ft:rev_epoch:{rte.jobid}", 0)
@@ -157,26 +158,39 @@ class Detector:
 
     # -- progress-engine applier (MPI thread) ----------------------------
     def _sweep(self) -> int:
-        """Apply new faults/revocations to PML + communicator state."""
-        events = 0
-        new_dead = {r: why for r, why in self.dead.items()
-                    if r not in self._applied_dead}
-        if new_dead:
-            self._applied_dead.update(new_dead)
-            _out.verbose(1, "rank %d: failures detected: %s",
-                         rte.rank, new_dead)
-            from ompi_tpu.core import events as mpit_events
+        """Apply new faults/revocations to PML + communicator state.
 
-            for r, why in new_dead.items():
-                if mpit_events.active("ft_process_failure"):
-                    mpit_events.emit("ft_process_failure", rank=r,
-                                     reason=why)
-            events += self._apply_faults(set(new_dead))
-        new_rev = self.revoked_cids - self._applied_revokes
-        if new_rev:
-            self._applied_revokes |= new_rev
-            events += self._apply_revokes(new_rev)
-        return events
+        Runs on EVERY progress tick (millions/sec in a spin loop), so
+        the no-news path is a pair of length checks — only the
+        eventful path below is counted and timed (``ft_sweep_ns``).
+        Both applied sets grow monotonically out of the observer's
+        snapshots, so length equality IS set equality here."""
+        if (len(self._applied_dead) == len(self.dead)
+                and len(self._applied_revokes)
+                == len(self.revoked_cids)):
+            return 0
+        with pvar.timer("ft_sweep"):
+            events = 0
+            new_dead = {r: why for r, why in self.dead.items()
+                        if r not in self._applied_dead}
+            if new_dead:
+                self._applied_dead.update(new_dead)
+                pvar.record("ft_faults_observed", len(new_dead))
+                _out.verbose(1, "rank %d: failures detected: %s",
+                             rte.rank, new_dead)
+                from ompi_tpu.core import events as mpit_events
+
+                for r, why in new_dead.items():
+                    if mpit_events.active("ft_process_failure"):
+                        mpit_events.emit("ft_process_failure", rank=r,
+                                         reason=why)
+                events += self._apply_faults(set(new_dead))
+            new_rev = self.revoked_cids - self._applied_revokes
+            if new_rev:
+                self._applied_revokes |= new_rev
+                pvar.record("ft_revokes_applied", len(new_rev))
+                events += self._apply_revokes(new_rev)
+            return events
 
     def _apply_faults(self, dead: Set[int]) -> int:
         from ompi_tpu import pml

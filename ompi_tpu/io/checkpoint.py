@@ -122,7 +122,8 @@ def save_sharded(path: str, tree, comm, step: int = 0,
              for s, a in zip(global_shapes, host)]
     header = pickle.dumps(
         {"treedef": _portable_treedef(treedef), "specs": specs,
-         "step": step, "sharded_axis": axis},
+         "step": step, "sharded_axis": axis,
+         "sharded_nranks": comm.size},
         protocol=pickle.HIGHEST_PROTOCOL)
     base = len(_MAGIC) + 8 + len(header)
     fake = [np.empty(s, dtype=a.dtype)
@@ -146,35 +147,63 @@ def save_sharded(path: str, tree, comm, step: int = 0,
         f.Close()
 
 
-def restore(path: str, comm=None) -> Tuple[Any, int]:
+def restore(path: str, comm=None,
+            reshard: bool = False) -> Tuple[Any, int]:
     """Load (tree, step) from `path`. Every rank reads the full
     replicated state (restore of sharded files: pass comm and the
-    original axis split is re-applied by rank)."""
+    original axis split is re-applied by rank). Restoring a sharded
+    file into a comm whose size differs from the save-time split
+    raises ``MPIError(ERR_FILE)`` unless ``reshard=True`` explicitly
+    asks for the re-split (np.array_split semantics) — a silent
+    mis-shard would corrupt state bit-by-bit, not fail. Any malformed
+    input (truncated header, corrupt pickle, short leaf bytes) raises
+    ``MPIError(ERR_FILE)`` naming the path."""
     with open(path, "rb") as fh:
         blob = fh.read()
     if blob[:len(_MAGIC)] != _MAGIC:
         raise errors.MPIError(errors.ERR_FILE,
                               f"{path}: not a checkpoint")
-    (hlen,) = struct.unpack_from("<Q", blob, len(_MAGIC))
-    header = pickle.loads(
-        blob[len(_MAGIC) + 8:len(_MAGIC) + 8 + hlen])
-    base = len(_MAGIC) + 8 + hlen
-    fake = [np.empty(s, dtype=np.dtype(d))
-            for s, d in header["specs"]]
-    layout = _layout(fake, base)
-    leaves = []
-    for (off, nbytes), spec in zip(layout, header["specs"]):
-        shape, dtype = spec
-        arr = np.frombuffer(
-            blob[off:off + nbytes], dtype=np.dtype(dtype)).reshape(shape)
+    try:
+        (hlen,) = struct.unpack_from("<Q", blob, len(_MAGIC))
+        header = pickle.loads(
+            blob[len(_MAGIC) + 8:len(_MAGIC) + 8 + hlen])
         axis = header.get("sharded_axis")
-        if comm is not None and axis is not None:
-            arr = np.array_split(arr, comm.size, axis=axis)[comm.rank]
-        # copy out of the frombuffer view, preserving 0-d shapes
-        # (np.ascontiguousarray promotes 0-d to 1-d)
-        leaves.append(np.ascontiguousarray(arr).reshape(arr.shape))
-    tree = _tree_unflatten(_restore_treedef(header["treedef"]), leaves)
-    return tree, header["step"]
+        nranks = header.get("sharded_nranks")
+        if (comm is not None and axis is not None
+                and nranks is not None and not reshard
+                and int(nranks) != comm.size):
+            raise errors.MPIError(
+                errors.ERR_FILE,
+                f"{path}: sharded for {nranks} ranks, restoring "
+                f"into a size-{comm.size} comm — pass reshard=True "
+                "to re-split explicitly")
+        base = len(_MAGIC) + 8 + hlen
+        fake = [np.empty(s, dtype=np.dtype(d))
+                for s, d in header["specs"]]
+        layout = _layout(fake, base)
+        leaves = []
+        for (off, nbytes), spec in zip(layout, header["specs"]):
+            shape, dtype = spec
+            arr = np.frombuffer(
+                blob[off:off + nbytes],
+                dtype=np.dtype(dtype)).reshape(shape)
+            if comm is not None and axis is not None:
+                arr = np.array_split(arr, comm.size,
+                                     axis=axis)[comm.rank]
+            # copy out of the frombuffer view, preserving 0-d shapes
+            # (np.ascontiguousarray promotes 0-d to 1-d)
+            leaves.append(np.ascontiguousarray(arr).reshape(arr.shape))
+        tree = _tree_unflatten(_restore_treedef(header["treedef"]),
+                               leaves)
+        step = header["step"]
+    except errors.MPIError:
+        raise
+    except (struct.error, pickle.UnpicklingError, EOFError,
+            ValueError, KeyError, TypeError, IndexError) as exc:
+        raise errors.MPIError(
+            errors.ERR_FILE,
+            f"{path}: malformed checkpoint ({exc})") from exc
+    return tree, step
 
 
 class SaveHandle:

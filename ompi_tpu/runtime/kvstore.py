@@ -296,11 +296,49 @@ class Store:
 
 
 class Client:
-    """Client handle to a Store (used by ompi_tpu.runtime.rte)."""
+    """Client handle to a Store (used by ompi_tpu.runtime.rte).
+
+    The initial connect retries with exponential backoff: a
+    hot-joining or spawned rank races store startup/recovery, and a
+    refused first SYN must not kill it. Exhaustion raises
+    ``MPIError(ERR_INTERN)`` (cvars ``kvstore_connect_attempts`` /
+    ``kvstore_connect_backoff``)."""
 
     def __init__(self, addr: Tuple[str, int]) -> None:
+        from ompi_tpu.core import cvar, pvar
+
+        attempts_var = cvar.register(
+            "kvstore_connect_attempts", 5, int,
+            help="Initial store-connect attempts before giving up "
+                 "(spawned/hot-joining ranks race store startup).",
+            level=6)
+        backoff_var = cvar.register(
+            "kvstore_connect_backoff", 0.05, float,
+            help="Base delay in seconds between store-connect "
+                 "attempts; doubles each retry.", level=6)
         self.addr = addr
-        self._sock = socket.create_connection(addr, timeout=60)
+        attempts = max(1, int(attempts_var.get()))
+        delay = max(0.0, float(backoff_var.get()))
+        last: Optional[Exception] = None
+        for i in range(attempts):
+            try:
+                self._sock = socket.create_connection(addr,
+                                                      timeout=60)
+                break
+            except OSError as exc:
+                last = exc
+                if i + 1 >= attempts:
+                    from ompi_tpu import errors
+
+                    raise errors.MPIError(
+                        errors.ERR_INTERN,
+                        f"kvstore: store {addr[0]}:{addr[1]} "
+                        f"unreachable after {attempts} connect "
+                        f"attempts: {exc}") from exc
+                pvar.record("kvstore_connect_retries")
+                time.sleep(delay)
+                delay *= 2
+        del last
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
         # anonymous fence identity (unique per client, never a real

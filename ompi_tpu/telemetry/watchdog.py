@@ -18,6 +18,12 @@ stuck seq: one JSON file (verdict + in-flight table + pvar snapshot +
 trace spans when the recorder is up), a ``telemetry_hang`` MPI-4
 event, the ``telemetry_hangs`` pvar — and, under
 ``telemetry_hang_action=abort``, a job abort after the dump lands.
+
+When the elastic plane reports an in-progress recovery (shrink or
+hot-join regrow), a collective stuck past the timeout is expected
+downtime rather than a hang: the verdict carries
+``kind="recovery"`` with the recovery phase, the dump lands under
+``ompi_tpu_recovery_*`` — and no hang pvar, event, or abort fires.
 """
 
 from __future__ import annotations
@@ -75,13 +81,15 @@ class Watchdog:
                  dead_fn=None, period: Optional[float] = None,
                  timeout: Optional[float] = None,
                  action: Optional[str] = None,
-                 dump_dir: Optional[str] = None) -> None:
+                 dump_dir: Optional[str] = None,
+                 recovery_fn=None) -> None:
         self.rank = rank
         self.jobid = jobid
         self._world = world  # iterable of world ranks; rte's on start
         self._client = client
         self._flight = flight_rec
         self._dead_fn = dead_fn
+        self._recovery_fn = recovery_fn
         self.period = (_period_var.get() if period is None
                        else float(period))
         self.timeout = (_timeout_var.get() if timeout is None
@@ -92,7 +100,10 @@ class Watchdog:
         #: current hang diagnosis (None = healthy); tests and the
         #: dump read the same dict
         self.verdict: Optional[Dict[str, Any]] = None
-        self._dumped: Dict[int, str] = {}  # stuck seq -> dump path
+        # (stuck seq, verdict kind) -> dump path: one dump per seq
+        # per kind, so a recovery that fails into a real hang (or the
+        # reverse) still gets its own diagnosis
+        self._dumped: Dict[Any, str] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -167,6 +178,20 @@ class Watchdog:
                     and self.verdict["seq"] != seq:
                 self.verdict = None  # the stuck op completed
             return self.verdict
+        rec_info = self._recovery()
+        if rec_info is not None:
+            # an elastic recovery legitimately parks this rank (and
+            # its peers) in a collective past the timeout — name the
+            # recovery instead of inventing stragglers
+            self.verdict = {
+                "kind": "recovery", "op": op, "seq": seq,
+                "comm_cid": cid, "nbytes": nbytes,
+                "waited_s": round(waited, 3), "stragglers": [],
+                "recovery": rec_info,
+            }
+            if (seq, "recovery") not in self._dumped:
+                self._dumped[(seq, "recovery")] = self._dump(fl)
+            return self.verdict
         peers = (self._client.telemetry()
                  if self._client is not None else {})
         entered = {r: int(p.get("seq", 0))
@@ -188,9 +213,23 @@ class Watchdog:
             "waited_s": round(waited, 3), "stragglers": stragglers,
             "peer_seqs": entered, "dead": dict(dead),
         }
-        if seq not in self._dumped:
-            self._dumped[seq] = self._dump(fl)
+        if (seq, "hang") not in self._dumped:
+            self._dumped[(seq, "hang")] = self._dump(fl)
         return self.verdict
+
+    def _recovery(self) -> Optional[Dict[str, Any]]:
+        """The elastic recovery in progress on this rank, if any
+        (injectable for tests; default: the elastic plane's
+        process-wide recovery_info)."""
+        if self._recovery_fn is not None:
+            return self._recovery_fn()
+        try:
+            from ompi_tpu import elastic
+
+            return elastic.recovery_info()
+        except Exception:  # noqa: BLE001 — diagnosis must never
+            # become the failure
+            return None
 
     def _dead(self) -> Dict[int, str]:
         """Failed ranks: the ft detector's live snapshot when it runs,
@@ -257,9 +296,11 @@ class Watchdog:
             os.makedirs(d, exist_ok=True)
         except OSError:
             d = "."
+        kind = v.get("kind", "hang")
+        prefix = ("ompi_tpu_recovery" if kind == "recovery"
+                  else "ompi_tpu_hang")
         path = os.path.join(
-            d, "ompi_tpu_hang_rank%d_seq%d.json" % (self.rank,
-                                                    v["seq"]))
+            d, "%s_rank%d_seq%d.json" % (prefix, self.rank, v["seq"]))
         tmp = "%s.tmp.%d" % (path, os.getpid())
         try:
             with open(tmp, "w") as fh:
@@ -268,6 +309,16 @@ class Watchdog:
         except OSError as exc:
             _out.verbose(0, "hang dump write failed: %r", exc)
             path = ""
+        if kind == "recovery":
+            # an in-progress elastic recovery is expected downtime:
+            # record the diagnosis but fire no hang pvar/event/abort
+            rec = v.get("recovery") or {}
+            _out.verbose(0, "RECOVERY: %s seq %d waited %.1fs — "
+                         "elastic %s at phase %s in progress -> %s",
+                         v["op"], v["seq"], v["waited_s"],
+                         rec.get("kind", "?"), rec.get("phase", "?"),
+                         path or "(dump failed)")
+            return path
         pvar.record("telemetry_hangs")
         _out.verbose(0, "HANG: %s seq %d stuck %.1fs phase=%s, "
                      "stragglers %s -> %s", v["op"], v["seq"],

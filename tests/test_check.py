@@ -414,7 +414,7 @@ def test_hang_dump_carries_check_mismatch(tmp_path, monkeypatch):
                   action="dump", dump_dir=str(tmp_path))
     v = wd.sweep()
     assert v is not None and v["seq"] == 1
-    doc = json.load(open(wd._dumped[1]))
+    doc = json.load(open(wd._dumped[(1, "hang")]))
     assert doc["check_mismatch"]["op"] == "Allreduce"
     assert doc["check_mismatch"]["seq"] == 3
     flight.disable()

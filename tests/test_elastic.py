@@ -1,0 +1,501 @@
+"""Elastic training — rank-failure shrink/regrow + in-memory re-shard.
+
+The acceptance contract: after an injected SIGKILL the survivors
+revoke, shrink, decide a resume step by agree, re-shard the ZeRO
+optimizer state IN MEMORY from surviving chunks (own snapshot + buddy
+replica), and the post-recovery trajectory is BITWISE identical
+(deterministic='linear') to restoring the last sharded checkpoint into
+the shrunken comm; a hot-joining replacement reaches parameter parity
+before its first contributing step; the fault injection is
+deterministic; recovery is observable (elastic_* pvars, the watchdog's
+recovery verdict instead of a false hang); and the satellites hold
+(ft epoch hygiene on Comm.free, ERR_FILE on malformed checkpoints,
+bounded kvstore connect retry).
+"""
+
+import hashlib
+import json
+import os
+import socket
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from tests.harness import run_ranks
+
+FT = {"ft": "1"}
+
+
+# -- reshard arithmetic (pure, no comm) ----------------------------------
+
+def _tree():
+    rng = np.random.default_rng(3)
+    return {"w": rng.standard_normal((13, 5)).astype(np.float32),
+            "b": rng.standard_normal(11).astype(np.float32),
+            "i": np.arange(9, dtype=np.int32)}
+
+
+def test_reshard_roundtrip_is_pure_layout_arithmetic():
+    """n changes only the pad tail: full_flats(old chunks) recovers
+    the exact bucket flats, and pack() onto a different n bit-matches
+    slicing the replicated tree directly (from_full)."""
+    import jax
+
+    from ompi_tpu.elastic import reshard
+    from ompi_tpu.zero import layout as zl
+
+    tree = _tree()
+    leaves = jax.tree.leaves(tree)
+    p3 = zl.plan_for(leaves, 3)
+    p2 = zl.plan_for(leaves, 2)
+    assert p3.buckets == p2.buckets and p3.elems == p2.elems
+    olds = [zl.ShardedState.from_full(
+        SimpleNamespace(rank=r, size=3), tree) for r in range(3)]
+    chunks = {r: reshard.host_chunks(olds[r]) for r in range(3)}
+    flats = reshard.full_flats(chunks, p3.elems)
+    for b, idxs in enumerate(p3.buckets):
+        ref = (np.concatenate([np.reshape(leaves[i], (-1,))
+                               for i in idxs]) if len(idxs) > 1
+               else np.reshape(leaves[idxs[0]], (-1,)))
+        np.testing.assert_array_equal(flats[b], ref)
+    for r in range(2):
+        tmpl = zl.ShardedState.from_full(
+            SimpleNamespace(rank=r, size=2), tree)
+        packed = reshard.pack(p2, tmpl, flats, r)
+        assert packed.rank == r and packed.n == 2
+        for a, b in zip(packed.shards, tmpl.shards):
+            np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_reshard_rejects_incomplete_or_mismatched_chunks():
+    import jax
+
+    from ompi_tpu import errors
+    from ompi_tpu.elastic import reshard
+    from ompi_tpu.zero import layout as zl
+
+    tree = _tree()
+    leaves = jax.tree.leaves(tree)
+    p3 = zl.plan_for(leaves, 3)
+    olds = [zl.ShardedState.from_full(
+        SimpleNamespace(rank=r, size=3), tree) for r in range(3)]
+    chunks = {r: reshard.host_chunks(olds[r]) for r in range(3)}
+    with pytest.raises(errors.MPIError) as ei:
+        reshard.full_flats({}, p3.elems)
+    assert ei.value.error_class == errors.ERR_INTERN
+    with pytest.raises(errors.MPIError) as ei:
+        reshard.full_flats({0: chunks[0], 2: chunks[2]}, p3.elems)
+    assert "ranks [1]" in str(ei.value)
+    flats = reshard.full_flats(chunks, p3.elems)
+    tmpl = zl.ShardedState.from_full(
+        SimpleNamespace(rank=0, size=2), tree)
+    p2 = zl.plan_for(leaves, 2)
+    with pytest.raises(errors.MPIError):
+        reshard.pack(p2, tmpl, flats[:-1], 0)  # bucket count
+    with pytest.raises(errors.MPIError):
+        reshard.pack(p2, tmpl, [f[:-1] for f in flats], 0)  # sizes
+
+
+# -- deterministic fault injection ---------------------------------------
+
+def test_inject_armed_is_rank_and_step_exact():
+    from ompi_tpu.elastic import inject
+    from ompi_tpu.runtime import rte
+
+    ks, kr = inject._kill_step_var.get(), inject._kill_rank_var.get()
+    try:
+        inject._kill_step_var.set(4)
+        inject._kill_rank_var.set(rte.rank)
+        assert inject.armed(4)
+        assert not inject.armed(3) and not inject.armed(5)
+        inject._kill_rank_var.set(rte.rank + 1)
+        assert not inject.armed(4)
+        inject._kill_step_var.set(-1)
+        assert not inject.armed(0)
+    finally:
+        inject._kill_step_var.set(ks)
+        inject._kill_rank_var.set(kr)
+
+
+# -- the tentpole: kill -> shrink -> in-memory re-shard ------------------
+
+def test_kill_shrink_memory_reshard_bitmatches_checkpoint_restore():
+    """Rank 2 SIGKILLs at step 3; survivors recover IN MEMORY (resume
+    step 2 via agree, dead rank's chunks from its buddy) and finish.
+    A second context restored from the step-2 checkpoint replays the
+    same steps — params AND momentum shards must be bit-identical."""
+    run_ranks("""
+        import os, tempfile
+        from ompi_tpu import elastic
+        from ompi_tpu.core import pvar
+        from ompi_tpu.elastic import inject
+        from ompi_tpu.runtime import rte
+
+        d = os.path.join(tempfile.gettempdir(),
+                         "elastic_bitid_" + rte.jobid)
+        params = {"w": np.arange(12, dtype=np.float32)
+                       .reshape(3, 4) / 7.0,
+                  "b": np.linspace(-1.0, 1.0, 5).astype(np.float32)}
+
+        def grad_fn(p, step, c):
+            import jax
+            return jax.tree.map(
+                lambda a: 0.01 * a
+                + np.full_like(a, 0.125 * (step + 1)), p)
+
+        inject._kill_step_var.set(3)
+        inject._kill_rank_var.set(2)
+        ctx = elastic.ElasticContext(comm, params, lr=0.125,
+                                     momentum=0.5,
+                                     checkpoint_dir=d)
+        ctx.run(grad_fn, 3)           # steps 0..2, everyone alive
+        ctx.save_checkpoint()         # sharded snapshot at step 2
+        out = ctx.run(grad_fn, 6)     # rank 2 dies entering step 3
+        assert ctx.comm.size == 2, ctx.comm.size
+        assert ctx.shrinks == 1 and ctx.step_done == 5
+        assert ctx.last_resume == 2, ctx.last_resume
+        assert ctx.restored_from == "memory", ctx.restored_from
+        snap = pvar.snapshot()
+        assert snap.get("elastic_shrinks", 0) >= 1
+        assert snap.get("elastic_recovery_ns", 0) > 0
+        assert snap.get("elastic_reshard_bytes", 0) > 0
+        assert snap.get("elastic_injected_kills", 0) == 0  # survivors
+        # reference: restore the step-2 checkpoint into the SHRUNKEN
+        # comm and replay the same steps
+        ref = elastic.ElasticContext.from_checkpoint(
+            ctx.comm, d, lr=0.125, momentum=0.5)
+        assert ref.step_done == 2 and ref.restored_from == "checkpoint"
+        ref_out = ref.run(grad_fn, 6)
+        import jax
+        for a, b in zip(jax.tree.leaves(out),
+                        jax.tree.leaves(ref_out)):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b))
+        for name, st in ctx.opt.state.slots.items():
+            for a, b in zip(st.shards,
+                            ref.opt.state.slots[name].shards):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+        if ctx.comm.rank == 0:
+            import shutil
+            shutil.rmtree(d, ignore_errors=True)
+    """, 3, mca=FT, timeout=90)
+
+
+def test_adjacent_double_failure_falls_back_to_checkpoint():
+    """Ranks 1 and 2 die in the same step: rank 1's chunk has no live
+    owner (its buddy died too), so recovery restores the last sharded
+    checkpoint — which checkpoint_every=1 keeps at the resume step —
+    and the lone survivor finishes the run."""
+    run_ranks("""
+        import os, signal, tempfile
+        from ompi_tpu import elastic
+        from ompi_tpu.core import pvar
+        from ompi_tpu.runtime import rte
+
+        d = os.path.join(tempfile.gettempdir(),
+                         "elastic_fb_" + rte.jobid)
+        params = {"w": np.arange(10, dtype=np.float32) / 3.0}
+
+        def grad_fn(p, step, c):
+            import jax
+            if step == 2 and rank in (1, 2):
+                os.kill(os.getpid(), signal.SIGKILL)
+            return jax.tree.map(
+                lambda a: np.full_like(a, 0.25 * (step + 1)), p)
+
+        ctx = elastic.ElasticContext(comm, params, lr=0.1,
+                                     momentum=0.9,
+                                     checkpoint_dir=d,
+                                     checkpoint_every=1)
+        ctx.run(grad_fn, 4)
+        assert ctx.comm.size == 1, ctx.comm.size
+        assert ctx.shrinks >= 1 and ctx.step_done == 3
+        assert ctx.restored_from == "checkpoint", ctx.restored_from
+        assert pvar.snapshot().get("elastic_fallback_restores", 0) >= 1
+        import shutil
+        shutil.rmtree(d, ignore_errors=True)
+    """, 3, mca=FT, timeout=90)
+
+
+# -- hot-join: spawn a replacement, regrow at a step boundary ------------
+
+def test_hot_join_regrows_with_parameter_parity():
+    """Rank 0 spawns a replacement; the 2-rank job regrows to 3 at the
+    step-3 boundary. Parameter digests agree across all members BEFORE
+    the joiner's first contributing step and at the end."""
+    run_ranks("""
+        import hashlib
+        from ompi_tpu import elastic
+        from ompi_tpu.core import pvar
+
+        def digest(tree):
+            import jax
+            h = hashlib.sha256()
+            for leaf in jax.tree.leaves(tree):
+                h.update(np.ascontiguousarray(
+                    np.asarray(leaf)).tobytes())
+            return h.hexdigest()
+
+        params = {"w": np.arange(10, dtype=np.float32) / 3.0,
+                  "b": np.ones(7, dtype=np.float32)}
+
+        def grad_fn(p, step, c):
+            import jax
+            if step == 3:
+                # first post-regrow step: every member (joiner
+                # included) must already hold identical params
+                ds = c.allgather(digest(p))
+                assert len(set(ds)) == 1, ds
+                assert c.size == 3, c.size
+            return jax.tree.map(
+                lambda a: np.full_like(a, 0.25 * (step + 1)), p)
+
+        proc = None
+        if elastic.is_joiner():
+            ctx, target = elastic.hot_join()
+            assert ctx.joins == 1 and target == 6
+            out = ctx.run(grad_fn, target)
+        else:
+            ctx = elastic.ElasticContext(comm, params, lr=0.1,
+                                         momentum=0.75)
+            if rank == 0:
+                proc = elastic.spawn_replacement(mca={"ft": "1"})
+            out = ctx.run(grad_fn, 6, join_at=3)
+            assert ctx.comm.size == 3 and ctx.joins == 1
+            assert pvar.snapshot().get("elastic_hot_joins", 0) == 1
+        ds = ctx.comm.allgather(digest(out))
+        assert len(set(ds)) == 1, ds
+        assert ctx.step_done == 5
+        if proc is not None:  # reap AFTER the last collective the
+            # joiner participates in, or the wait deadlocks it
+            assert proc.wait(timeout=60) == 0
+    """, 2, mca=FT, timeout=120)
+
+
+# -- satellite: ft epoch hygiene on Comm.free ----------------------------
+
+def test_comm_free_releases_ft_epochs():
+    run_ranks("""
+        from ompi_tpu import ft
+        c = comm.dup()
+        c.agree(1)
+        assert c.cid in ft._agree_epochs
+        ft._shrink_epochs[c.cid] = 1        # simulate a past shrink
+        cid = c.cid
+        c.free()
+        assert cid not in ft._agree_epochs
+        assert cid not in ft._shrink_epochs
+    """, 2, mca=FT, timeout=90)
+
+
+# -- satellite: checkpoint restore hardening -----------------------------
+
+def test_restore_rejects_malformed_files(tmp_path):
+    from ompi_tpu import errors
+    from ompi_tpu.io import checkpoint
+
+    bad = tmp_path / "bad.ck"
+    bad.write_bytes(b"not a checkpoint at all" * 4)
+    with pytest.raises(errors.MPIError) as ei:
+        checkpoint.restore(str(bad))
+    assert ei.value.error_class == errors.ERR_FILE
+
+    good = tmp_path / "good.ck"
+    checkpoint.save(str(good),
+                    {"w": np.arange(64, dtype=np.float32)}, step=7)
+    blob = good.read_bytes()
+    torn = tmp_path / "torn.ck"
+    torn.write_bytes(blob[:len(blob) // 2])
+    with pytest.raises(errors.MPIError) as ei:
+        checkpoint.restore(str(torn))
+    assert ei.value.error_class == errors.ERR_FILE
+    assert "malformed" in str(ei.value)
+
+    lying = tmp_path / "lying.ck"
+    import struct
+
+    lying.write_bytes(b"OTCKPT\x00\x01"
+                      + struct.pack("<Q", 10 ** 6) + b"xx")
+    with pytest.raises(errors.MPIError) as ei:
+        checkpoint.restore(str(lying))
+    assert ei.value.error_class == errors.ERR_FILE
+
+
+def test_sharded_restore_guards_rank_count_mismatch():
+    """A sharded file restored into a different-size comm raises
+    ERR_FILE unless reshard=True asks for the re-split explicitly;
+    comm=None (the global view) is never guarded."""
+    run_ranks("""
+        import os, tempfile
+        from types import SimpleNamespace
+        from ompi_tpu import errors
+        from ompi_tpu.io import checkpoint
+        from ompi_tpu.runtime import rte
+
+        d = os.path.join(tempfile.gettempdir(),
+                         "elastic_szg_" + rte.jobid)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, "s.ck")
+        tree = {"m:0": np.arange(6, dtype=np.float32) + rank}
+        checkpoint.save_sharded(path, tree, comm, step=4)
+        t2, s2 = checkpoint.restore(path, comm=comm)
+        assert s2 == 4
+        np.testing.assert_array_equal(t2["m:0"], tree["m:0"])
+        fake = SimpleNamespace(rank=0, size=3)
+        try:
+            checkpoint.restore(path, comm=fake)
+            raise AssertionError("rank-count mismatch accepted")
+        except errors.MPIError as exc:
+            assert exc.error_class == errors.ERR_FILE
+            assert "reshard=True" in str(exc)
+        t3, _ = checkpoint.restore(path, comm=fake, reshard=True)
+        g, _ = checkpoint.restore(path)          # global view
+        assert g["m:0"].size == 12
+        np.testing.assert_array_equal(
+            t3["m:0"], np.array_split(g["m:0"], 3)[0])
+        if rank == 0:
+            import shutil
+            shutil.rmtree(d, ignore_errors=True)
+        comm.Barrier()
+    """, 2)
+
+
+# -- satellite: kvstore bounded connect retry ----------------------------
+
+def _vars():
+    from ompi_tpu.core import cvar
+
+    return (cvar.register("kvstore_connect_attempts", 5, int),
+            cvar.register("kvstore_connect_backoff", 0.05, float))
+
+
+def test_kvstore_connect_retries_then_err_intern():
+    from ompi_tpu import errors
+    from ompi_tpu.core import pvar
+    from ompi_tpu.runtime import kvstore
+
+    # a port with no listener: bind, read it back, close
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    addr = s.getsockname()
+    s.close()
+    attempts_var, backoff_var = _vars()
+    a0, b0 = attempts_var.get(), backoff_var.get()
+    before = pvar.snapshot().get("kvstore_connect_retries", 0)
+    try:
+        attempts_var.set(3)
+        backoff_var.set(0.01)
+        with pytest.raises(errors.MPIError) as ei:
+            kvstore.Client(addr)
+        assert ei.value.error_class == errors.ERR_INTERN
+        assert "3 connect attempts" in str(ei.value)
+        after = pvar.snapshot().get("kvstore_connect_retries", 0)
+        assert after - before == 2          # attempts - 1 retries
+    finally:
+        attempts_var.set(a0)
+        backoff_var.set(b0)
+
+
+def test_kvstore_connect_survives_late_store_start():
+    from ompi_tpu.runtime import kvstore
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    addr = s.getsockname()
+    s.close()
+    attempts_var, backoff_var = _vars()
+    a0, b0 = attempts_var.get(), backoff_var.get()
+    store_box = {}
+
+    def late_start():
+        time.sleep(0.3)
+        store_box["store"] = kvstore.Store(
+            host=addr[0], port=addr[1]).start()
+
+    t = threading.Thread(target=late_start, daemon=True)
+    try:
+        attempts_var.set(8)
+        backoff_var.set(0.05)
+        t.start()
+        c = kvstore.Client(addr)           # races the store up
+        c.put("k", "v")
+        assert c.get("k") == "v"
+        c.close()
+    finally:
+        t.join()
+        attempts_var.set(a0)
+        backoff_var.set(b0)
+        if "store" in store_box:
+            store_box["store"].stop()
+
+
+def test_chaos_client_drops_then_recovers():
+    from ompi_tpu.elastic import inject
+    from ompi_tpu.runtime import kvstore
+
+    store = kvstore.Store().start()
+    try:
+        c = inject.ChaosClient(store.addr, latency_s=0.02,
+                               drop_first=2)
+        for _ in range(2):
+            with pytest.raises(OSError):
+                c.put("x", 1)
+        t0 = time.monotonic()
+        c.put("x", 2)
+        assert time.monotonic() - t0 >= 0.02
+        assert c.get("x") == 2
+        c.close()
+    finally:
+        store.stop()
+
+
+# -- observability: watchdog names recovery, not a false hang ------------
+
+def test_watchdog_reports_recovery_instead_of_hang(tmp_path):
+    from ompi_tpu.core import pvar
+    from ompi_tpu.telemetry import flight, watchdog
+
+    fl = flight.FlightRecorder()
+    fl.enter("allgather_obj", comm_cid=5, nbytes=64)
+    rec = {"kind": "shrink", "phase": "reshard", "step": 4,
+           "failed_comm_ranks": [2]}
+    box = {"rec": rec}
+    wd = watchdog.Watchdog(
+        rank=0, jobid="je", world=[0, 1], client=None,
+        flight_rec=fl, dead_fn=lambda: {},
+        recovery_fn=lambda: box["rec"], period=3600, timeout=0.0,
+        action="abort",  # must NOT fire for a recovery verdict
+        dump_dir=str(tmp_path))
+    before = pvar.snapshot().get("telemetry_hangs", 0)
+    v = wd.sweep()
+    assert v["kind"] == "recovery"
+    assert v["stragglers"] == [] and v["recovery"]["phase"] == "reshard"
+    path = wd._dumped[(1, "recovery")]
+    assert "ompi_tpu_recovery_rank0" in path
+    doc = json.load(open(path))
+    assert doc["verdict"]["recovery"]["kind"] == "shrink"
+    assert pvar.snapshot().get("telemetry_hangs", 0) == before
+    # dump fires once per (seq, kind); recovery ending while the op is
+    # STILL stuck escalates to a real hang verdict with its own dump
+    wd.sweep()
+    assert list(wd._dumped) == [(1, "recovery")]
+    box["rec"] = None
+    wd.action = "dump"
+    v2 = wd.sweep()
+    assert "kind" not in v2 and (1, "hang") in wd._dumped
+
+
+def test_elastic_pvars_are_well_known():
+    from ompi_tpu.core import pvar
+
+    for name in ("elastic_shrinks", "elastic_hot_joins",
+                 "elastic_reshard_bytes", "elastic_recovery_ns",
+                 "elastic_fallback_restores", "elastic_checkpoints",
+                 "elastic_injected_kills", "ft_heartbeats",
+                 "ft_faults_observed", "ft_revokes_applied",
+                 "ft_sweep_ns", "kvstore_connect_retries"):
+        assert name in pvar.WELL_KNOWN, name

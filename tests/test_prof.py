@@ -257,7 +257,7 @@ def test_watchdog_dump_carries_current_phase(tmp_path, no_prof):
     with ledger.phase("staging"):
         v = wd.sweep()
     assert v is not None and v["stragglers"] == [1]
-    doc = json.load(open(wd._dumped[2]))
+    doc = json.load(open(wd._dumped[(2, "hang")]))
     assert doc["phase"] == "staging"
 
 

@@ -360,7 +360,7 @@ def test_watchdog_names_straggler_and_dumps(tmp_path, no_flight):
     assert v["peer_seqs"] == {0: 2, 1: 1}
     # every sweep publishes this rank's seq on the heartbeat plane
     assert client.beats == [(0, {"seq": 2, "done": 1, "inflight": 1})]
-    path = wd._dumped[2]
+    path = wd._dumped[(2, "hang")]
     doc = json.load(open(path))
     assert doc["schema"] == watchdog.DUMP_SCHEMA
     assert doc["verdict"]["stragglers"] == [1]
@@ -368,7 +368,7 @@ def test_watchdog_names_straggler_and_dumps(tmp_path, no_flight):
     assert "telemetry_watchdog_sweeps" in doc["pvars"]
     # dump-on-hang fires exactly once per stuck seq
     wd.sweep()
-    assert list(wd._dumped) == [2]
+    assert list(wd._dumped) == [(2, "hang")]
     # the op completing clears the verdict
     fl.exit(2)
     assert wd.sweep() is None and wd.verdict is None
