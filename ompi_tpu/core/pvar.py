@@ -124,6 +124,11 @@ WELL_KNOWN = (
     # cross-rank fingerprint exchanges performed at level 2
     "check_violations", "check_leaks", "check_sig_exchanges",
     "memchecker_violations",
+    # check/ plane (static lint engine): files linted per run, files
+    # served from the incremental cache, and CFG paths enumerated by
+    # the path-sensitive lifecycle/divergence rules
+    "check_lint_files", "check_lint_cached_files",
+    "check_lint_cfg_paths",
     # every remaining literal recorded anywhere in the framework —
     # the check plane's unregistered-pvar lint rule enforces that
     # this tuple stays the single source of truth, so tools/info and

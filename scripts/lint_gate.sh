@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# The CI lint gate over the whole-program analysis engine:
+#   1. cold run with the incremental cache + SARIF export — the tree
+#      must lint clean (exit 0), and the run must fit the timing
+#      budget (a full-tree lint is a pre-commit-grade tool; if it
+#      cannot finish in 30s on CI it will be skipped locally);
+#   2. warm re-run against the same cache — every file must be served
+#      from the cache (the incremental path is what developers live
+#      on, so CI proves it stays correct AND effective).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${1:-lint_gate_out}"
+budget="${LINT_BUDGET_SECONDS:-30}"
+rm -rf "$out"
+mkdir -p "$out"
+
+echo "== lint gate: cold run (cache + SARIF) =="
+start=$(date +%s)
+JAX_PLATFORMS=cpu python -m ompi_tpu.check lint ompi_tpu examples \
+  --cache "$out/lint_cache.json" \
+  --sarif "$out/lint.sarif" 2> "$out/cold.log"
+cat "$out/cold.log" >&2
+elapsed=$(( $(date +%s) - start ))
+echo "cold run: ${elapsed}s (budget ${budget}s)"
+if [ "$elapsed" -gt "$budget" ]; then
+  echo "lint gate: cold full-tree lint took ${elapsed}s > ${budget}s budget" >&2
+  exit 1
+fi
+
+echo "== lint gate: warm run (cache effectiveness) =="
+JAX_PLATFORMS=cpu python -m ompi_tpu.check lint ompi_tpu examples \
+  --cache "$out/lint_cache.json" 2> "$out/warm.log"
+cat "$out/warm.log" >&2
+
+# "N/N file(s) from cache" with N == N: all files reused
+python - "$out" <<'EOF'
+import json
+import re
+import sys
+
+out = sys.argv[1]
+warm = open(out + "/warm.log").read()
+m = re.search(r"(\d+)/(\d+) file\(s\) from cache", warm)
+assert m, f"no cache counters in warm-run summary:\n{warm}"
+cached, total = int(m.group(1)), int(m.group(2))
+assert total > 0 and cached == total, (
+    f"warm run reused {cached}/{total} files — the incremental "
+    "cache is not effective")
+doc = json.load(open(out + "/lint.sarif"))
+assert doc["version"] == "2.1.0", doc["version"]
+run = doc["runs"][0]
+assert run["tool"]["driver"]["rules"], "empty SARIF rule catalog"
+bad = [r for r in run["results"] if not r.get("suppressions")]
+assert not bad, f"unsuppressed findings leaked into SARIF: {bad}"
+print(f"lint gate OK: clean tree, {cached}/{total} files from cache "
+      f"on the warm run, SARIF 2.1.0 with "
+      f"{len(run['tool']['driver']['rules'])} rules")
+EOF

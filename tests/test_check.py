@@ -80,12 +80,15 @@ def test_pready_after_start_negative():
 
 
 def test_rank_divergent_collective_positive():
+    # superseded lexical rule's fixture, now caught (with both paths
+    # named) by the CFG-based collective-order-divergence rule
     fs = _lint("""
         def f(comm, x):
             if comm.rank == 0:
                 comm.bcast(x)
-    """, rule="rank-divergent-collective")
+    """, rule="collective-order-divergence")
     assert len(fs) == 1 and "comm.rank" in fs[0].message
+    assert "bcast" in fs[0].message and "deadlock" in fs[0].message
 
 
 def test_rank_divergent_negative_other_comms_rank():
@@ -95,7 +98,7 @@ def test_rank_divergent_negative_other_comms_rank():
         def f(comm, other, x):
             if other.rank == 0:
                 comm.bcast(x)
-    """, rule="rank-divergent-collective") == []
+    """, rule="collective-order-divergence") == []
 
 
 def test_buffer_reuse_before_wait_positive():
