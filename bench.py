@@ -608,6 +608,106 @@ def _bench_ingest():
     }
 
 
+def _bench_ckpt():
+    """Async checkpoint plane card (``--ckpt``): snapshot overhead as
+    a % of the train phase, plus restore-to-step-1 wall. Arm A runs N
+    jitted train steps bare; arm B runs the same N steps taking an
+    overlapped snapshot every step (begin at the boundary, d2h rides
+    alongside the next step, commit at the following boundary — the
+    AsyncCheckpointer contract). Overhead is (B - A) / A; the
+    ``overlap_s`` line is the prof ledger's snapshot||train proof.
+    Restore timing covers manifest scan + digest verify + rebuild +
+    the ingest-gated upload of the first leaf (the "step 1 can start"
+    moment) and the full-tree wait."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu.core import pvar
+    from ompi_tpu.ingest import engine as ingest_engine
+    from ompi_tpu.io.async_ckpt import AsyncCheckpointer
+    from ompi_tpu.prof import ledger as prof_ledger
+
+    nleaves, leaf_elems, steps = 8, 1 << 19, 6  # 8 x 2 MB f32
+    rng = np.random.default_rng(13)
+    tree = {f"w{i}": jnp.asarray(
+        rng.standard_normal(leaf_elems).astype(np.float32))
+        for i in range(nleaves)}
+    total_bytes = nleaves * leaf_elems * 4
+
+    step_fn = jax.jit(lambda t: jax.tree.map(
+        lambda x: x * 0.999 + jnp.tanh(x) * 1e-3, t))
+    tree = jax.block_until_ready(step_fn(tree))  # compile outside
+
+    # arm A: bare train steps
+    t0 = time.perf_counter()
+    cur = tree
+    for _ in range(steps):
+        cur = jax.block_until_ready(step_fn(cur))
+    bare_s = time.perf_counter() - t0
+
+    # arm B: same steps, one overlapped snapshot per boundary
+    ckdir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    sess = pvar.session()
+    try:
+        ck = AsyncCheckpointer(ckdir, retain=2)
+        cur, pending, last_src = tree, None, tree
+        t0 = time.perf_counter()
+        for s in range(steps):
+            if pending is not None:
+                ck.commit(pending)
+            last_src = cur
+            pending = ck.begin(cur, s)
+            # the step the d2h thread overlaps — under the train
+            # phase so prof_phase_overlap_ns accrues snapshot||train
+            with prof_ledger.phase("train"):
+                cur = jax.block_until_ready(step_fn(cur))
+        if pending is not None:
+            ck.commit(pending)
+        ckpt_s = time.perf_counter() - t0
+        overhead_pct = (ckpt_s - bare_s) / max(bare_s, 1e-9) * 100.0
+
+        # restore-to-step-1: scan + verify + rebuild + gated upload
+        eng = ingest_engine.IngestEngine()
+        try:
+            t0 = time.perf_counter()
+            got_tree, got_step, _ = ck.restore()
+            req = ingest_engine.upload_for_restore(
+                got_tree, keys=["w0"], engine=eng)
+            step1_s = time.perf_counter() - t0
+            req.wait()
+            full_s = time.perf_counter() - t0
+        finally:
+            eng.close()
+        # restored tree must be bit-identical to the final snapshot's
+        # source (the last begin() captured the state entering the
+        # last step — that's the newest committed epoch)
+        identical = (sorted(got_tree) == sorted(last_src) and all(
+            np.array_equal(np.asarray(got_tree[k]),
+                           np.asarray(last_src[k]))
+            for k in last_src))
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
+    return {
+        "bare_train_s": round(bare_s, 3),
+        "ckpt_train_s": round(ckpt_s, 3),
+        "ckpt_overhead_pct": round(overhead_pct, 2),
+        "snapshot_bytes": total_bytes,
+        "snapshots": steps,
+        "overlap_s": round(
+            sess.read("prof_phase_overlap_ns") / 1e9, 3),
+        "d2h_s": round(sess.read("ckpt_d2h_ns") / 1e9, 3),
+        "write_s": round(sess.read("ckpt_write_ns") / 1e9, 3),
+        "restore_step1_s": round(step1_s, 3),
+        "restore_full_s": round(full_s, 3),
+        "restored_step": int(got_step),
+        "tree_ok": bool(identical),
+    }
+
+
 def _bench_pallas():
     """coll/pallas switchpoint card (``--pallas``): the hand-rolled
     ring / bidir / linear allreduce kernels raced against the XLA
@@ -731,6 +831,8 @@ _EXTRA_BASELINE_KEYS = (
     ("ingest", "streamed_cold_s", False),
     ("ingest", "cold_start_speedup", True),
     ("ingest", "ingest_h2d_GBs", True),
+    ("ckpt", "ckpt_overhead_pct", False),
+    ("ckpt", "restore_step1_s", False),
     ("pallas", "best_speedup_vs_xla", True),
 )
 
@@ -859,6 +961,13 @@ def main() -> None:
             _phase("ingest microbench done")
         except Exception as e:
             _phase(f"ingest microbench skipped: {e!r}")
+    ckpt = None
+    if "--ckpt" in sys.argv:
+        try:
+            ckpt = _bench_ckpt()
+            _phase("ckpt microbench done")
+        except Exception as e:
+            _phase(f"ckpt microbench skipped: {e!r}")
     pallas = None
     if "--pallas" in sys.argv:
         try:
@@ -904,6 +1013,7 @@ def main() -> None:
                                    "overlap": overlap,
                                    "zero": zero,
                                    "ingest": ingest,
+                                   "ckpt": ckpt,
                                    "pallas": pallas})
         except Exception:
             pass
@@ -948,6 +1058,7 @@ def main() -> None:
             "monitoring": monitoring,
             "zero": zero,
             "ingest": ingest,
+            "ckpt": ckpt,
             "pallas": pallas,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),

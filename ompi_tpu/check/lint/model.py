@@ -138,9 +138,10 @@ GUARD_GLOBALS = frozenset(("FLIGHT", "RECORDER", "SANITIZER",
                            "TRAFFIC", "INGEST"))
 
 #: path components marking the MPI-convention public API surface for
-#: bare-public-raise (coll/, osc/, shmem/, part/, ingest/, elastic/)
+#: bare-public-raise (coll/, osc/, shmem/, part/, ingest/, elastic/,
+#: io/)
 PUBLIC_API_DIRS = frozenset(("coll", "osc", "shmem", "part",
-                             "ingest", "elastic"))
+                             "ingest", "elastic", "io"))
 
 
 # -- shared walking helpers ----------------------------------------------

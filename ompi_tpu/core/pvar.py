@@ -68,6 +68,10 @@ WELL_KNOWN = (
     # compilation cache hit/miss accounting (compile_cache_dir cvar)
     "prof_phase_staging_ns", "prof_phase_compile_ns",
     "prof_phase_train_ns", "prof_phase_teardown_ns",
+    # the async checkpoint plane's d2h thread runs under "snapshot";
+    # snapshot || train overlap accrues into prof_phase_overlap_ns
+    # (the proof the ckpt smoke lane asserts on)
+    "prof_phase_snapshot_ns",
     # cross-thread phase overlap (ingest: staging || compile run
     # concurrently, so per-phase walls may sum past the job wall —
     # this counter quantifies the legitimately-double-counted span)
@@ -116,6 +120,22 @@ WELL_KNOWN = (
     "elastic_shrinks", "elastic_hot_joins", "elastic_reshard_bytes",
     "elastic_recovery_ns", "elastic_fallback_restores",
     "elastic_checkpoints", "elastic_injected_kills",
+    # io/async_ckpt (crash-consistent overlapped checkpoints):
+    # snapshots begun / epochs committed, chunk counts + shard bytes
+    # + d2h/write walls, collective-write retries and the per-rank
+    # synchronous degrades (never a lost snapshot), incremental
+    # chunks skipped by digest-diff, restores served, epochs
+    # abandoned by the newest-first fallback scan, digest mismatches
+    # caught, and deterministic injected faults fired
+    "ckpt_snapshots", "ckpt_commits", "ckpt_chunks", "ckpt_bytes",
+    "ckpt_d2h_ns", "ckpt_write_ns", "ckpt_write_retries",
+    "ckpt_fallback_sync", "ckpt_incremental_skipped",
+    "ckpt_restores", "ckpt_restore_fallbacks",
+    "ckpt_digest_mismatches", "ckpt_injected_failures",
+    # fcoll aggregator writes retried after a short/partial result
+    # (exhaustion raises MPIError(ERR_FILE) — satellites of the same
+    # hardening pass)
+    "fcoll_write_retries",
     # kvstore client: initial-connect retries burned before the store
     # answered (hot-joining ranks race store startup/recovery)
     "kvstore_connect_retries",

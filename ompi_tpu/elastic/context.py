@@ -136,25 +136,36 @@ class ElasticContext:
                  grad_average: bool = True,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 0,
-                 poll_joins: bool = False) -> None:
+                 poll_joins: bool = False,
+                 async_checkpoint: bool = False) -> None:
         self._init_state(
             dict(lr=lr, momentum=momentum, stage=stage,
                  deterministic=deterministic,
                  grad_average=grad_average),
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
-            poll_joins=poll_joins)
+            poll_joins=poll_joins,
+            async_checkpoint=async_checkpoint)
         self._build(comm, _host_tree(params))
         self._snapshot(-1)
 
     def _init_state(self, opt_kw: Dict[str, Any],
                     checkpoint_dir: Optional[str] = None,
                     checkpoint_every: int = 0,
-                    poll_joins: bool = False) -> None:
+                    poll_joins: bool = False,
+                    async_checkpoint: bool = False) -> None:
         self._opt_kw = dict(opt_kw)
         self._ckpt_dir = checkpoint_dir
         self._ckpt_every = int(checkpoint_every)
         self._poll_joins = bool(poll_joins)
+        #: opt-in: snapshots ride io/async_ckpt — d2h begun at the
+        #: checkpoint boundary overlaps the NEXT steps and commits at
+        #: the following boundary (two-phase manifest, incremental
+        #: digest-diff); the disk fallback prefers the newest
+        #: digest-verified manifest. The legacy .params/.slots pair
+        #: stays the default.
+        self._async_ckpt = bool(async_checkpoint)
+        self._pending_snap: Optional[tuple] = None
         self._join_timeout = _join_timeout_var.get()
         self._join_seq = 0
         self._owns_comm = False
@@ -266,10 +277,11 @@ class ElasticContext:
                 self.step_done = step
                 if (self._ckpt_every and self._ckpt_dir
                         and (step + 1) % self._ckpt_every == 0):
-                    self.save_checkpoint()
+                    self._checkpoint_boundary()
             except (errors.ProcFailedError,
                     errors.RevokedError) as exc:
                 self._recover_until_stable(exc)
+        self._commit_pending()
         return self._params
 
     # -- failure recovery ---------------------------------------------------
@@ -289,6 +301,12 @@ class ElasticContext:
         from ompi_tpu.trace import recorder as _trace
 
         t0 = time.perf_counter_ns()
+        # a snapshot begun on the old comm can never commit (its
+        # write would be collective over dead ranks) — drop it; the
+        # post-recovery boundary snapshots fresh state anyway
+        pend, self._pending_snap = self._pending_snap, None
+        if pend is not None:
+            pend[1].abort()
         failed = sorted(getattr(exc, "failed_ranks", ()) or ())
         _set_recovery({"kind": "shrink", "since": time.time(),
                        "step": self.step_done + 1,
@@ -399,6 +417,16 @@ class ElasticContext:
                 "elastic recovery: a dead rank's shard has no live "
                 "owner and no checkpoint_dir is configured — "
                 "unrecoverable")
+        if self._async_ckpt:
+            try:
+                # newest digest-verified manifest; parts carry the
+                # slot flats under the legacy name:bucket key scheme
+                tree, astep, aparts = self._ackpt_for(None).restore()
+                return (tree,
+                        _parse_slot_tree(aparts) if aparts else {},
+                        int(astep))
+            except errors.MPIError:
+                pass  # no restorable epoch — try the legacy pair
         from ompi_tpu.io import checkpoint as _ckpt
 
         params_full, pstep = _ckpt.restore(self._params_path())
@@ -422,8 +450,48 @@ class ElasticContext:
     def _slots_path(self) -> str:
         return os.path.join(self._ckpt_dir, _CKPT_BASE + ".slots")
 
+    def _ackpt_for(self, comm):
+        from ompi_tpu.io import async_ckpt as _ackpt_mod
+
+        return _ackpt_mod.AsyncCheckpointer(
+            self._ckpt_dir, comm=comm, incremental=True)
+
+    def _slot_parts(self) -> Dict[str, Any]:
+        """This rank's slot shards as async-ckpt parts — the same
+        ``name:bucket`` key scheme the legacy slot file uses, so
+        :func:`_parse_slot_tree` reads both."""
+        return {f"{name}:{b}": np.ascontiguousarray(
+                    np.asarray(st.shards[b]))
+                for name, st in self.opt.state.slots.items()
+                for b in range(len(st.shards))}
+
+    def _checkpoint_boundary(self) -> None:
+        """The run-loop checkpoint hook. Async mode: commit the
+        snapshot begun at the PREVIOUS boundary (its d2h overlapped
+        the steps in between — the snapshot window), then begin the
+        next one. Legacy mode: the synchronous pair write."""
+        if not self._async_ckpt:
+            self.save_checkpoint()
+            return
+        self._commit_pending()
+        ck = self._ackpt_for(self._comm)
+        snap = ck.begin(self._params, self.step_done,
+                        parts=self._slot_parts())
+        self._pending_snap = (ck, snap)
+
+    def _commit_pending(self) -> None:
+        pend, self._pending_snap = self._pending_snap, None
+        if pend is None:
+            return
+        ck, snap = pend
+        ck.commit(snap)
+        pvar.record("elastic_checkpoints")
+
     def save_checkpoint(self) -> None:
-        """Collective snapshot: replicated params (rank 0 writes) +
+        """Collective snapshot. Async mode (``async_checkpoint=True``):
+        one digest-diffed, two-phase-committed epoch through
+        ``io/async_ckpt`` (params sharded by ZeroPlan extents + slot
+        shards as parts). Legacy: replicated params (rank 0 writes) +
         slot shards through ``save_sharded`` (each rank lands its
         chunk; the file's global view is the old padded flats — the
         fallback's input)."""
@@ -432,6 +500,13 @@ class ElasticContext:
                 errors.ERR_ARG,
                 "ElasticContext.save_checkpoint: no checkpoint_dir "
                 "configured")
+        if self._async_ckpt:
+            self._commit_pending()
+            self._ackpt_for(self._comm).save(
+                self._params, self.step_done,
+                parts=self._slot_parts())
+            pvar.record("elastic_checkpoints")
+            return
         from ompi_tpu.io import checkpoint as _ckpt
 
         os.makedirs(self._ckpt_dir, exist_ok=True)
@@ -457,6 +532,22 @@ class ElasticContext:
         reference semantics)."""
         from ompi_tpu.io import checkpoint as _ckpt
 
+        if kwargs.get("async_checkpoint"):
+            from ompi_tpu.io import async_ckpt as _ackpt_mod
+
+            try:
+                tree, astep, aparts = _ackpt_mod.AsyncCheckpointer(
+                    checkpoint_dir).restore()
+            except errors.MPIError:
+                tree = None  # no manifest — fall back to the pair
+            if tree is not None:
+                ctx = cls(comm, tree, checkpoint_dir=checkpoint_dir,
+                          **kwargs)
+                slots_full = _parse_slot_tree(aparts) \
+                    if aparts and ctx._has_slots else {}
+                ctx._rebuild(comm, tree, slots_full, int(astep))
+                ctx.restored_from = "checkpoint"
+                return ctx
         base = os.path.join(checkpoint_dir, _CKPT_BASE)
         params_full, step = _ckpt.restore(base + ".params")
         ctx = cls(comm, params_full, checkpoint_dir=checkpoint_dir,

@@ -533,6 +533,23 @@ class IngestEngine:
         return drain
 
 
+def upload_for_restore(tree, keys=None, engine=None):
+    """Checkpoint-restore gating: stream a restored host pytree up
+    through the ingest plane so step 1 gates on just its leaves
+    (``gate(keys)``, default: the first leaf) instead of waiting for
+    the whole state — the restore-side mirror of the cold-start
+    pipeline. Returns the gated :class:`IngestRequest`; with no
+    engine up this is the identity (the host tree is returned and
+    the caller proceeds synchronously)."""
+    eng = engine if engine is not None else INGEST
+    if eng is None:
+        return tree
+    req = eng.upload(tree)
+    if req.n_units:
+        req.gate(keys=[0] if keys is None else keys)
+    return req
+
+
 # -- plane lifecycle (runtime/state wiring) -------------------------------
 
 def requested() -> bool:

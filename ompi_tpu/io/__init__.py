@@ -219,7 +219,20 @@ class File:
         done = 0
         try:
             for off, length in extents:
-                os.pwrite(self.fd, data[done:done + length], off)
+                # honor pwrite's return: POSIX may land fewer bytes
+                # than asked (quota, signals, fs limits) — loop until
+                # the extent is fully on disk; a zero-byte write is an
+                # error, not progress
+                written = 0
+                while written < length:
+                    w = os.pwrite(self.fd,
+                                  data[done + written:done + length],
+                                  off + written)
+                    if w <= 0:
+                        raise OSError(
+                            f"zero-byte pwrite at offset "
+                            f"{off + written}")
+                    written += w
                 done += length
             if self._atomic and done:
                 os.fsync(self.fd)  # atomic mode: durable/visible

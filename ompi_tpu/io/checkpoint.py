@@ -207,19 +207,36 @@ def restore(path: str, comm=None,
 
 
 class SaveHandle:
-    """Async snapshot in flight; wait() joins the writer thread."""
+    """Async snapshot in flight; wait() joins the writer thread.
+
+    Background failures are never silent: ``wait()`` re-raises them
+    as ``MPIError(ERR_FILE)`` (the file-plane error class callers
+    already handle), and after ``done()`` turns True the
+    :attr:`error` attribute exposes the failure state without
+    raising — a train loop can poll it at step boundaries."""
 
     def __init__(self, thread: threading.Thread) -> None:
         self._thread = thread
+        #: the writer thread's failure (None while running or on
+        #: success) — readable once done() is True
         self.error: Optional[BaseException] = None
 
     def done(self) -> bool:
+        """True when the writer thread finished — successfully OR
+        not; check :attr:`error` (or call :meth:`wait`) to tell."""
         return not self._thread.is_alive()
 
     def wait(self) -> None:
+        """Join the writer; a failed save surfaces as
+        ``MPIError(ERR_FILE)`` naming the underlying cause."""
         self._thread.join()
         if self.error is not None:
-            raise self.error
+            if isinstance(self.error, errors.MPIError):
+                raise self.error
+            raise errors.MPIError(
+                errors.ERR_FILE,
+                f"async checkpoint save failed: {self.error!r}"
+            ) from self.error
 
 
 def save_async(path: str, tree, step: int = 0) -> SaveHandle:

@@ -15,9 +15,50 @@ default when ranks ≤ aggregators).
 
 from __future__ import annotations
 
+import time
 from typing import List, Tuple
 
+from ompi_tpu import errors
+from ompi_tpu.core import cvar, pvar
+
 Extent = Tuple[int, int]  # (absolute file offset, byte length)
+
+_attempts_var = cvar.register(
+    "fcoll_write_attempts", 3, int,
+    help="Bounded retries of one aggregator write before the "
+         "collective fails with MPIError(ERR_FILE). Short/partial "
+         "writes and transient OS errors retry with doubling "
+         "backoff (fcoll_write_backoff).", level=6)
+_backoff_var = cvar.register(
+    "fcoll_write_backoff", 0.002, float,
+    help="Initial aggregator write-retry backoff in seconds; "
+         "doubles per attempt.", level=9)
+
+
+def _pwritev_retry(f, off: int, chunk: bytes) -> int:
+    """One aggregator write, hardened: short/partial results and OS
+    errors retry (bounded, doubling backoff); exhaustion raises
+    ``MPIError(ERR_FILE)`` naming the offset and the deficit — a
+    collective write must never silently under-deliver."""
+    attempts = max(1, int(_attempts_var.get()))
+    backoff = max(0.0, float(_backoff_var.get()))
+    last: object = None
+    n = -1
+    for attempt in range(attempts):
+        try:
+            n = f._pwritev([(off, len(chunk))], chunk)
+        except errors.MPIError as exc:
+            last, n = exc, -1
+        if n == len(chunk):
+            return n
+        pvar.record("fcoll_write_retries")
+        if attempt + 1 < attempts and backoff:
+            time.sleep(backoff * (1 << attempt))
+    raise errors.MPIError(
+        errors.ERR_FILE,
+        f"{f.filename}: collective write at offset {off} landed "
+        f"{max(n, 0)}/{len(chunk)} bytes after {attempts} attempts"
+        + (f" (last error: {last})" if last is not None else ""))
 
 
 def _domains(all_extents: List[List[Extent]],
@@ -86,8 +127,17 @@ def sched_write(f, extents: List[Extent], data: bytes, tags,
     the byte count at completion."""
     comm = f.comm
     n, me = comm.size, comm.rank
+    if sum(ln for _, ln in extents) != len(data):
+        raise errors.MPIError(
+            errors.ERR_ARG,
+            f"{f.filename}: collective write extents sum to "
+            f"{sum(ln for _, ln in extents)} bytes but {len(data)} "
+            "bytes of data were supplied")
     if n == 1:
-        f._pwritev(extents, data)
+        pos = 0
+        for off, ln in extents:
+            _pwritev_retry(f, off, data[pos:pos + ln])
+            pos += ln
         out["n"] = len(data)
         _io_event("write", f, out["n"])
         return
@@ -130,8 +180,15 @@ def sched_write(f, extents: List[Extent], data: bytes, tags,
             merged[-1] = (merged[-1][0], merged[-1][1] + chunk)
         else:
             merged.append((off, chunk))
+    landed = 0
     for off, chunk in merged:
-        f._pwritev([(off, len(chunk))], chunk)
+        landed += _pwritev_retry(f, off, chunk)
+    want = sum(len(chunk) for _, chunk in merged)
+    if landed != want:  # belt over the per-chunk verification
+        raise errors.MPIError(
+            errors.ERR_FILE,
+            f"{f.filename}: aggregator landed {landed}/{want} bytes "
+            "for its file domain")
     out["n"] = len(data)
     # completion: every rank's domain is on disk before anyone returns
     yield from _sched_barrier_obj(comm, p, t_bar)

@@ -14,6 +14,7 @@ from typing import List, Tuple
 
 import numpy as np
 
+from ompi_tpu import errors
 from ompi_tpu.datatype import datatype as dt_mod
 
 
@@ -34,9 +35,12 @@ class FileView:
         self.bytes_per_tile = int(self._cum[-1])
         self.tile_extent = self.filetype.extent
         if self.bytes_per_tile == 0:
-            raise ValueError("filetype has no data bytes")
+            raise errors.MPIError(errors.ERR_ARG,
+                                  "filetype has no data bytes")
         if self.etype.size and self.bytes_per_tile % self.etype.size:
-            raise ValueError("filetype size not a multiple of etype size")
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                "filetype size not a multiple of etype size")
 
     def is_contiguous(self) -> bool:
         return (len(self._offs) == 1 and self._offs[0] == 0

@@ -8,11 +8,14 @@ the measurement substrate that makes "where did the wall go" a
 tooling answer:
 
 - **Phase ledger**: ``with ledger.phase("staging"): ...`` marks
-  first-class ``staging`` / ``compile`` / ``train`` / ``teardown``
-  regions (nestable, reentrant, thread-aware). Each exit records a
-  ``prof_phase_<name>_ns`` pvar and — when the trace recorder is up —
-  a span on the ``prof`` track, so Perfetto shows the run's wall
-  breakdown as a top-level lane.
+  first-class ``staging`` / ``compile`` / ``train`` / ``teardown`` /
+  ``snapshot`` regions (nestable, reentrant, thread-aware). Each exit
+  records a ``prof_phase_<name>_ns`` pvar and — when the trace
+  recorder is up — a span on the ``prof`` track, so Perfetto shows
+  the run's wall breakdown as a top-level lane. Cross-thread
+  different-phase concurrency accrues ``prof_phase_overlap_ns`` —
+  how the ingest plane proves staging || compile and the async
+  checkpoint plane proves snapshot || train.
 - **Transfer accounting**: instrumented copy sites (accelerator
   memcpy/chunked puts/IPC import, ``_Ctx.to_global`` staging) call
   :meth:`Profiler.xfer` with direction + bytes + [t0, t1): span on

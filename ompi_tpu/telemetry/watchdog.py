@@ -274,6 +274,15 @@ class Watchdog:
         san = _check_san.SANITIZER
         if san is not None and san.last_mismatch is not None:
             doc["check_mismatch"] = san.last_mismatch
+        # an async snapshot in flight is expected d2h/commit work, not
+        # a hang — name it (step, phase, chunk progress) so a dump
+        # taken mid-snapshot reads as "busy checkpointing", and the
+        # ckpt_* pvars above carry the corroborating counters
+        from ompi_tpu.io import async_ckpt as _ackpt
+
+        snap = _ackpt.snapshot_info()
+        if snap is not None:
+            doc["ckpt_snapshot"] = snap
         # a congested ICI link is another likely hang cause: name this
         # rank's hottest link + its top peer (optional key, level 2)
         from ompi_tpu.monitoring import matrix as _mon

@@ -111,16 +111,25 @@ class ShardedState:
     replicated values, e.g. the initial parameters); consumed by
     ``Comm.Allgather_multi`` which reassembles the full pytree."""
 
-    __slots__ = ("plan", "metas", "treedef", "shards", "rank", "n")
+    __slots__ = ("plan", "metas", "treedef", "shards", "rank", "n",
+                 "versions")
 
     def __init__(self, plan: ZeroPlan, metas, treedef, shards,
-                 rank: int, n: int) -> None:
+                 rank: int, n: int, versions=None) -> None:
         self.plan = plan
         self.metas = metas
         self.treedef = treedef
         self.shards = list(shards)
         self.rank = int(rank)
         self.n = int(n)
+        #: per-bucket mutation counters (changed-bucket dirty
+        #: tracking): every :meth:`map` bumps them, so the async
+        #: checkpoint plane's incremental mode can tell which buckets
+        #: MAY have changed since the last snapshot without touching
+        #: the data (digest-diff stays the source of truth — versions
+        #: are the cheap over-approximation)
+        self.versions = list(versions) if versions is not None \
+            else [0] * len(self.shards)
 
     # -- sizing (the O(1/n) story the smoke lane asserts) -----------------
     @property
@@ -158,7 +167,8 @@ class ShardedState:
         shards = [fn(s, *(o.shards[b] for o in others))
                   for b, s in enumerate(self.shards)]
         return ShardedState(self.plan, self.metas, self.treedef,
-                            shards, self.rank, self.n)
+                            shards, self.rank, self.n,
+                            versions=[v + 1 for v in self.versions])
 
     def zeros_like(self) -> "ShardedState":
         xp = _xp(self.shards)

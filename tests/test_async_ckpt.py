@@ -1,0 +1,433 @@
+"""Crash-consistent async checkpoint plane tests — kill-anywhere
+restore (ISSUE 13 acceptance: a fault at ANY snapshot phase leaves a
+digest-verified earlier epoch restorable; a torn/corrupt newest epoch
+falls back one epoch, never restores garbage)."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def _ck(tmp_path, **kw):
+    from ompi_tpu.io.async_ckpt import AsyncCheckpointer
+
+    return AsyncCheckpointer(str(tmp_path), **kw)
+
+
+def _tree(seed=0, nleaves=3, elems=5000):
+    rng = np.random.default_rng(seed)
+    t = {f"w{i}": rng.standard_normal(elems).astype(np.float32)
+         for i in range(nleaves)}
+    t["scalar"] = np.float32(seed + 0.5)
+    t["ints"] = np.arange(17 + seed, dtype=np.int32)
+    return t
+
+
+def _assert_tree_equal(a, b):
+    import jax
+
+    la, da = jax.tree_util.tree_flatten(a)
+    lb, db = jax.tree_util.tree_flatten(b)
+    assert da == db
+    for x, y in zip(la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+        assert np.asarray(x).dtype == np.asarray(y).dtype
+
+
+@pytest.fixture(autouse=True)
+def _clear_injection():
+    from ompi_tpu.io import async_ckpt as A
+
+    yield
+    A._fail_var.set("")
+    A._kill_chunk_var.set(-1)
+    A._kill_rank_var.set(-1)
+
+
+def test_roundtrip_with_parts(tmp_path):
+    ck = _ck(tmp_path)
+    tree = _tree(1)
+    parts = {"m:0": np.linspace(0, 1, 333).astype(np.float32),
+             "m:1": np.arange(64, dtype=np.int64)}
+    ck.save(tree, 7, parts=parts)
+    got, step, gparts = ck.restore()
+    assert step == 7
+    _assert_tree_equal(got, tree)
+    assert sorted(gparts) == sorted(parts)
+    for k in parts:
+        assert np.array_equal(gparts[k], parts[k])
+        assert gparts[k].dtype == parts[k].dtype
+    assert ck.latest_step() == 7
+
+
+def test_overlapped_begin_commit_and_snapshot_info(tmp_path,
+                                                   monkeypatch):
+    """begin() returns immediately with the d2h riding a background
+    thread; while it drains, snapshot_info() names the in-flight
+    snapshot (the watchdog's hang-dump key) and clears once the
+    commit lands. Observed from inside the drain (a digest spy) so
+    the check is deterministic however fast the copies are."""
+    from ompi_tpu.io import async_ckpt as A
+
+    seen = []
+    orig = A._manifest.digest
+
+    def spy(data):
+        seen.append(A.snapshot_info())
+        return orig(data)
+
+    monkeypatch.setattr(A._manifest, "digest", spy)
+    ck = _ck(tmp_path, chunk_bytes=1 << 12)
+    tree = _tree(2, nleaves=4, elems=20000)
+    snap = ck.begin(tree, 3)
+    snap.wait_d2h()
+    in_flight = list(seen)
+    assert in_flight and all(
+        i is not None and i["step"] == 3 and i["phase"] == "d2h"
+        for i in in_flight)
+    ck.commit(snap)
+    assert A.snapshot_info() is None
+    got, step, _ = ck.restore()
+    assert step == 3
+    _assert_tree_equal(got, tree)
+
+
+def test_corrupt_newest_epoch_falls_back_one(tmp_path):
+    """Flip one byte of the newest epoch's data: restore must detect
+    the digest mismatch and land on the previous epoch."""
+    from ompi_tpu.core import pvar
+    from ompi_tpu.io import manifest
+
+    ck = _ck(tmp_path)
+    t1, t2 = _tree(1), _tree(2)
+    ck.save(t1, 1)
+    ck.save(t2, 2)
+    doc = manifest.load(str(tmp_path), 2)
+    rec = doc["chunks"][0]
+    p = os.path.join(str(tmp_path), rec["file"])
+    with open(p, "r+b") as f:
+        f.seek(rec["offset"])
+        b = f.read(1)
+        f.seek(rec["offset"])
+        f.write(bytes([b[0] ^ 0xFF]))
+    sess = pvar.session()
+    got, step, _ = ck.restore()
+    assert step == 1
+    _assert_tree_equal(got, t1)
+    assert sess.read("ckpt_digest_mismatches") >= 1
+    assert sess.read("ckpt_restore_fallbacks") >= 1
+
+
+def test_truncated_data_file_falls_back(tmp_path):
+    """A torn write (file shorter than the manifest's extents — the
+    kill-mid-write shape) is a fallback, not a crash."""
+    from ompi_tpu.io import manifest
+
+    ck = _ck(tmp_path)
+    t1, t2 = _tree(3), _tree(4)
+    ck.save(t1, 1)
+    ck.save(t2, 2)
+    doc = manifest.load(str(tmp_path), 2)
+    rec = doc["chunks"][0]
+    p = os.path.join(str(tmp_path), rec["file"])
+    os.truncate(p, rec["offset"] + rec["nbytes"] // 2)
+    got, step, _ = ck.restore()
+    assert step == 1
+    _assert_tree_equal(got, t1)
+
+
+def test_missing_data_file_falls_back(tmp_path):
+    from ompi_tpu.io import manifest
+
+    ck = _ck(tmp_path, retain=10)
+    t1, t2 = _tree(5), _tree(6)
+    ck.save(t1, 1)
+    ck.save(t2, 2)
+    doc = manifest.load(str(tmp_path), 2)
+    os.unlink(os.path.join(str(tmp_path), doc["chunks"][0]["file"]))
+    got, step, _ = ck.restore()
+    assert step == 1
+    _assert_tree_equal(got, t1)
+
+
+# -- the crash matrix: every injectable phase, asserted end state --------
+
+@pytest.mark.parametrize("phase,commits,restores_to", [
+    ("d2h", False, 1),          # copy fails -> commit raises
+    ("pre_manifest", False, 1),  # data durable, manifest never lands
+    ("mid_rename", False, 1),    # tmp manifest durable, rename torn
+    ("corrupt_chunk", True, 1),  # commits, but bytes are torn on disk
+    ("write", True, 2),          # collective exhausts -> sync fallback
+])
+def test_crash_matrix(tmp_path, phase, commits, restores_to):
+    """Inject a deterministic fault at every snapshot phase ISSUE 13
+    names; epoch 1 is always clean. The restore must land on a
+    digest-verified epoch: epoch 1 for real faults, epoch 2 when the
+    fault only degraded the write path (never a lost snapshot)."""
+    from ompi_tpu import errors
+    from ompi_tpu.core import pvar
+    from ompi_tpu.io import async_ckpt as A
+
+    ck = _ck(tmp_path)
+    t1, t2 = _tree(11), _tree(12)
+    ck.save(t1, 1)
+    sess = pvar.session()
+    A._fail_var.set(phase)
+    try:
+        if commits:
+            ck.save(t2, 2)  # degraded (write) or silently torn
+        else:
+            with pytest.raises(errors.MPIError):
+                ck.save(t2, 2)
+    finally:
+        A._fail_var.set("")
+    got, step, _ = ck.restore()
+    assert step == restores_to, (phase, step)
+    _assert_tree_equal(got, t1 if restores_to == 1 else t2)
+    assert sess.read("ckpt_injected_failures") >= 1
+    if phase == "write":
+        assert sess.read("ckpt_fallback_sync") >= 1
+        assert sess.read("ckpt_write_retries") >= 1
+    # the injected fault must never strand the in-flight marker
+    assert A.snapshot_info() is None
+
+
+def test_no_restorable_epoch_raises_err_file(tmp_path):
+    from ompi_tpu import errors
+
+    ck = _ck(tmp_path)
+    with pytest.raises(errors.MPIError) as ei:
+        ck.restore()
+    assert ei.value.error_class == errors.ERR_FILE
+
+
+def test_incremental_skips_unchanged_chunks(tmp_path):
+    """Digest-diff vs the parent manifest: an unchanged tree re-saves
+    as metadata only (chunks inherit the parent's file/offset)."""
+    from ompi_tpu.core import pvar
+
+    ck = _ck(tmp_path, incremental=True)
+    tree = _tree(21, nleaves=4, elems=30000)
+    ck.save(tree, 1)
+    sess = pvar.session()
+    ck.save(tree, 2)
+    assert sess.read("ckpt_incremental_skipped") > 0
+    got, step, _ = ck.restore()
+    assert step == 2
+    _assert_tree_equal(got, tree)
+    # a changed leaf dirties only its chunks
+    tree2 = dict(tree)
+    tree2["w0"] = tree["w0"] + 1.0
+    sess2 = pvar.session()
+    ck.save(tree2, 3)
+    assert sess2.read("ckpt_incremental_skipped") > 0
+    got, step, _ = ck.restore()
+    assert step == 3
+    _assert_tree_equal(got, tree2)
+
+
+def test_incremental_chain_survives_prune(tmp_path):
+    """Pruning keeps data files any retained manifest references —
+    an old epoch's data backing a newer incremental epoch must not
+    be deleted out from under it."""
+    ck = _ck(tmp_path, incremental=True, retain=2)
+    tree = _tree(22, elems=10000)
+    for s in range(1, 6):
+        ck.save(tree, s)  # all epochs share epoch 1's bytes
+    got, step, _ = ck.restore()
+    assert step == 5
+    _assert_tree_equal(got, tree)
+
+
+def test_clean_buckets_skip_d2h(tmp_path):
+    """A bucket certified clean by the caller (ShardedState.versions
+    unchanged) inherits the parent manifest's records without even
+    copying the bytes off the device."""
+    ck = _ck(tmp_path, incremental=True)
+    tree = _tree(23, elems=8000)
+    s1 = ck.begin(tree, 1)
+    ck.commit(s1)
+    nplan = ck._plan([np.asarray(v) for v in
+                      __import__("jax").tree.leaves(tree)])
+    all_buckets = tuple(range(len(nplan.buckets)))
+    s2 = ck.begin(tree, 2, clean_buckets=all_buckets)
+    ck.commit(s2)
+    got, step, _ = ck.restore()
+    assert step == 2
+    _assert_tree_equal(got, tree)
+
+
+def test_overlap_pvar_proves_snapshot_rides_train(tmp_path):
+    """prof_phase_overlap_ns > 0 when the d2h thread (snapshot phase)
+    runs concurrently with a train phase on the main thread — the
+    acceptance criterion's overlap proof."""
+    import time
+
+    from ompi_tpu.core import pvar
+    from ompi_tpu.prof import ledger
+
+    ledger.enable()
+    try:
+        sess = pvar.session()
+        ck = _ck(tmp_path, chunk_bytes=1 << 14)
+        tree = _tree(31, nleaves=8, elems=200000)
+        with ledger.phase("train"):
+            # begin() inside the open phase: the snapshot phase then
+            # starts strictly after train opens, so the overlap the
+            # ledger accounts at either close is positive even when
+            # the drain finishes in microseconds
+            snap = ck.begin(tree, 1)
+            # keep the train phase open until the d2h thread has
+            # demonstrably been concurrent with it
+            deadline = time.monotonic() + 10.0
+            while not snap.d2h_done() \
+                    and time.monotonic() < deadline:
+                time.sleep(0.002)
+            time.sleep(0.01)
+        ck.commit(snap)
+        assert sess.read("prof_phase_overlap_ns") > 0
+        assert sess.read("prof_phase_snapshot_ns") > 0
+    finally:
+        ledger.disable()
+
+
+def test_restore_feeds_ingest_gated_upload(tmp_path):
+    """restore_to_device hands the tree to the ingest plane: step 1
+    gates on just its first leaves, the rest streams behind."""
+    from ompi_tpu.ingest import engine as ingest_engine
+
+    ck = _ck(tmp_path)
+    tree = _tree(41, nleaves=4)
+    ck.save(tree, 9)
+    eng = ingest_engine.IngestEngine()
+    try:
+        req, step, _ = ck.restore_to_device(engine=eng)
+        assert step == 9
+        req.wait()
+        got = req.tree()
+        _assert_tree_equal(got, tree)
+    finally:
+        eng.close()
+
+
+def test_sharded_state_versions_bump_on_map():
+    """zero-plane dirty tracking: map() bumps every bucket's version
+    counter (the cheap over-approximation incremental mode consults);
+    a fresh pack starts at zero."""
+    from ompi_tpu.zero.layout import ShardedState, plan_for
+
+    leaves = [np.arange(100, dtype=np.float32),
+              np.arange(40, dtype=np.int32)]
+    plan = plan_for(leaves, 1)
+
+    class _One:
+        rank, size = 0, 1
+
+    import jax
+
+    tree = {"a": leaves[0], "b": leaves[1]}
+    st = ShardedState.from_full(_One(), tree, plan=plan_for(
+        jax.tree.leaves(tree), 1))
+    assert st.versions == [0] * len(st.shards)
+    st2 = st.map(lambda s: s * 2)
+    assert st2.versions == [v + 1 for v in st.versions]
+    assert st.versions == [0] * len(st.shards)  # original untouched
+
+
+def test_elastic_async_checkpoint_roundtrip():
+    """ElasticContext(async_checkpoint=True): boundary snapshots ride
+    the async plane (overlapped d2h, two-phase manifest) and
+    from_checkpoint restores params AND optimizer slot shards
+    bit-identically into a replayed reference run."""
+    from tests.harness import run_ranks
+
+    run_ranks("""
+        import os, shutil, tempfile
+        from ompi_tpu import elastic
+        from ompi_tpu.core import pvar
+        from ompi_tpu.runtime import rte
+
+        d = os.path.join(tempfile.gettempdir(),
+                         "async_ckpt_el_" + rte.jobid)
+        params = {"w": np.arange(12, dtype=np.float32)
+                       .reshape(3, 4) / 7.0,
+                  "b": np.linspace(-1.0, 1.0, 5).astype(np.float32)}
+
+        def grad_fn(p, step, c):
+            import jax
+            return jax.tree.map(
+                lambda a: 0.01 * a
+                + np.full_like(a, 0.125 * (step + 1)), p)
+
+        ctx = elastic.ElasticContext(comm, params, lr=0.125,
+                                     momentum=0.5,
+                                     checkpoint_dir=d,
+                                     checkpoint_every=2,
+                                     async_checkpoint=True)
+        out = ctx.run(grad_fn, 5)
+        snap = pvar.snapshot()
+        assert snap.get("ckpt_commits", 0) >= 1, snap
+        # restore into a fresh context and replay from the last
+        # committed boundary — trajectories must re-converge exactly
+        ref = elastic.ElasticContext.from_checkpoint(
+            comm, d, lr=0.125, momentum=0.5,
+            async_checkpoint=True)
+        assert ref.restored_from == "checkpoint"
+        assert ref.step_done >= 2
+        ref_out = ref.run(grad_fn, 5)
+        import jax
+        for a, b in zip(jax.tree.leaves(out),
+                        jax.tree.leaves(ref_out)):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b))
+        for name, st in ctx.opt.state.slots.items():
+            for a, b in zip(st.shards,
+                            ref.opt.state.slots[name].shards):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+        comm.Barrier()
+        if rank == 0:
+            shutil.rmtree(d, ignore_errors=True)
+    """, 2, timeout=120)
+
+
+def test_hang_dump_names_in_flight_snapshot(tmp_path):
+    """A watchdog dump taken while a snapshot is in flight carries a
+    ckpt_snapshot key — 'busy checkpointing', not an anonymous hang."""
+    import json
+
+    from ompi_tpu.io import async_ckpt as A
+    from ompi_tpu.telemetry import flight
+    from ompi_tpu.telemetry.watchdog import Watchdog
+
+    flight.disable()
+    A._set_info({"step": 12, "phase": "d2h", "since": 0.0,
+                 "chunks_done": 3, "chunks_total": 9})
+    try:
+        fl = flight.FlightRecorder(rank=0)
+        fl.enter("allreduce_dev", comm_cid=0, nbytes=64)
+        wd = Watchdog(rank=0, world=[0], client=None, flight_rec=fl,
+                      dead_fn=lambda: {}, period=10, timeout=0.0,
+                      action="dump", dump_dir=str(tmp_path))
+        v = wd.sweep()
+        assert v is not None
+        doc = json.load(open(wd._dumped[(v["seq"], "hang")]))
+        assert doc["ckpt_snapshot"]["step"] == 12
+        assert doc["ckpt_snapshot"]["phase"] == "d2h"
+        assert doc["ckpt_snapshot"]["chunks_done"] == 3
+    finally:
+        A._set_info(None)
+        flight.disable()
+
+
+def test_retention_prunes_old_epochs(tmp_path):
+    from ompi_tpu.io import manifest
+
+    ck = _ck(tmp_path, retain=2)
+    for s in range(1, 6):
+        ck.save(_tree(s), s)
+    steps = manifest.scan(str(tmp_path))
+    assert steps == [5, 4]
+    got, step, _ = ck.restore()
+    assert step == 5

@@ -89,6 +89,26 @@ def test_async_save(tmp_path):
     assert np.array_equal(back["x"], tree["x"])
 
 
+def test_async_save_failure_surfaces_as_mpierror(tmp_path):
+    """A background save that dies must not vanish: done() goes True,
+    error carries the cause, and wait() raises MPIError(ERR_FILE)
+    (ISSUE 13 satellite — no silent checkpoint loss)."""
+    import pytest
+
+    from ompi_tpu import errors
+    from ompi_tpu.io import checkpoint
+
+    # unwritable destination: the directory does not exist
+    path = str(tmp_path / "no" / "such" / "dir" / "x.otck")
+    tree = {"x": np.arange(16, dtype=np.float32)}
+    h = checkpoint.save_async(path, tree, step=1)
+    with pytest.raises(errors.MPIError) as ei:
+        h.wait()
+    assert ei.value.error_class == errors.ERR_FILE
+    assert h.done()
+    assert h.error is not None
+
+
 def test_sharded_collective_checkpoint(tmp_path):
     """4 ranks each write their leading-axis shard via Write_at_all;
     restore re-slices per rank and also reads back the global view."""

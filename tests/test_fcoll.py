@@ -1,0 +1,139 @@
+"""fcoll hardening tests — short/partial aggregator writes retry
+(bounded, doubling backoff) and the landed byte count is verified
+against the extent sum; exhaustion is MPIError(ERR_FILE), never a
+silently under-delivered collective write (ISSUE 13 satellite)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from tests.harness import run_ranks
+
+
+def _open_single(path):
+    from ompi_tpu import mpi
+    from ompi_tpu import io as io_mod
+
+    comm = mpi.Init()
+    return io_mod.File_open(
+        comm, path, io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+
+
+def test_short_write_retries_then_lands():
+    """A transiently short pwritev (first attempt delivers half) must
+    retry and land every byte; fcoll_write_retries counts it."""
+    from ompi_tpu.core import pvar
+    from ompi_tpu.io import fcoll
+
+    path = tempfile.mktemp(suffix=".fcoll")
+    f = _open_single(path)
+    try:
+        real = f._pwritev
+        calls = {"n": 0}
+
+        def flaky(extents, data):
+            calls["n"] += 1
+            if calls["n"] == 1:  # short: land only half
+                (off, ln), = extents
+                half = ln // 2
+                real([(off, half)], data[:half])
+                return half
+            return real(extents, data)
+
+        f._pwritev = flaky
+        data = bytes(np.arange(256, dtype=np.uint8))
+        sess = pvar.session()
+        n = fcoll.two_phase_write(f, [(0, len(data))], data)
+        assert n == len(data)
+        assert calls["n"] == 2
+        assert sess.read("fcoll_write_retries") == 1
+        f._pwritev = real
+        out = np.zeros(256, dtype=np.uint8)
+        f.Read_at(0, out)
+        assert np.array_equal(out, np.frombuffer(data, np.uint8))
+        f.Close()
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+
+
+def test_short_write_exhaustion_raises_err_file():
+    """A persistently short write exhausts the bounded attempts and
+    raises MPIError(ERR_FILE) naming the deficit."""
+    from ompi_tpu import errors
+    from ompi_tpu.io import fcoll
+
+    path = tempfile.mktemp(suffix=".fcoll")
+    f = _open_single(path)
+    try:
+        def always_short(extents, data):
+            (off, ln), = extents
+            return max(0, ln - 1)
+
+        f._pwritev = always_short
+        with pytest.raises(errors.MPIError) as ei:
+            fcoll.two_phase_write(f, [(0, 64)], bytes(64))
+        assert ei.value.error_class == errors.ERR_FILE
+        assert "63/64" in str(ei.value)
+        f.Close()
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+
+
+def test_extent_sum_mismatch_is_err_arg():
+    """Extents that do not cover the supplied data are rejected up
+    front (ERR_ARG) instead of writing a torn file."""
+    from ompi_tpu import errors
+    from ompi_tpu.io import fcoll
+
+    path = tempfile.mktemp(suffix=".fcoll")
+    f = _open_single(path)
+    try:
+        with pytest.raises(errors.MPIError) as ei:
+            fcoll.two_phase_write(f, [(0, 10)], bytes(64))
+        assert ei.value.error_class == errors.ERR_ARG
+        f.Close()
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+
+
+def test_aggregator_short_write_retries_2rank(tmp_path):
+    """The two-phase aggregator path: rank 0's first merged write is
+    short; the retry must still land a bit-identical file."""
+    path = str(tmp_path / "agg.fcoll")
+    run_ranks(f"""
+        from ompi_tpu import io as io_mod
+        from ompi_tpu.io import fcoll
+
+        path = {path!r}
+        f = io_mod.File_open(
+            comm, path, io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+        if rank == 0:
+            real = f._pwritev
+            state = {{"first": True}}
+
+            def flaky(extents, data):
+                if state["first"] and len(data) > 1:
+                    state["first"] = False
+                    (off, ln), = extents
+                    real([(off, ln // 2)], data[:ln // 2])
+                    return ln // 2
+                return real(extents, data)
+
+            f._pwritev = flaky
+        blk = 512
+        data = bytes(np.full(blk, rank + 1, dtype=np.uint8))
+        n = fcoll.two_phase_write(f, [(rank * blk, blk)], data)
+        assert n == blk, n
+        f.Close()
+        comm.Barrier()
+        if rank == 0:
+            got = np.fromfile(path, dtype=np.uint8)
+            want = np.concatenate([np.full(blk, 1, np.uint8),
+                                   np.full(blk, 2, np.uint8)])
+            assert np.array_equal(got, want)
+    """, 2, timeout=120)
