@@ -610,6 +610,14 @@ class ElasticContext:
 
         t0 = time.perf_counter_ns()
         client = rte.client()
+        # a snapshot begun before the join must never commit after it:
+        # its checkpointer is bound to the old comm, so the deferred
+        # commit's collectives would run over a freed comm the joiners
+        # are not part of — drop it exactly as _recover does; the next
+        # boundary begins fresh on the grown comm
+        pend, self._pending_snap = self._pending_snap, None
+        if pend is not None:
+            pend[1].abort()
         snap = self._snapshots[self.step_done]
         members = sorted(set(self._comm.group.ranks)
                          | set(dec["joiners"]))
@@ -627,7 +635,13 @@ class ElasticContext:
                              "step": self.step_done,
                              "target": int(num_steps),
                              "opt": dict(self._opt_kw),
-                             "checkpoint_dir": self._ckpt_dir})
+                             "checkpoint_dir": self._ckpt_dir,
+                             # boundary checkpoints (and join polls)
+                             # are collective — the joiner must run
+                             # them in lockstep with the survivors
+                             "checkpoint_every": self._ckpt_every,
+                             "async_checkpoint": self._async_ckpt,
+                             "poll_joins": self._poll_joins})
                 old_comm = self._comm
                 old_rank = old_comm.rank
                 _recovery_phase("regrow_comm")
@@ -739,7 +753,12 @@ def hot_join() -> tuple:
     slots_full = _regrow_slots(got, elems)
     ctx = ElasticContext.__new__(ElasticContext)
     ctx._init_state(dict(admit["opt"]),
-                    checkpoint_dir=admit.get("checkpoint_dir"))
+                    checkpoint_dir=admit.get("checkpoint_dir"),
+                    checkpoint_every=int(
+                        admit.get("checkpoint_every") or 0),
+                    poll_joins=bool(admit.get("poll_joins")),
+                    async_checkpoint=bool(
+                        admit.get("async_checkpoint")))
     ctx._join_seq = int(admit["seq"])
     ctx._rebuild(new, params_full, slots_full, int(admit["step"]))
     ctx._owns_comm = True

@@ -331,7 +331,7 @@ class AsyncCheckpointer:
         }
         chunks, jobs = self._cut_chunks(
             leaves, plan, parts, parts_meta, boffs, poffs, rank, step)
-        jobs = self._skip_clean(chunks, jobs, clean_buckets)
+        jobs = self._skip_clean(chunks, jobs, clean_buckets, header)
         payload: List[Optional[bytes]] = [None] * len(chunks)
         snap = Snapshot(step, header, chunks, payload)
         _set_info({"step": int(step), "phase": "d2h",
@@ -444,13 +444,14 @@ class AsyncCheckpointer:
                     break
         return chunks, jobs
 
-    def _skip_clean(self, chunks, jobs, clean_buckets):
+    def _skip_clean(self, chunks, jobs, clean_buckets, header):
         """Changed-bucket dirty tracking consumer: chunks of buckets
         the caller certifies unchanged inherit the parent manifest's
         records (sha/file/offset) and never ride the d2h stream.
-        Chunks without a parent record keep their copy job — a new
-        bucket layout or a pruned parent silently falls back to the
-        full path."""
+        Chunks without a parent record — or a parent whose file
+        layout differs from this snapshot's — keep their copy job: a
+        new bucket layout or a pruned parent silently falls back to
+        the full path."""
         clean = set(int(b) for b in (clean_buckets or ()))
         if not clean or not self.incremental:
             return jobs
@@ -461,7 +462,8 @@ class AsyncCheckpointer:
                 break
             except errors.MPIError:
                 continue
-        if parent is None:
+        if parent is None or not self._parent_compatible(parent,
+                                                         header):
             return jobs
         old = {rec["key"]: rec for rec in parent["chunks"]}
         kept = []
@@ -514,6 +516,26 @@ class AsyncCheckpointer:
         (still chunked, digested, two-phase committed)."""
         return self.commit(self.begin(tree, step, parts=parts))
 
+    @staticmethod
+    def _parent_compatible(parent: Dict[str, Any],
+                           header: Dict[str, Any]) -> bool:
+        """True when the parent manifest's file layout is
+        byte-identical to this snapshot's — the precondition for
+        inheriting its chunk records. _materialize resolves an
+        inherited record's offset against the CURRENT epoch's bucket
+        offsets, so after an elastic shrink/regrow shifts n/padded
+        (while an early chunk's bytes and sha can be unchanged) an
+        inherited offset would silently land restored bytes at the
+        wrong position — with the digest still verifying."""
+        ph = parent.get("header") or {}
+        return (int(ph.get("n", -1)) == int(header["n"])
+                and [int(p) for p in ph.get("padded", ())]
+                == [int(p) for p in header["padded"]]
+                and [str(d) for d in ph.get("dtypes", ())]
+                == [str(d) for d in header["dtypes"]]
+                and (ph.get("parts") or {})
+                == (header.get("parts") or {}))
+
     def _diff_incremental(self, snap: Snapshot) -> List[int]:
         """Indices of chunks that must hit the disk. In incremental
         mode a chunk whose digest matches the parent manifest's
@@ -529,7 +551,8 @@ class AsyncCheckpointer:
                 break
             except errors.MPIError:
                 continue
-        if parent is None:
+        if parent is None or not self._parent_compatible(parent,
+                                                         snap.header):
             return idxs
         old = {rec["key"]: rec for rec in parent["chunks"]}
         snap.header["parent"] = int(parent["step"])
@@ -574,26 +597,52 @@ class AsyncCheckpointer:
         t0 = time.perf_counter_ns()
         last: Optional[BaseException] = None
         for attempt in range(attempts):
+            err: Optional[BaseException] = None
             try:
                 _inject("write")
                 if use_coll:
                     self._write_collective(path, extents, data)
                 else:
                     self._write_direct(path, extents, data)
+            except errors.MPIError as exc:
+                err = exc
+            if self._agree_write(err is None):
                 last = None
                 break
-            except errors.MPIError as exc:
-                last = exc
-                pvar.record("ckpt_write_retries")
-                if attempt + 1 < attempts and backoff:
-                    time.sleep(backoff * (1 << attempt))
+            last = err or errors.MPIError(
+                errors.ERR_FILE,
+                f"{path}: checkpoint write failed on a peer rank")
+            pvar.record("ckpt_write_retries")
+            if attempt + 1 < attempts and backoff:
+                time.sleep(backoff * (1 << attempt))
         if last is not None:
             # degrade, never lose: every rank lands its own extents
-            # with plain pwrite (deterministic injection/failure means
-            # every rank degrades together, keeping commit collective)
+            # with plain pwrite (the vote above made every rank take
+            # this path together, keeping commit collective)
             pvar.record("ckpt_fallback_sync")
-            self._write_direct(path, extents, data)
+            err = None
+            try:
+                self._write_direct(path, extents, data)
+            except errors.MPIError as exc:
+                err = exc
+            if not self._agree_write(err is None):
+                raise err or errors.MPIError(
+                    errors.ERR_FILE,
+                    f"{path}: synchronous degrade write failed on a "
+                    "peer rank")
         pvar.record("ckpt_write_ns", time.perf_counter_ns() - t0)
+
+    def _agree_write(self, ok: bool) -> bool:
+        """Success vote after a write attempt: transient storage
+        failures (the ENOSPC/EIO shapes the backoff cvar is for) hit
+        individual ranks, so retry/degrade decisions must be agreed —
+        a lone failing rank re-entering the collective write while its
+        peers moved on to _publish's allgather is a deadlock. The vote
+        doubles as the everyone-durable barrier ahead of the
+        manifest."""
+        if self.comm is None or self.comm.size == 1:
+            return bool(ok)
+        return all(self.comm.allgather(bool(ok)))
 
     def _write_collective(self, path: str, extents, data) -> None:
         from ompi_tpu import io as io_mod
@@ -612,7 +661,9 @@ class AsyncCheckpointer:
         """Per-rank direct writes (single-process path, the post-retry
         degrade, and the deterministic home of the kill-chunk
         injection). O_CREAT is race-free across ranks; fsync before
-        return makes the chunks durable ahead of the manifest."""
+        return makes the chunks durable ahead of the manifest (the
+        cross-rank durability sync is _write_data's success vote — a
+        Barrier here would mismatch a failing rank's vote call)."""
         try:
             fd = os.open(path, os.O_WRONLY | os.O_CREAT, 0o644)
         except OSError as exc:
@@ -642,8 +693,6 @@ class AsyncCheckpointer:
                 _maybe_kill(ci)
         finally:
             os.close(fd)
-        if self.comm is not None and self.comm.size > 1:
-            self.comm.Barrier()  # everyone durable before the manifest
 
     def _corrupt_if_injected(self, snap: Snapshot) -> None:
         """corrupt_chunk injection: flip one byte of this rank's first
@@ -669,16 +718,36 @@ class AsyncCheckpointer:
             os.fsync(fh.fileno())
 
     def _publish(self, snap: Snapshot) -> None:
-        """Gather every rank's chunk records and atomically publish
-        the manifest from rank 0. The mid_rename injection dies after
-        the tmp write, before the rename — the torn state scan() must
-        never surface."""
+        """Gather every rank's chunk records, atomically publish the
+        manifest from rank 0, then broadcast rank 0's outcome so every
+        rank raises or proceeds to the commit barrier TOGETHER — a
+        rank-0-only failure (disk full at the rename, the mid_rename
+        injection) must not strand peers believing the epoch
+        committed."""
         recs = [dict(c) for c in snap.chunks]
-        if self.comm is not None and self.comm.size > 1:
+        coll = self.comm is not None and self.comm.size > 1
+        if coll:
             gathered = self.comm.allgather(recs)
             recs = [r for per_rank in gathered for r in per_rank]
-        if self._rank != 0:
-            return
+        failure: Optional[Tuple[int, str]] = None
+        if self._rank == 0:
+            try:
+                self._write_manifest(snap, recs)
+            except errors.MPIError as exc:
+                # (class, msg), not the exception: MPIError pickles
+                # its args positionally and would rebuild with the
+                # message in the error_class slot
+                failure = (int(exc.error_class), str(exc))
+        if coll:
+            failure = self.comm.bcast(failure, root=0)
+        if failure is not None:
+            raise errors.MPIError(failure[0], failure[1])
+
+    def _write_manifest(self, snap: Snapshot, recs) -> None:
+        """Rank 0's half of _publish: build the doc and commit it via
+        the atomic manifest rename. The mid_rename injection dies
+        after the tmp write, before the rename — the torn state
+        scan() must never surface."""
         doc = {"version": _manifest.VERSION, "step": snap.step,
                "nranks": self._n, "header": snap.header,
                "parent": snap.header.get("parent"),

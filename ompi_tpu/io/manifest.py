@@ -86,11 +86,20 @@ def write(directory: str, doc: Dict[str, Any]) -> str:
     doc names must already be durable before calling."""
     final = path_for(directory, doc["step"])
     tmp = f"{final}.tmp.{os.getpid()}"
-    with open(tmp, "w") as fh:
-        json.dump(doc, fh, indent=1, sort_keys=True)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, final)
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, final)
+    except OSError as exc:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise errors.MPIError(
+            errors.ERR_FILE,
+            f"{final}: manifest publish failed ({exc})") from exc
     _fsync_dir(directory)
     return final
 

@@ -392,6 +392,172 @@ def test_elastic_async_checkpoint_roundtrip():
     """, 2, timeout=120)
 
 
+def test_incremental_no_inherit_across_layout_change(tmp_path):
+    """A parent whose file layout differs (rank count / padded — the
+    elastic shrink/regrow shape) must not donate chunk records even
+    when digests match: inherited offsets resolve against the CURRENT
+    layout, so crossing a layout change would silently land restored
+    bytes at the wrong position with the digest still verifying."""
+    from ompi_tpu.core import pvar
+    from ompi_tpu.io import manifest
+
+    ck = _ck(tmp_path, incremental=True)
+    tree = _tree(51, elems=20000)
+    ck.save(tree, 1)
+    doc = manifest.load(str(tmp_path), 1)
+    doc["header"]["n"] = 2  # pretend epoch 1 was written 2-rank
+    manifest.write(str(tmp_path), doc)
+    sess = pvar.session()
+    ck.save(tree, 2)
+    assert sess.read("ckpt_incremental_skipped") == 0
+    doc2 = manifest.load(str(tmp_path), 2)
+    assert all(r["file"] == "epoch_2.data" for r in doc2["chunks"])
+    assert doc2.get("parent") is None
+    got, step, _ = ck.restore()
+    assert step == 2
+    _assert_tree_equal(got, tree)
+
+
+def test_manifest_write_oserror_wraps_err_file(tmp_path):
+    """manifest.write keeps AsyncCheckpointer.commit's documented
+    MPIError(ERR_FILE) contract when the OS fails the publish."""
+    from ompi_tpu import errors
+    from ompi_tpu.io import manifest
+
+    target = tmp_path / "not_a_dir"
+    target.write_text("file where the checkpoint dir should be")
+    with pytest.raises(errors.MPIError) as ei:
+        manifest.write(str(target), {"step": 1, "chunks": []})
+    assert ei.value.error_class == errors.ERR_FILE
+
+
+def test_publish_failure_raises_on_every_rank():
+    """A rank-0-only manifest failure (mid_rename: tmp written, rename
+    never happens) must raise on EVERY rank — the outcome bcast keeps
+    peers out of a Barrier they would otherwise wait in forever."""
+    from tests.harness import run_ranks
+
+    run_ranks("""
+        import os, shutil, tempfile
+        from ompi_tpu import errors
+        from ompi_tpu.io import async_ckpt as A
+        from ompi_tpu.runtime import rte
+
+        d = os.path.join(tempfile.gettempdir(),
+                         "async_ckpt_pub_" + rte.jobid)
+        ck = A.AsyncCheckpointer(d, comm=comm)
+        tree = {"w": np.arange(256, dtype=np.float32)}
+        ck.save(tree, 1)
+        A._fail_var.set("mid_rename")
+        try:
+            raised = False
+            try:
+                ck.save(tree, 2)
+            except errors.MPIError:
+                raised = True
+            assert raised, rank  # not just rank 0
+        finally:
+            A._fail_var.set("")
+        got, step, _ = ck.restore()
+        assert step == 1
+        comm.Barrier()
+        if rank == 0:
+            shutil.rmtree(d, ignore_errors=True)
+    """, 2, timeout=120)
+
+
+def test_write_retry_agreement_across_ranks():
+    """A write failure on ONE rank (transient local EIO after the
+    collective exchange) must make every rank retry together — the
+    success vote keeps the failing rank's second _write_collective
+    matched with its peers instead of rank 0 moving on to _publish."""
+    from tests.harness import run_ranks
+
+    run_ranks("""
+        import os, shutil, tempfile
+        from ompi_tpu import errors
+        from ompi_tpu.core import pvar
+        from ompi_tpu.io import async_ckpt as A
+        from ompi_tpu.runtime import rte
+
+        d = os.path.join(tempfile.gettempdir(),
+                         "async_ckpt_vote_" + rte.jobid)
+        ck = A.AsyncCheckpointer(d, comm=comm)
+        tree = {"w": np.arange(4096, dtype=np.float32)}
+        if rank == 1:
+            orig = ck._write_collective
+            state = {"failed": False}
+            def flaky(path, extents, data):
+                orig(path, extents, data)
+                if not state["failed"]:
+                    state["failed"] = True
+                    raise errors.MPIError(
+                        errors.ERR_FILE, "injected local EIO")
+            ck._write_collective = flaky
+        ck.save(tree, 1)
+        # every rank voted and retried, even the one whose own
+        # write succeeded first time
+        assert pvar.snapshot().get("ckpt_write_retries", 0) >= 1
+        got, step, _ = ck.restore()
+        assert step == 1
+        comm.Barrier()
+        if rank == 0:
+            shutil.rmtree(d, ignore_errors=True)
+    """, 2, timeout=120)
+
+
+def test_hot_join_aborts_pending_async_snapshot():
+    """A snapshot begun at a pre-join checkpoint boundary is bound to
+    the old comm; the regrow must drop it (exactly as shrink recovery
+    does) so the post-join boundary begins/commits fresh on the grown
+    comm — deferring the stale commit would run collectives over the
+    freed 2-rank comm the joiner is not part of."""
+    from tests.harness import run_ranks
+
+    run_ranks("""
+        import os, shutil, tempfile
+        from ompi_tpu import elastic
+        from ompi_tpu.io import manifest
+        from ompi_tpu.runtime import rte
+
+        d = os.path.join(tempfile.gettempdir(),
+                         "async_ckpt_join_" + rte.jobid)
+        params = {"w": np.arange(12, dtype=np.float32) / 5.0}
+
+        def grad_fn(p, step, c):
+            import jax
+            return jax.tree.map(
+                lambda a: np.full_like(a, 0.125 * (step + 1)), p)
+
+        proc = None
+        if elastic.is_joiner():
+            ctx, target = elastic.hot_join()
+            out = ctx.run(grad_fn, target)
+        else:
+            ctx = elastic.ElasticContext(comm, params, lr=0.125,
+                                         momentum=0.5,
+                                         checkpoint_dir=d,
+                                         checkpoint_every=2,
+                                         async_checkpoint=True)
+            if rank == 0:
+                proc = elastic.spawn_replacement(mca={"ft": "1"})
+            # snapshot begins at the step-1 boundary (2 ranks),
+            # the join lands at step 3, boundaries at 3 and 5 then
+            # run on the grown comm
+            out = ctx.run(grad_fn, 6, join_at=3)
+            assert ctx.comm.size == 3 and ctx.joins == 1
+        steps = manifest.scan(d)
+        assert steps, "no committed epoch"
+        doc = manifest.load(d, steps[0])
+        assert int(doc["nranks"]) == 3, doc["nranks"]
+        ctx.comm.Barrier()
+        if ctx.comm.rank == 0:
+            shutil.rmtree(d, ignore_errors=True)
+        if proc is not None:
+            assert proc.wait(timeout=60) == 0
+    """, 2, mca={"ft": "1"}, timeout=120)
+
+
 def test_hang_dump_names_in_flight_snapshot(tmp_path):
     """A watchdog dump taken while a snapshot is in flight carries a
     ckpt_snapshot key — 'busy checkpointing', not an anonymous hang."""

@@ -357,10 +357,10 @@ def test_sharded_restore_guards_rank_count_mismatch():
         assert g["m:0"].size == 12
         np.testing.assert_array_equal(
             t3["m:0"], np.array_split(g["m:0"], 3)[0])
+        comm.Barrier()  # everyone done reading before the cleanup
         if rank == 0:
             import shutil
             shutil.rmtree(d, ignore_errors=True)
-        comm.Barrier()
     """, 2)
 
 
