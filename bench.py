@@ -433,6 +433,86 @@ def _bench_zero():
     }
 
 
+def _bench_zero3(steps: int = 10):
+    """ZeRO stage-3 streaming cost card (``--zero3``), on the real
+    singleton comm (size 1 — pure dispatch/layout cost, same caveat
+    as the other single-process cards): a forward+backward layer
+    stream (fetch -> use -> release with layer-ahead prefetch) plus
+    the per-layer reduce_scatter update, against the stage-1 cycle
+    over the same parameters. Reports the residency story the stage
+    exists for — per-rank resident param bytes (high-water) vs the
+    replicated total, ≈ shard + the two-layer prefetch window; the
+    ratio reads ≈ n on a real n-rank run — plus the steady-state
+    prefetch hit rate (the smoke lane asserts 100%) and misses."""
+    import numpy as np
+
+    from ompi_tpu import mpi
+    from ompi_tpu.core import pvar
+    from ompi_tpu.zero import ZeroOptimizer, zero3 as z3
+
+    world = mpi.Init()
+    params = {"embed": np.ones((512, 64), np.float32),
+              "layers": [{"w": np.ones((64, 64), np.float32),
+                          "b": np.zeros((64,), np.float32)}
+                         for _ in range(8)]}
+    grads = {"embed": np.full((512, 64), 0.01, np.float32),
+             "layers": [{"w": np.full((64, 64), 0.01, np.float32),
+                         "b": np.full((64,), 0.01, np.float32)}
+                        for _ in range(8)]}
+
+    opt3 = z3.Zero3Optimizer(world, params, lr=1e-3, momentum=0.9,
+                             deterministic="linear")
+
+    def stream_step():
+        opt3.start_pass()
+        for g in range(opt3.plan.n_layers):
+            with opt3.layer(g):
+                pass
+        opt3.start_pass(reverse=True)
+        for g in reversed(range(opt3.plan.n_layers)):
+            with opt3.layer(g):
+                pass
+        opt3.step(grads)
+
+    stream_step()  # warm (plans, requests, first-gather cache)
+    s = pvar.session()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        stream_step()
+    zero3_ms = (time.perf_counter() - t0) / steps * 1e3
+    hits = s.read("zero_prefetch_hits")
+    misses = s.read("zero_prefetch_misses")
+    resident_hwm = pvar.read("zero3_resident_bytes")
+    opt3.free()
+
+    opt1 = ZeroOptimizer(world, params, lr=1e-3, momentum=0.9,
+                         stage=1, deterministic="linear")
+    opt1.step(grads)  # warm
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        opt1.step(grads)
+    zero1_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    window = 2 * max(opt3.plan.layer_bytes)
+    return {
+        "zero3_step_ms": round(zero3_ms, 3),
+        "zero1_step_ms": round(zero1_ms, 3),
+        "step_vs_stage1": round(zero1_ms / zero3_ms, 3),
+        "param_resident_bytes": int(resident_hwm),
+        "param_shard_bytes": opt3.shard_bytes,
+        "param_replicated_bytes": opt3.replicated_bytes,
+        # > 1.0 = the stream held less than the replicated total;
+        # ≈ n/(1 + n*window/total) on a real n-rank mesh
+        "residency_ratio": round(
+            opt3.replicated_bytes / max(resident_hwm, 1), 4),
+        "residency_bound_ok": bool(
+            resident_hwm <= opt3.shard_bytes + window),
+        "prefetch_hit_rate": round(hits / max(hits + misses, 1), 4),
+        "prefetch_misses_steady": misses,
+        "layers": opt3.plan.n_layers,
+    }
+
+
 def _bench_telemetry():
     """Overhead of being watched (the telemetry plane's cost card):
     flight-recorder enter/exit ns per op, one sampler cycle (pvar
@@ -828,6 +908,9 @@ _EXTRA_BASELINE_KEYS = (
     ("zero", "zero_cycle_32x256k_ms", False),
     ("zero", "fused_cycle_speedup", True),
     ("zero", "rs_launches_per_cycle", False),
+    ("zero3", "zero3_step_ms", False),
+    ("zero3", "residency_ratio", True),
+    ("zero3", "prefetch_hit_rate", True),
     ("ingest", "streamed_cold_s", False),
     ("ingest", "cold_start_speedup", True),
     ("ingest", "ingest_h2d_GBs", True),
@@ -954,6 +1037,13 @@ def main() -> None:
             _phase("zero microbench done")
         except Exception as e:
             _phase(f"zero microbench skipped: {e!r}")
+    zero3 = None
+    if "--zero3" in sys.argv:
+        try:
+            zero3 = _bench_zero3()
+            _phase("zero3 microbench done")
+        except Exception as e:
+            _phase(f"zero3 microbench skipped: {e!r}")
     ingest = None
     if "--ingest" in sys.argv:
         try:
@@ -1012,6 +1102,7 @@ def main() -> None:
                                   {"dispatch": dispatch,
                                    "overlap": overlap,
                                    "zero": zero,
+                                   "zero3": zero3,
                                    "ingest": ingest,
                                    "ckpt": ckpt,
                                    "pallas": pallas})
@@ -1057,6 +1148,7 @@ def main() -> None:
             "telemetry": telemetry,
             "monitoring": monitoring,
             "zero": zero,
+            "zero3": zero3,
             "ingest": ingest,
             "ckpt": ckpt,
             "pallas": pallas,

@@ -1,0 +1,122 @@
+"""ZeRO stage 3 — parameter sharding with layer-ahead prefetch.
+
+Stages 1/2 (examples/zero_optimizer.py) shard gradients and optimizer
+state but every rank still holds ALL parameters. Stage 3 shards the
+parameters too: each rank keeps only its 1/n flat shard, and a
+layer's full weights exist only for the moment they are used — a
+per-layer persistent ``Allgather_multi_init`` request is started one
+layer AHEAD of the consumer (the partitioned plane's Pready-on-
+boundary discipline, scheduled by ``part.overlap.LayerPrefetcher``),
+consumed by ``fetch`` (hit = the gather was already in flight), and
+freed by ``release``. Steady-state residency is the shard plus the
+prefetch window — O(1/n) + two layers, not O(P).
+
+Run:  python -m ompi_tpu.runtime.launcher -n 2 --mca device_plane on \
+          examples/zero3_params.py [summary_dir]
+"""
+
+import json
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+from ompi_tpu import mpi
+from ompi_tpu.core import pvar
+from ompi_tpu.prof import ledger as prof
+from ompi_tpu.zero import Zero3Optimizer
+
+comm = mpi.Init()
+rank, size = comm.rank, comm.size
+
+with prof.phase("staging"):
+    params = {
+        "embed": jnp.ones((256, 32), jnp.float32),
+        "layers": [
+            {"w": jnp.ones((64, 64), jnp.float32) * (i + 1),
+             "b": jnp.zeros((64,), jnp.float32)}
+            for i in range(4)
+        ],
+    }
+    opt = Zero3Optimizer(comm, params, lr=0.1, momentum=0.9,
+                         deterministic="linear")
+
+L = opt.plan.n_layers
+shard = opt.shard_bytes
+replicated = opt.replicated_bytes
+window = 2 * max(opt.plan.layer_bytes)
+
+s = pvar.session()
+with prof.phase("train"):
+    for step in range(4):
+        # forward: stream the layers front to back, each fetched one
+        # ahead of use and freed immediately after
+        opt.start_pass()
+        for g in range(L):
+            with opt.layer(g) as ws:
+                assert len(ws) >= 1
+        # backward: the same stream reversed
+        opt.start_pass(reverse=True)
+        for g in reversed(range(L)):
+            with opt.layer(g):
+                pass
+        grads = {
+            "embed": jnp.full((256, 32), 0.5, jnp.float32),
+            "layers": [
+                {"w": jnp.full((64, 64), 0.5, jnp.float32),
+                 "b": jnp.full((64,), 0.5, jnp.float32)}
+                for _ in range(4)
+            ],
+        }
+        opt.step(grads)
+
+hits = s.read("zero_prefetch_hits")
+misses = s.read("zero_prefetch_misses")
+resident_hwm = pvar.read("zero3_resident_bytes")
+
+# the two stage-3 contracts the smoke lane rides on:
+# 1. the layer-ahead prefetch beat the consumer every single time
+assert misses == 0, f"prefetch misses: {misses}"
+assert hits == 4 * 2 * L, (hits, L)
+# 2. residency never exceeded shard + the two-layer prefetch window
+assert resident_hwm <= shard + window, (resident_hwm, shard, window)
+assert shard * size <= replicated + opt.plan.n_layers * 8 * size, \
+    (shard, replicated)
+
+# the trajectory is replicated even though params never are: compare
+# a gathered probe element across ranks
+full = opt.gathered_params()
+probe = float(np.asarray(full["embed"])[0, 0])
+mean = comm.allreduce(probe) / size
+np.testing.assert_allclose(probe, mean, rtol=0, atol=0)
+
+hit_rate = 100.0 * hits / max(hits + misses, 1)
+if rank == 0:
+    print(f"prefetch hit rate {hit_rate:.0f}% over {hits + misses} "
+          f"fetches ({misses} misses)")
+    print(f"param residency {resident_hwm} B <= shard {shard} B + "
+          f"2-layer window {window} B (replicated {replicated} B, "
+          f"n={size})")
+    ph = prof.phase_seconds()
+    if ph:
+        print("phase ledger: " + ", ".join(
+            f"{k}={v:.3f}s" for k, v in sorted(ph.items())))
+    if len(sys.argv) > 1:
+        os.makedirs(sys.argv[1], exist_ok=True)
+        with open(os.path.join(sys.argv[1],
+                               "zero3_summary.json"), "w") as fh:
+            json.dump({
+                "ranks": size,
+                "layers": L,
+                "prefetch_hits": hits,
+                "prefetch_misses": misses,
+                "prefetch_hit_rate_pct": hit_rate,
+                "param_resident_bytes_hwm": int(resident_hwm),
+                "param_shard_bytes": shard,
+                "param_window_bytes": window,
+                "param_replicated_bytes": replicated,
+            }, fh, indent=1)
+
+opt.free()
+mpi.Finalize()

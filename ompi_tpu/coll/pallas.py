@@ -639,6 +639,42 @@ def allgather_matmul_dev(comm, x, w):
         fl.exit(tok)
 
 
+def zero3_gather_matmul_dev(comm, state, rhs):
+    """ZeRO stage-3 fused gather→use fast path: consume a sharded
+    2-D weight W (a single-bucket single-leaf ShardedState) directly
+    against ``rhs`` as ``allgather_matmul(shard_rows, rhs)`` — the
+    gather of W overlaps the matmul, and the full W is NEVER
+    materialized as a standalone array. Works because a contiguous
+    1/n slice of a row-major (d, f) flatten with d % n == 0 and no
+    pad IS rows [r*d/n, (r+1)*d/n): the flat shard reshapes to this
+    rank's row block and the tensor-parallel kernel's rank-order
+    concat equals the ZeroPlan pack order. Returns the (d, k) product
+    or **None** for every other layout — the zero-3 engine then
+    gathers through the persistent coll/xla allgather and matmuls
+    locally (staged fallthrough)."""
+    plan = getattr(state, "plan", None)
+    shards = getattr(state, "shards", None)
+    ok = (comm.size > 1
+          and plan is not None and shards is not None
+          and len(plan.buckets) == 1
+          and len(plan.buckets[0]) == 1
+          and plan.padded[0] == plan.elems[0]
+          and getattr(rhs, "ndim", 0) == 2
+          and str(getattr(rhs, "dtype", "")) in _SUPPORTED_DTYPES
+          and str(plan.dtypes[0]) in _SUPPORTED_DTYPES)
+    if ok:
+        shape = state.metas[plan.buckets[0][0]][0]
+        ok = (len(shape) == 2
+              and int(shape[0]) % comm.size == 0
+              and int(shape[1]) == int(rhs.shape[0]))
+    if not ok:
+        pvar.record("pallas_fallthrough")
+        return None
+    block = shards[0].reshape(int(shape[0]) // comm.size,
+                              int(shape[1]))
+    return allgather_matmul_dev(comm, block, rhs)
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -671,4 +707,5 @@ class CollPallas(CollModule):
             # fused compute+comm kernels (pallas-only slots)
             "fused_rs_update_dev": fused_rs_update_dev,
             "allgather_matmul_dev": allgather_matmul_dev,
+            "zero3_gather_matmul_dev": zero3_gather_matmul_dev,
         }

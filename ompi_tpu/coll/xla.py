@@ -1485,7 +1485,46 @@ class PersistentDeviceRequest:
         self._inner: Optional[DeviceRequest] = None
 
     def start(self) -> None:
+        if self._launch is None:
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                "start: persistent request already freed (MPI calls "
+                "starting a freed request erroneous)")
         self._inner = DeviceRequest(self._launch())
+
+    def rebind(self, *args, **kwargs) -> None:
+        """Rebind the request's operands to fresh values of the SAME
+        signature without re-planning or re-compiling — the zero-3
+        parameter-stream hook (the optimizer replaces its shard
+        arrays every step; the per-layer allgather keeps its cached
+        executable and only swaps the bound inputs). Only preps that
+        install a ``rebind`` hook support it; the trivial gated paths
+        (size-1 comms, empty states) raise ERR_NOT_SUPPORTED and the
+        caller re-inits instead (init is free there — there is no
+        prep to redo)."""
+        if self._launch is None:
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                "rebind: persistent request already freed")
+        if self.active:
+            raise errors.MPIError(
+                errors.ERR_REQUEST,
+                "rebind: cycle still active — wait() it to "
+                "completion before swapping operands")
+        rb = getattr(self._launch, "rebind", None)
+        if rb is None:
+            raise errors.MPIError(
+                errors.ERR_NOT_SUPPORTED,
+                "rebind: this persistent request binds per start "
+                "(trivial/gated path) — free() and re-init instead")
+        rb(*args, **kwargs)
+
+    def discard(self) -> None:
+        """Drop the completed cycle's result so its device arrays can
+        be reclaimed — the zero-3 free-after-use hook (a gathered
+        layer's full parameters would otherwise stay pinned by
+        ``.array`` until the next start). The request stays usable."""
+        self._inner = None
 
     @property
     def active(self) -> bool:
@@ -1520,7 +1559,14 @@ class PersistentDeviceRequest:
         pass
 
     def free(self) -> None:
-        pass
+        # release the launcher's bound operands (the param shards /
+        # gathered results it pins) and the last cycle's arrays; a
+        # start() after free raises ERR_REQUEST per MPI
+        rel = getattr(self._launch, "release", None)
+        if rel is not None:
+            rel()
+        self._launch = None
+        self._inner = None
 
 
 def _pinit(fn):
@@ -1787,7 +1833,12 @@ def _zero_state_check(comm, state) -> None:
 def _allgather_multi_prep(comm, state):
     """Compile + bind the bucketed allgather NOW (operand = the
     state's current shards; like every persistent device collective
-    the binding is per-init — jax arrays are immutable)."""
+    the binding is per-init — jax arrays are immutable). The returned
+    launcher carries two hooks the persistent form exposes for the
+    zero-3 parameter stream: ``rebind(new_state)`` swaps the bound
+    shard arrays for a same-plan state with NO re-planning or
+    re-compiling (the optimizer replaces its shards every step), and
+    ``release()`` drops the bound operands so nothing pins them."""
     ctx = _ctx(comm)
     _zero_state_check(comm, state)
     plan, metas = state.plan, state.metas
@@ -1795,7 +1846,7 @@ def _allgather_multi_prep(comm, state):
     for b, idxs in enumerate(plan.buckets):
         fn = _zero_ag_fn(ctx, metas, idxs, plan.elems[b],
                          plan.padded[b] - plan.elems[b])
-        launches.append((fn, ctx.to_global(state.shards[b]), idxs))
+        launches.append([fn, ctx.to_global(state.shards[b]), idxs])
 
     import jax
 
@@ -1804,6 +1855,11 @@ def _allgather_multi_prep(comm, state):
     def launch():
         outs = [None] * n_leaves
         for fn, g, idxs in launches:
+            if g is None:
+                raise errors.MPIError(
+                    errors.ERR_REQUEST,
+                    "allgather_multi start: operands released — "
+                    "rebind() a fresh state first")
             res = ctx.launch(fn, g)
             for j, i in enumerate(idxs):
                 outs[i] = ctx.my_shard(res[j])
@@ -1811,6 +1867,23 @@ def _allgather_multi_prep(comm, state):
         pvar.record("zero_fused_bytes", plan.nbytes)
         return jax.tree.unflatten(state.treedef, outs)
 
+    def rebind(new_state) -> None:
+        _zero_state_check(comm, new_state)
+        if new_state.plan.buckets != plan.buckets:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                "allgather_multi rebind: state packed by a different "
+                "plan (the compiled programs are layout-specialized; "
+                "re-init for a new bucket layout)")
+        for b, entry in enumerate(launches):
+            entry[1] = ctx.to_global(new_state.shards[b])
+
+    def release() -> None:
+        for entry in launches:
+            entry[1] = None
+
+    launch.rebind = rebind
+    launch.release = release
     return launch
 
 
@@ -1842,6 +1915,43 @@ def allgather_multi_dev(comm, state):
         return _allgather_multi_prep(comm, state)()
     finally:
         fl.exit(tok)
+
+
+def allgather_multi_bucket_dev(comm, state, b: int):
+    """Gather ONE bucket of a ShardedState: the member leaves (in
+    ``plan.buckets[b]`` order) of the full tree, through the same
+    cached per-bucket executable as allgather_multi_dev. The
+    bucket-granular form the ZeroOptimizer dirty-skip path uses —
+    buckets whose shards did not change this step reuse the previous
+    cycle's gathered leaves instead of relaunching (the
+    ``zero_ag_skipped`` accounting lives with the caller)."""
+    pvar.record("coll_xla_device")
+    _zero_state_check(comm, state)
+    plan, metas = state.plan, state.metas
+    if not 0 <= b < len(plan.buckets):
+        raise errors.MPIError(
+            errors.ERR_COUNT,
+            f"allgather_multi_bucket: bucket {b} out of range for a "
+            f"{len(plan.buckets)}-bucket plan")
+    idxs = plan.buckets[b]
+    if comm.size == 1:
+        # the n=1 shard IS the full padded bucket: unpack locally
+        flat = state.shards[b]
+        outs, off = [], 0
+        for i in idxs:
+            shape = metas[i][0]
+            k = 1
+            for s in shape:
+                k *= int(s)
+            outs.append(flat[off:off + k].reshape(shape))
+            off += k
+        return outs
+    ctx = _ctx(comm)
+    fn = _zero_ag_fn(ctx, metas, idxs, plan.elems[b],
+                     plan.padded[b] - plan.elems[b])
+    res = ctx.launch(fn, ctx.to_global(state.shards[b]))
+    pvar.record("zero_ag_launches")
+    return [ctx.my_shard(r) for r in res]
 
 
 def _multi_state_empty(comm, state, *a, **k) -> bool:
@@ -2594,6 +2704,7 @@ class CollXla(CollModule):
                 reduce_scatter_multi_init_dev,
             "allgather_multi_dev": allgather_multi_dev,
             "allgather_multi_init_dev": allgather_multi_init_dev,
+            "allgather_multi_bucket_dev": allgather_multi_bucket_dev,
             "preduce_scatter_init_dev": preduce_scatter_init_dev,
             "reduce_dev": reduce_dev,
             "bcast_dev": bcast_dev,

@@ -49,6 +49,20 @@ WELL_KNOWN = (
     # buckets dispatched before the cycle's final Pready
     "zero_rs_launches", "zero_ag_launches", "zero_fused_bytes",
     "zero_pad_bytes", "zero_overlap_flushes",
+    # stage-1/2 allgather dirty-skip: buckets whose shards did not
+    # change this step (frozen leaves) reuse the previous cycle's
+    # gathered leaves instead of relaunching
+    "zero_ag_skipped",
+    # zero-3 parameter stream: prefetch accounting (hit = the
+    # layer-ahead gather was already issued when the consumer
+    # arrived; late_ns = wall blocked on a prefetched-but-unfinished
+    # gather), layer gather/release traffic, fused gather→matmul
+    # consumptions, and the residency watermarks the O(1/n)+window
+    # claim is asserted against
+    "zero_prefetch_hits", "zero_prefetch_misses",
+    "zero_prefetch_late_ns", "zero3_gathers", "zero3_releases",
+    "zero3_fused_matmuls", "zero3_resident_bytes",
+    "zero3_shard_bytes", "zero3_layer_bytes",
     "put", "get", "accumulate", "win_lock",
     "eager", "rndv", "rget",
     "time_progress_ns",
@@ -72,6 +86,9 @@ WELL_KNOWN = (
     # snapshot || train overlap accrues into prof_phase_overlap_ns
     # (the proof the ckpt smoke lane asserts on)
     "prof_phase_snapshot_ns",
+    # zero-3 blocked prefetch waits run under "prefetch" — train-loop
+    # wall lost to gathers the layer-ahead scheduler failed to hide
+    "prof_phase_prefetch_ns",
     # cross-thread phase overlap (ingest: staging || compile run
     # concurrently, so per-phase walls may sum past the job wall —
     # this counter quantifies the legitimately-double-counted span)

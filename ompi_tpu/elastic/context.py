@@ -138,6 +138,20 @@ class ElasticContext:
                  checkpoint_every: int = 0,
                  poll_joins: bool = False,
                  async_checkpoint: bool = False) -> None:
+        if stage == 3:
+            # the shrink/regrow arithmetic (elastic/reshard) re-shards
+            # grad/momentum state only — silently accepting a
+            # parameter-sharded optimizer would corrupt params at the
+            # first shrink. Refuse at construction, loudly.
+            raise errors.MPIError(
+                errors.ERR_NOT_SUPPORTED,
+                "ElasticContext: ZeRO stage-3 (parameter-sharded) "
+                "training is not elastic yet — shrink/regrow "
+                "re-shards gradient/momentum state only and would "
+                "corrupt sharded parameters. Train stage 3 via "
+                "ompi_tpu.zero.zero3.Zero3Optimizer without "
+                "elasticity, or use stage 1/2 here (elastic param "
+                "re-shard is future ROADMAP work).")
         self._init_state(
             dict(lr=lr, momentum=momentum, stage=stage,
                  deterministic=deterministic,

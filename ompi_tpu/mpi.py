@@ -851,10 +851,14 @@ def _Allgather_multi(self, state):
 
 
 def _Allgather_multi_init(self, state) -> rq.Request:
-    """Persistent form of Allgather_multi bound to the state object:
-    each Start()+Wait() re-gathers state's CURRENT shards (the
-    optimizer mutates them in place between cycles); req.array holds
-    the rebuilt pytree. Device shards only."""
+    """Persistent form of Allgather_multi: plan + compile + bind the
+    state's shards at init (jax arrays are immutable — the binding is
+    per-init, like every persistent device collective); each
+    Start()+Wait() is one cached launch per bucket, req.array holds
+    the rebuilt pytree. ``req.rebind(new_state)`` swaps in a same-plan
+    state's fresh shards with no re-planning (the zero-3 parameter
+    stream's per-step refresh); ``req.discard()`` drops a completed
+    cycle's gathered arrays (free-after-use). Device shards only."""
     self.check_revoked()
     self.check_failed()
     shards = getattr(state, "shards", None)

@@ -39,6 +39,6 @@ from ompi_tpu.part.host import (  # noqa: F401
     PartitionedSendRequest,
 )
 from ompi_tpu.part.overlap import (  # noqa: F401
-    GradientSync, ZeroGradientSync,
+    GradientSync, LayerPrefetcher, ZeroGradientSync,
 )
 from ompi_tpu.part.partial import PartialAvailability  # noqa: F401

@@ -20,6 +20,7 @@ string of the template (``keystr`` form, e.g. ``"['layers'][0]['w']"``)
 
 from __future__ import annotations
 
+from ompi_tpu import errors
 from ompi_tpu import op as op_mod
 
 
@@ -68,6 +69,74 @@ class GradientSync:
 
     def free(self) -> None:
         self._req.free()
+
+
+class LayerPrefetcher:
+    """Run-ahead scheduler for per-layer gathers — the ZeRO stage-3
+    parameter stream's timing brain.
+
+    The zero-3 engine gathers one layer's parameters at a time and
+    frees them after use; hiding the gather latency requires the NEXT
+    layer's gather to already be in flight when the consumer arrives
+    (the FSDP prefetch rule, expressed over this repo's persistent
+    ``Allgather_multi_init`` requests: ``start()`` here plays the role
+    ``Pready`` plays on the send side — it fires the layer-boundary
+    event that releases the next gather). This class only decides
+    WHEN: the ``start(layer)`` callback owns the how.
+
+    A pass opens with :meth:`begin` (fires the first ``depth``
+    gathers); every consumer arrival calls :meth:`advance`, which
+    tops the in-flight window back up to ``depth`` layers beyond the
+    consumer's position. Layers may be visited in any order of the
+    declared pass order — the window is positional, so a reversed
+    order models the backward pass. Hit/miss accounting (did the
+    scheduler beat the consumer?) stays with the caller, which is the
+    only side that knows whether a gather had actually completed."""
+
+    def __init__(self, start, depth: int = 1) -> None:
+        if depth < 0:
+            raise errors.MPIError(
+                errors.ERR_ARG, f"LayerPrefetcher: depth {depth} < 0")
+        self._start = start
+        self._depth = int(depth)
+        self._order = []
+        self._pos = {}
+        self._next = 0
+
+    def begin(self, order) -> None:
+        """Open a pass over ``order`` (layer ids, consumer order);
+        fires the first ``depth`` gathers immediately so layer 0 is
+        already in flight before the consumer reaches it."""
+        self._order = list(order)
+        self._pos = {g: i for i, g in enumerate(self._order)}
+        self._next = 0
+        self._fill(self._depth - 1)
+
+    def advance(self, layer) -> None:
+        """Consumer reached ``layer``: extend the in-flight window to
+        ``depth`` layers past it. Unknown layers (fetched outside the
+        declared order) are the caller's miss to account — no-op
+        here."""
+        pos = self._pos.get(layer)
+        if pos is not None:
+            self._fill(pos + self._depth)
+
+    def _fill(self, upto: int) -> None:
+        while self._next <= upto and self._next < len(self._order):
+            g = self._order[self._next]
+            self._next += 1
+            self._start(g)
+
+    @property
+    def issued(self) -> int:
+        """Gathers fired so far this pass."""
+        return self._next
+
+    def reset(self) -> None:
+        """Abandon the pass (no further starts until begin())."""
+        self._order = []
+        self._pos = {}
+        self._next = 0
 
 
 class ZeroGradientSync(GradientSync):

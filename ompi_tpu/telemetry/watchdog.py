@@ -283,6 +283,15 @@ class Watchdog:
         snap = _ackpt.snapshot_info()
         if snap is not None:
             doc["ckpt_snapshot"] = snap
+        # a rank blocked in a zero-3 parameter gather is a LATE
+        # PREFETCH (the layer-ahead scheduler lost the race), not a
+        # lost peer — name the layer so the dump reads as an overlap
+        # tuning problem instead of a false hang (optional key)
+        from ompi_tpu.zero import zero3 as _zero3
+
+        pf = _zero3.prefetch_info()
+        if pf is not None:
+            doc["zero3_prefetch"] = pf
         # a congested ICI link is another likely hang cause: name this
         # rank's hottest link + its top peer (optional key, level 2)
         from ompi_tpu.monitoring import matrix as _mon

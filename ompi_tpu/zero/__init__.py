@@ -10,11 +10,18 @@ bucket to a multiple of the comm size so it lowers to ONE
 :class:`~ompi_tpu.zero.optimizer.ZeroOptimizer` wraps it into the
 shard-grad -> local-update -> allgather-params training step with
 O(1/n) optimizer state per rank (ZeRO stages 1/2).
+:class:`~ompi_tpu.zero.zero3.Zero3Optimizer` extends the cycle to
+stage 3 — parameters themselves sharded, streamed layer by layer
+through per-layer persistent allgathers prefetched one layer ahead
+and freed after use (O(1/n) + two-layer residency).
 """
 
 from ompi_tpu.zero.layout import (  # noqa: F401
-    ShardedState, ZeroPlan, plan_for,
+    ShardedState, ZeroPlan, layer_groups, plan_for,
 )
 from ompi_tpu.zero.optimizer import (  # noqa: F401
     ZeroOptimizer, ZeroShardedState,
+)
+from ompi_tpu.zero.zero3 import (  # noqa: F401
+    Zero3Optimizer, Zero3Plan, prefetch_info,
 )

@@ -86,6 +86,29 @@ def plan_for(leaves, n: int, bucket_bytes: Optional[int] = None
     return ZeroPlan(_fuse_metas(leaves), bb, n)
 
 
+def layer_groups(template) -> Tuple[Tuple[str, Tuple[int, ...]], ...]:
+    """Ordered (name, leaf_indices) layer grouping of a pytree — the
+    unit of ZeRO stage-3 parameter streaming (gather a layer, use it,
+    free it). Leaves group by the TOP component of their jax key path;
+    when that component holds a sequence the second component joins
+    the key, so ``layers[0]``, ``layers[1]``, … are separate layers
+    (the transformer-block shape) while ``{"embed": …}`` stays one.
+    Groups are ordered by first appearance in flatten order — the
+    forward-pass order a prefetch scheduler runs ahead of.
+    Deterministic in the treedef: every rank derives the same grouping
+    locally, no agreement needed."""
+    import jax
+
+    paths, _ = jax.tree_util.tree_flatten_with_path(template)
+    groups: dict = {}
+    for i, (path, _leaf) in enumerate(paths):
+        depth = 2 if (len(path) > 1 and isinstance(
+            path[1], jax.tree_util.SequenceKey)) else 1
+        key = jax.tree_util.keystr(path[:depth]) if path else ""
+        groups.setdefault(key, []).append(i)
+    return tuple((k, tuple(v)) for k, v in groups.items())
+
+
 def _xp(arrs):
     """jnp for jax arrays, numpy otherwise (one code path packs both
     the device and host layouts)."""
@@ -152,10 +175,15 @@ class ShardedState:
         return self.plan.nbytes
 
     # -- local elementwise math (the optimizer update) --------------------
-    def map(self, fn, *others: "ShardedState") -> "ShardedState":
+    def map(self, fn, *others: "ShardedState", where=None
+            ) -> "ShardedState":
         """New state with ``fn(self.shards[b], *others.shards[b])`` per
         bucket — the local-shard update step (runs on whatever array
-        type the shards are; no collective)."""
+        type the shards are; no collective). ``where`` (optional
+        per-bucket bool mask) limits the update to selected buckets:
+        unselected buckets keep their shard AND their version counter,
+        which is what lets a downstream allgather prove "this bucket
+        did not change" (the frozen-leaf skip path)."""
         for o in others:
             if o.plan.buckets != self.plan.buckets \
                     or o.plan.n != self.plan.n:
@@ -164,11 +192,20 @@ class ShardedState:
                     "ShardedState.map: operand packed by a different "
                     "plan (shard-wise math requires identical bucket "
                     "layouts)")
+        if where is not None and len(where) != len(self.shards):
+            raise errors.MPIError(
+                errors.ERR_COUNT,
+                f"ShardedState.map: where mask has {len(where)} "
+                f"entries for {len(self.shards)} buckets")
         shards = [fn(s, *(o.shards[b] for o in others))
+                  if where is None or where[b] else s
                   for b, s in enumerate(self.shards)]
         return ShardedState(self.plan, self.metas, self.treedef,
                             shards, self.rank, self.n,
-                            versions=[v + 1 for v in self.versions])
+                            versions=[v + 1 if where is None or where[b]
+                                      else v
+                                      for b, v in
+                                      enumerate(self.versions)])
 
     def zeros_like(self) -> "ShardedState":
         xp = _xp(self.shards)
@@ -263,6 +300,31 @@ def host_reduce_scatter_multi(comm, bufs, op=op_mod.SUM
     pvar.record("zero_pad_bytes", plan.pad_bytes)
     return ShardedState(plan, metas, treedef, k_shards, rank,
                         comm.size)
+
+
+def host_allgather_bucket(comm, state: ShardedState, b: int):
+    """Gather ONE bucket of a numpy ShardedState: the member leaves
+    (in ``plan.buckets[b]`` order) reshaped to their original shapes.
+    The bucket-granular form the optimizer's dirty-skip path uses —
+    unchanged buckets reuse the previous cycle's gathered leaves
+    instead of relaunching."""
+    plan = state.plan
+    if not 0 <= b < len(plan.buckets):
+        raise errors.MPIError(
+            errors.ERR_COUNT,
+            f"host_allgather_bucket: bucket {b} out of range for a "
+            f"{len(plan.buckets)}-bucket plan")
+    parts = comm.coll.allgather_obj(
+        comm, np.ascontiguousarray(state.shards[b]))
+    full = np.concatenate(parts)
+    pvar.record("zero_ag_launches")
+    outs, off = [], 0
+    for i in plan.buckets[b]:
+        shape = state.metas[i][0]
+        k = _elems_of(shape)
+        outs.append(full[off:off + k].reshape(shape))
+        off += k
+    return outs
 
 
 def host_allgather_multi(comm, state: ShardedState):

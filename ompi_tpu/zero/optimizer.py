@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ompi_tpu import errors, op as op_mod
+from ompi_tpu.core import pvar
 from ompi_tpu.zero import layout as _layout
 
 
@@ -84,6 +85,15 @@ class ZeroOptimizer:
       back to the unfused sequence below, the same staged-fallthrough
       shape the device collectives use. Bit-identical to unfused
       under ``deterministic='linear'``.
+    - ``frozen`` (optional pytree of bools matching ``params``): True
+      marks a non-trainable leaf. Buckets whose members are ALL
+      frozen are excluded from the shard update (their
+      ``ShardedState.versions`` counter never bumps), and the
+      allgather tail skips re-gathering them — the previous cycle's
+      gathered leaves are reused, with ``zero_ag_skipped`` counting
+      the skipped launches. Mutually exclusive with ``fused`` (the
+      fused kernel updates whole buckets unconditionally and rebuilds
+      states with reset version counters).
     """
 
     def __init__(self, comm, params, lr: float = 1e-3,
@@ -91,13 +101,15 @@ class ZeroOptimizer:
                  deterministic: Optional[str] = None,
                  overlap: bool = False,
                  grad_average: bool = True,
-                 fused: bool = False) -> None:
+                 fused: bool = False,
+                 frozen=None) -> None:
         if stage not in (1, 2):
             raise errors.MPIError(
                 errors.ERR_ARG,
                 f"ZeroOptimizer: stage={stage} (ZeRO stages 1 and 2 "
                 "shard state/gradients; stage 3 parameter sharding "
-                "is not implemented)")
+                "lives in ompi_tpu.zero.zero3.Zero3Optimizer — the "
+                "streaming surface differs, it is not a flag here)")
         if overlap and stage != 2:
             raise errors.MPIError(
                 errors.ERR_ARG,
@@ -111,6 +123,13 @@ class ZeroOptimizer:
                 "gradient in-kernel — stage 2 only, and mutually "
                 "exclusive with overlap (the partitioned request "
                 "already owns the reduce_scatter)")
+        if fused and frozen is not None:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                "ZeroOptimizer: frozen leaves require the unfused "
+                "step (the fused kernel updates whole buckets "
+                "unconditionally, losing the version counters the "
+                "allgather skip is proven by)")
         self._comm = comm
         self._lr = float(lr)
         self._mu = float(momentum)
@@ -132,6 +151,24 @@ class ZeroOptimizer:
         import jax
 
         self._n_leaves = len(jax.tree.leaves(params))
+        #: per-bucket "has a trainable member" mask (None: everything
+        #: trains); all-frozen buckets skip the update AND the
+        #: re-gather (their versions prove they did not change)
+        self._bucket_live = None
+        self._frozen_leaves = None
+        self._ag_versions = None
+        self._ag_leaves: dict = {}
+        if frozen is not None:
+            fl = jax.tree.leaves(frozen)
+            if len(fl) != self._n_leaves:
+                raise errors.MPIError(
+                    errors.ERR_COUNT,
+                    f"ZeroOptimizer: {len(fl)} frozen flags for a "
+                    f"{self._n_leaves}-leaf parameter pytree")
+            self._frozen_leaves = [bool(f) for f in fl]
+            self._bucket_live = [
+                any(not fl[i] for i in idxs)
+                for idxs in self._pshards.plan.buckets]
 
     # -- one training step -------------------------------------------------
     def _grad_shards(self, grads) -> _layout.ShardedState:
@@ -178,7 +215,7 @@ class ZeroOptimizer:
         # constants cast to the shard dtype: a bare python float would
         # upcast numpy f32 shards to f64 (dtype drift across the
         # host/device paths would break the bit-identity contract)
-        g = self._grad_shards(grads)
+        g = self._grad_shards(self._mask_frozen(grads))
         if self._avg:
             inv = 1.0 / self._comm.size
             g = g.map(lambda s: s * np.asarray(inv, s.dtype))
@@ -186,18 +223,74 @@ class ZeroOptimizer:
         if mom is not None:
             mom = mom.map(
                 lambda v, gs: np.asarray(self._mu, v.dtype) * v + gs,
-                g)
+                g, where=self._bucket_live)
             self.state.slots["momentum"] = mom
             g = mom
         self._pshards = self._pshards.map(
-            lambda p, gs: p - np.asarray(self._lr, p.dtype) * gs, g)
+            lambda p, gs: p - np.asarray(self._lr, p.dtype) * gs, g,
+            where=self._bucket_live)
         self.state.params = self._pshards
-        return self._comm.Allgather_multi(self._pshards)
+        return self._gather_params()
+
+    def _mask_frozen(self, grads):
+        """Zero the gradients of frozen leaves, so a frozen leaf that
+        shares a bucket with trainable ones stays exactly put when the
+        bucket updates (p - lr*0 == p bitwise; its zero momentum
+        contribution stays zero). All-frozen buckets additionally skip
+        the update entirely via the ``where`` mask below."""
+        if self._frozen_leaves is None:
+            return grads
+        import jax
+
+        leaves, treedef = jax.tree.flatten(grads)
+        leaves = [_layout._xp([g]).zeros_like(g) if fr else g
+                  for g, fr in zip(leaves, self._frozen_leaves)]
+        return jax.tree.unflatten(treedef, leaves)
+
+    def _gather_params(self):
+        """The allgather tail. With frozen leaves, bucket-granular:
+        buckets whose shard versions did not move since the last
+        gather reuse the cached gathered leaves (``zero_ag_skipped``
+        counts them); only dirty buckets relaunch."""
+        st = self._pshards
+        if self._bucket_live is None or all(self._bucket_live):
+            return self._comm.Allgather_multi(st)
+        import jax
+        import numpy as np
+
+        host = bool(st.shards) and isinstance(st.shards[0],
+                                              np.ndarray)
+        bucket_dev = None if host else \
+            self._comm.coll.fns.get("allgather_multi_bucket_dev")
+        if not host and bucket_dev is None:
+            return self._comm.Allgather_multi(st)
+        outs = [None] * self._n_leaves
+        skipped = 0
+        for b, idxs in enumerate(st.plan.buckets):
+            cached = self._ag_leaves.get(b)
+            if (cached is not None and self._ag_versions is not None
+                    and self._ag_versions[b] == st.versions[b]):
+                lb = cached
+                skipped += 1
+            elif host:
+                lb = _layout.host_allgather_bucket(self._comm, st, b)
+            else:
+                lb = bucket_dev(self._comm, st, b)
+            if not self._bucket_live[b]:
+                # only all-frozen buckets can ever be clean again —
+                # caching live buckets would just pin a stale copy
+                self._ag_leaves[b] = lb
+            for j, i in enumerate(idxs):
+                outs[i] = lb[j]
+        if skipped:
+            pvar.record("zero_ag_skipped", skipped)
+        self._ag_versions = list(st.versions)
+        return jax.tree.unflatten(st.treedef, outs)
 
     def params(self):
         """Replicated parameters rebuilt from the current shards (one
         allgather cycle — what ``step`` already returns)."""
-        return self._comm.Allgather_multi(self._pshards)
+        return self._gather_params()
 
     def free(self) -> None:
         if self._req is not None:

@@ -1,0 +1,439 @@
+"""zero/zero3 — ZeRO stage-3 parameter sharding with layer-ahead
+prefetch.
+
+Stage 3 (P\\ :sub:`os+g+p`, Rajbhandari et al. SC'20; FSDP is the
+same idea) shards the PARAMETERS themselves: each rank keeps only its
+1/n flat shard resident and materializes a layer's full weights just
+in time for use, freeing them immediately after. The streaming cycle
+is built entirely out of landed subsystems:
+
+- **layout**: :func:`~ompi_tpu.zero.layout.layer_groups` splits the
+  parameter pytree into layers (the streaming unit); each layer's
+  leaves pack into their own :class:`~ompi_tpu.zero.layout.ZeroPlan`
+  buckets, so a layer gather is the same cached per-bucket tiled
+  all_gather the stage-1/2 cycle uses.
+- **persistent collectives**: one ``Comm.Allgather_multi_init``
+  request per layer, prepped ONCE — plans and compiled programs live
+  in the ``_Ctx`` LRU caches, so steady-state steps never replan or
+  recompile; after the optimizer refreshes a layer's shards the
+  request ``rebind()``\\ s the fresh arrays into the same executable.
+- **the partitioned plane's timing discipline**:
+  :class:`~ompi_tpu.part.overlap.LayerPrefetcher` fires each layer's
+  ``start()`` on the PREVIOUS layer's consumption event (the
+  ``Pready``-on-layer-boundary shape), so the gather for layer k+1
+  is in flight while layer k computes; :meth:`Zero3Optimizer.fetch`
+  is the ``Parrived``-style consumption gate that blocks only when
+  the prefetch lost the race (``zero_prefetch_late_ns`` + the
+  ``prefetch`` trace lane + ``prof.phase('prefetch')`` account the
+  loss; the watchdog names it via :func:`prefetch_info` instead of
+  reporting a false hang).
+- **free-after-use**: :meth:`Zero3Optimizer.release` drops the
+  gathered arrays and ``discard()``\\ s the request's cycle result,
+  so steady-state residency is the O(1/n) shard plus the in-flight
+  prefetch window (``zero3_resident_bytes`` is the high-water proof).
+- **fused fast path**: when coll_pallas is on,
+  :meth:`Zero3Optimizer.matmul` consumes a single-leaf 2-D layer
+  through the ``zero3_gather_matmul_dev`` slot — the tensor-parallel
+  allgather@matmul kernel eats the SHARD directly and the full weight
+  is never materialized; every other layout falls through to the
+  persistent coll/xla gather (staged fallthrough).
+
+Bit-identity: the update math is op-for-op the stage-1/2
+:class:`~ompi_tpu.zero.optimizer.ZeroOptimizer` sequence (same
+dtype-cast constants, same fold order), and 'linear' reduce_scatter /
+all_gather are elementwise identical regardless of how leaves are
+grouped into buckets — so a stage-3 trajectory under
+``deterministic='linear'`` reproduces stage 1 bitwise, momentum
+included (proven in tests/test_zero3.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ompi_tpu import errors, op as op_mod, prof as _prof
+from ompi_tpu.core import pvar
+from ompi_tpu.part.overlap import LayerPrefetcher
+from ompi_tpu.trace import recorder as _trace
+from ompi_tpu.zero import layout as _layout
+
+#: last blocked prefetch, for the watchdog hang dump (a rank stuck in
+#: a gather wait is a LATE PREFETCH, not a lost peer — naming it
+#: turns a false hang report into an actionable one)
+_PREFETCH_INFO: Optional[dict] = None
+
+
+def prefetch_info() -> Optional[dict]:
+    """The most recent blocked-prefetch record ({layer, pos, step,
+    late_ns}) or None if every fetch so far was already complete —
+    read by telemetry.watchdog's hang dump."""
+    return _PREFETCH_INFO
+
+
+class Zero3Plan:
+    """Layer-grouped extension of the ZeroPlan bucket/pad layout.
+
+    :func:`~ompi_tpu.zero.layout.layer_groups` fixes the streaming
+    order; each layer's leaves get their own
+    :class:`~ompi_tpu.zero.layout.ZeroPlan` (same
+    ``coll_xla_bucket_bytes`` close rule, same pad-to-n), so the
+    per-layer gather is the cached per-bucket executable the stage-1/2
+    cycle already compiled. Deterministic in (template treedef/shapes,
+    bucket_bytes, n) — every rank derives the identical plan locally,
+    no agreement needed."""
+
+    __slots__ = ("groups", "plans", "n", "treedef", "n_leaves")
+
+    def __init__(self, template, n: int,
+                 bucket_bytes: Optional[int] = None) -> None:
+        import jax
+
+        leaves, self.treedef = jax.tree.flatten(template)
+        if not leaves:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                "Zero3Plan: empty parameter pytree (nothing to shard)")
+        self.n = int(n)
+        self.n_leaves = len(leaves)
+        self.groups = _layout.layer_groups(template)
+        self.plans = tuple(
+            _layout.plan_for([leaves[i] for i in idxs], self.n,
+                             bucket_bytes)
+            for _name, idxs in self.groups)
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.groups)
+
+    @property
+    def total_bytes(self) -> int:
+        """Bytes of the full replicated parameters."""
+        return sum(p.nbytes for p in self.plans)
+
+    @property
+    def layer_bytes(self):
+        """Full (gathered) bytes per layer, in streaming order."""
+        return tuple(p.nbytes for p in self.plans)
+
+    def name_of(self, g: int) -> str:
+        return self.groups[g][0]
+
+
+class Zero3Optimizer:
+    """SGD(+momentum) with fully sharded parameters (ZeRO stage 3).
+
+    Unlike stages 1/2 there is no replicated parameter pytree: the
+    training loop streams layers through the optimizer —
+
+    >>> opt.start_pass()                    # forward: prefetch ahead
+    >>> for g in range(opt.plan.n_layers):
+    ...     with opt.layer(g) as ws:        # fetch -> use -> release
+    ...         acts = forward_layer(ws, acts)
+    >>> opt.step(grads)                     # reduce_scatter + update
+
+    - :meth:`start_pass` opens a forward (or ``reverse=True``
+      backward) pass: the prefetcher fires the first ``depth`` layer
+      gathers immediately and keeps the window topped up as layers
+      are consumed.
+    - :meth:`fetch` returns layer ``g``'s full leaves, blocking only
+      if the prefetched gather has not finished (hit/miss/late pvars;
+      a fetch outside the prefetch window counts a miss and gathers
+      on the spot).
+    - :meth:`release` frees the gathered arrays (and the persistent
+      request's held cycle) — O(1/n) + window residency.
+    - :meth:`step` reduce_scatters the gradients per layer, runs the
+      exact stage-1/2 shard-update math, and rebinds each layer's
+      persistent allgather to the fresh shards (``rebind``; gated
+      trivial requests re-init, same cost).
+    - :meth:`matmul` is the fused gather→use fast path (coll_pallas
+      ``zero3_gather_matmul_dev``), falling through to fetch + dot.
+
+    Host (numpy) parameters run the same cycle over the stacked host
+    collectives — prefetch degrades to eager blocking gathers (every
+    prefetched fetch is a hit; there is just no overlap to win).
+    """
+
+    def __init__(self, comm, params, lr: float = 1e-3,
+                 momentum: float = 0.0,
+                 deterministic: Optional[str] = None,
+                 grad_average: bool = True,
+                 prefetch_depth: int = 1) -> None:
+        import jax
+
+        self._comm = comm
+        self._lr = float(lr)
+        self._mu = float(momentum)
+        self._det = deterministic
+        self._avg = bool(grad_average)
+        self.plan = Zero3Plan(params, comm.size)
+        leaves = jax.tree.leaves(params)
+        from ompi_tpu import accelerator
+
+        self._dev = accelerator.is_device_buffer(leaves[0])
+        # every rank holds the full initial params: each layer's shard
+        # is a local slice (no collective), packed by the layer plan
+        # the collectives will reuse
+        self._pstates: List[_layout.ShardedState] = [
+            _layout.ShardedState.from_full(
+                comm, [leaves[i] for i in idxs], plan=lplan)
+            for (_n, idxs), lplan in zip(self.plan.groups,
+                                         self.plan.plans)]
+        self._mstates: Optional[List[_layout.ShardedState]] = (
+            [s.zeros_like() for s in self._pstates]
+            if self._mu else None)
+        # one persistent allgather per layer (device path): prepped
+        # once, rebound after every step — zero replans across steps
+        self._reqs = [comm.Allgather_multi_init(s)
+                      for s in self._pstates] if self._dev else None
+        self._prefetcher = LayerPrefetcher(self._start_gather,
+                                           depth=prefetch_depth)
+        self._gathered: Dict[int, list] = {}
+        self._started: set = set()
+        self._step_no = 0
+        pvar.record_hwm("zero3_shard_bytes", self.shard_bytes)
+        pvar.record_hwm("zero3_layer_bytes",
+                        max(self.plan.layer_bytes))
+        pvar.record_hwm("zero3_resident_bytes", self.resident_bytes)
+
+    # -- sizing (the O(1/n)+window story the smoke lane asserts) ----------
+    @property
+    def shard_bytes(self) -> int:
+        """Parameter bytes this rank holds permanently (the shards)."""
+        return sum(s.shard_bytes for s in self._pstates)
+
+    @property
+    def replicated_bytes(self) -> int:
+        """Bytes a replicated (non-stage-3) copy of the params needs."""
+        return self.plan.total_bytes
+
+    @property
+    def resident_bytes(self) -> int:
+        """Parameter bytes resident right now: the shards plus every
+        currently gathered layer (``zero3_resident_bytes`` tracks the
+        high-water mark of this)."""
+        return self.shard_bytes + sum(
+            self._pstates[g].total_bytes for g in self._gathered)
+
+    # -- the prefetch/fetch/release stream --------------------------------
+    def _start_gather(self, g: int) -> None:
+        if g in self._started or g in self._gathered:
+            return
+        pvar.record("zero3_gathers")
+        if not self._dev:
+            # host path: no async request to arm — gather eagerly so
+            # a later fetch of a prefetched layer is a hit
+            self._gathered[g] = self._comm.Allgather_multi(
+                self._pstates[g])
+            pvar.record_hwm("zero3_resident_bytes",
+                            self.resident_bytes)
+            return
+        self._reqs[g].start()
+        self._started.add(g)
+        rec = _trace.RECORDER
+        if rec is not None:
+            rec.instant("prefetch_start", "prefetch",
+                        {"layer": self.plan.name_of(g), "pos": g})
+
+    def start_pass(self, reverse: bool = False) -> None:
+        """Open a pass: drop any state left from a previous pass and
+        fire the first ``depth`` gathers of the (possibly reversed —
+        the backward) streaming order."""
+        self._drain()
+        order = range(self.plan.n_layers)
+        self._prefetcher.begin(reversed(order) if reverse else order)
+
+    def fetch(self, g: int) -> list:
+        """Layer ``g``'s full parameter leaves (the layer's flatten
+        order). A prefetched-and-complete gather is a hit; a fetch the
+        prefetcher never issued is a miss (gathered on the spot); a
+        prefetched-but-unfinished gather blocks — the wait is the
+        ``prefetch`` trace span, ``prof.phase('prefetch')`` time and
+        the ``zero_prefetch_late_ns`` pvar."""
+        global _PREFETCH_INFO
+
+        if not 0 <= g < self.plan.n_layers:
+            raise errors.MPIError(
+                errors.ERR_COUNT,
+                f"zero3 fetch: layer {g} out of range for a "
+                f"{self.plan.n_layers}-layer plan")
+        if g in self._gathered:
+            if not self._dev:
+                pvar.record("zero_prefetch_hits")
+            self._prefetcher.advance(g)
+            return self._gathered[g]
+        if not self._dev:
+            pvar.record("zero_prefetch_misses")
+            self._gathered[g] = self._comm.Allgather_multi(
+                self._pstates[g])
+            pvar.record_hwm("zero3_resident_bytes",
+                            self.resident_bytes)
+            self._prefetcher.advance(g)
+            return self._gathered[g]
+        if g in self._started:
+            pvar.record("zero_prefetch_hits")
+        else:
+            pvar.record("zero_prefetch_misses")
+            self._reqs[g].start()
+            self._started.add(g)
+        req = self._reqs[g]
+        if not req.completed:
+            # the prefetch lost the race to the consumer: account the
+            # blocked wait so a long stall reads as "late prefetch of
+            # layer X", not as a hang or unattributed train time
+            t0 = _trace.now()
+            with _prof.phase("prefetch"):
+                req.wait()
+            late = _trace.now() - t0
+            pvar.record("zero_prefetch_late_ns", int(late))
+            _PREFETCH_INFO = {"layer": self.plan.name_of(g),
+                              "pos": g, "step": self._step_no,
+                              "late_ns": int(late)}
+            rec = _trace.RECORDER
+            if rec is not None:
+                rec.record("prefetch_wait", "prefetch", t0,
+                           _trace.now(),
+                           {"layer": self.plan.name_of(g), "pos": g})
+        else:
+            req.wait()
+        self._gathered[g] = req.array
+        # the request's cycle handle would pin the gathered arrays
+        # past release(); drop it now — our dict is the only owner
+        req.discard()
+        self._started.discard(g)
+        pvar.record_hwm("zero3_resident_bytes", self.resident_bytes)
+        self._prefetcher.advance(g)
+        return self._gathered[g]
+
+    def release(self, g: int) -> None:
+        """Free layer ``g``'s gathered parameters (free-after-use —
+        THE stage-3 residency lever). No-op if not gathered."""
+        if self._gathered.pop(g, None) is not None:
+            pvar.record("zero3_releases")
+
+    @contextlib.contextmanager
+    def layer(self, g: int):
+        """``with opt.layer(g) as ws:`` — fetch on entry, release on
+        exit (the use-and-free discipline as a scope)."""
+        try:
+            yield self.fetch(g)
+        finally:
+            self.release(g)
+
+    def matmul(self, g: int, rhs):
+        """Layer ``g``'s (single 2-D leaf) weight @ ``rhs`` — through
+        the fused allgather-matmul kernel when a component provides
+        ``zero3_gather_matmul_dev`` and the layout qualifies (the full
+        weight is never materialized); otherwise fetch + local dot
+        (same result, staged fallthrough)."""
+        fn = self._comm.coll.fns.get("zero3_gather_matmul_dev") \
+            if self._dev else None
+        if fn is not None:
+            out = fn(self._comm, self._pstates[g], rhs)
+            if out is not None:
+                pvar.record("zero3_fused_matmuls")
+                self._prefetcher.advance(g)
+                return out
+        ws = self.fetch(g)
+        if len(ws) != 1:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                f"zero3 matmul: layer {g} has {len(ws)} leaves — the "
+                "gather→matmul path consumes single-weight layers")
+        return ws[0] @ rhs
+
+    def _drain(self) -> None:
+        """Quiesce the stream: wait out in-flight gathers (their
+        results are dropped) and free everything gathered."""
+        for g in list(self._started):
+            self._reqs[g].wait()
+            self._reqs[g].discard()
+        self._started.clear()
+        for g in list(self._gathered):
+            self.release(g)
+        self._prefetcher.reset()
+
+    # -- one training step -------------------------------------------------
+    def step(self, grads) -> None:
+        """Per layer (backward order): reduce_scatter the gradient
+        leaves, run the exact stage-1/2 shard-update math
+        (average -> momentum -> SGD, constants cast to the shard
+        dtype), then rebind the layer's persistent allgather to the
+        fresh shards. No replicated parameters are ever built."""
+        import jax
+
+        self._drain()
+        glaves = jax.tree.leaves(grads)
+        if len(glaves) != self.plan.n_leaves:
+            raise errors.MPIError(
+                errors.ERR_COUNT,
+                f"zero3 step: {len(glaves)} gradient leaves for a "
+                f"{self.plan.n_leaves}-leaf template")
+        for g in reversed(range(self.plan.n_layers)):
+            idxs = self.plan.groups[g][1]
+            gs = self._comm.Reduce_scatter_multi(
+                [glaves[i] for i in idxs], op_mod.SUM,
+                deterministic=self._det)
+            if self._avg:
+                inv = 1.0 / self._comm.size
+                gs = gs.map(lambda s: s * np.asarray(inv, s.dtype))
+            if self._mstates is not None:
+                mom = self._mstates[g].map(
+                    lambda v, sh: np.asarray(self._mu, v.dtype) * v
+                    + sh, gs)
+                self._mstates[g] = mom
+                gs = mom
+            new = self._pstates[g].map(
+                lambda p, sh: p - np.asarray(self._lr, p.dtype) * sh,
+                gs)
+            self._pstates[g] = new
+            self._refresh_req(g, new)
+        self._step_no += 1
+
+    def _refresh_req(self, g: int, state) -> None:
+        if self._reqs is None:
+            return
+        try:
+            self._reqs[g].rebind(state)
+        except errors.MPIError as e:
+            if e.error_class != errors.ERR_NOT_SUPPORTED:
+                raise
+            # gated trivial request (size-1 comm): binds per start —
+            # re-init costs nothing there
+            self._reqs[g].free()
+            self._reqs[g] = self._comm.Allgather_multi_init(state)
+
+    # -- whole-tree views (tests / checkpointing — NOT the hot path) ------
+    def gathered_params(self):
+        """The full parameter pytree, assembled layer by layer (each
+        layer gathered then kept — this materializes O(P); tests and
+        export only)."""
+        return self._gather_tree(self._pstates)
+
+    def gathered_momentum(self):
+        """The full momentum pytree (None without momentum) — the
+        trajectory-comparison hook for the bit-identity tests."""
+        if self._mstates is None:
+            return None
+        return self._gather_tree(self._mstates)
+
+    def _gather_tree(self, states):
+        import jax
+
+        outs = [None] * self.plan.n_leaves
+        for (g, (_name, idxs)) in enumerate(self.plan.groups):
+            fulls = self._comm.Allgather_multi(states[g])
+            for j, i in enumerate(idxs):
+                outs[i] = fulls[j]
+        return jax.tree.unflatten(self.plan.treedef, outs)
+
+    def free(self) -> None:
+        """Release the per-layer persistent requests and every
+        gathered layer."""
+        self._drain()
+        if self._reqs is not None:
+            for r in self._reqs:
+                r.free()
+            self._reqs = None

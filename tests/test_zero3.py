@@ -1,0 +1,505 @@
+"""ZeRO stage 3 (zero/zero3 + the persistent-allgather hooks).
+
+The acceptance contract: a stage-3 trajectory under
+deterministic='linear' is BITWISE identical to stage 1 on 2/3/4-rank
+meshes (momentum shards included — the update math and the fold order
+are shared, and bucket grouping never changes an element's fold);
+steady-state prefetch never misses (the layer-ahead scheduler beats
+the consumer from the first pass) and residency stays within shard +
+the two-layer window; the persistent allgather's rebind/discard/free
+hooks behave per MPI (freed start is erroneous); frozen leaves skip
+their bucket's re-gather with zero_ag_skipped proving it; and
+ElasticContext refuses stage-3 optimizers at construction.
+"""
+
+import pytest
+
+from tests.harness import run_ranks
+
+MCA = {"device_plane": "on"}
+MCA_SMALL = {"device_plane": "on", "coll_xla_bucket_bytes": "2048"}
+MCA_LEAF = {"device_plane": "on", "coll_xla_bucket_bytes": "64"}
+MCA_PALLAS = {"device_plane": "on", "coll_pallas": "on"}
+
+_PARAMS = """
+    import jax.numpy as jnp
+    params = {
+        "embed": jnp.arange(256, dtype=jnp.float32).reshape(16, 16)
+                 / 7.0,
+        "layers": [
+            {"w": jnp.ones((12, 12), jnp.float32) * (i + 1),
+             "b": jnp.linspace(-1.0, 1.0, 12).astype(jnp.float32)}
+            for i in range(3)
+        ],
+    }
+    def grads_for(step):
+        # rank-varying gradients whose mean is still step-dependent:
+        # the averaged update is identical across ranks, so both
+        # stages keep a replicated trajectory to compare
+        return jax.tree.map(
+            lambda p: jnp.full(p.shape,
+                               float(rank + 1) * 0.25 / (step + 1),
+                               p.dtype), params)
+"""
+
+
+@pytest.mark.parametrize("n", [2, 3, 4])
+def test_stage3_bit_identical_to_stage1_linear(n):
+    """Same trajectory bit for bit, stage 3 vs stage 1, momentum
+    shards included — across rank counts that exercise pad (12x12 and
+    16x16 leaves don't divide by 3)."""
+    run_ranks(_PARAMS + """
+    import jax
+    from ompi_tpu.zero import Zero3Optimizer, ZeroOptimizer
+    o3 = Zero3Optimizer(comm, params, lr=0.05, momentum=0.9,
+                        deterministic="linear")
+    o1 = ZeroOptimizer(comm, params, lr=0.05, momentum=0.9, stage=1,
+                       deterministic="linear")
+    for step in range(4):
+        o3.start_pass()
+        for g in range(o3.plan.n_layers):
+            with o3.layer(g):
+                pass
+        o3.step(grads_for(step))
+        ref = o1.step(grads_for(step))
+        got = o3.gathered_params()
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(ref)):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b))
+        m3 = o3.gathered_momentum()
+        m1 = comm.Allgather_multi(o1.state.slots["momentum"])
+        for a, b in zip(jax.tree.leaves(m3), jax.tree.leaves(m1)):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b))
+    o3.free()
+    """, n, mca=MCA_SMALL)
+
+
+def test_prefetch_steady_state_and_residency():
+    """From the very first pass the layer-ahead prefetch beats every
+    fetch (misses == 0, hits == fetches); gathered layers are freed
+    after use (releases == fetches) and the residency high-water
+    stays within shard + the two-layer window."""
+    run_ranks(_PARAMS + """
+    import jax
+    from ompi_tpu.core import pvar
+    from ompi_tpu.zero import Zero3Optimizer
+    o = Zero3Optimizer(comm, params, lr=0.05, momentum=0.9,
+                       deterministic="linear")
+    L = o.plan.n_layers
+    assert L == 4  # embed + 3 transformer blocks (layer_groups)
+    s = pvar.session()
+    steps = 3
+    for step in range(steps):
+        o.start_pass()
+        for g in range(L):
+            with o.layer(g) as ws:
+                assert all(hasattr(w, "shape") for w in ws)
+        o.start_pass(reverse=True)
+        for g in reversed(range(L)):
+            with o.layer(g):
+                pass
+        o.step(grads_for(step))
+    hits = s.read("zero_prefetch_hits")
+    misses = s.read("zero_prefetch_misses")
+    assert misses == 0, misses
+    assert hits == steps * 2 * L, (hits, L)
+    assert s.read("zero3_releases") == steps * 2 * L
+    hwm = pvar.read("zero3_resident_bytes")
+    window = 2 * max(o.plan.layer_bytes)
+    assert hwm <= o.shard_bytes + window, (hwm, o.shard_bytes, window)
+    # O(1/n): the permanent shard is the replicated total / n (up to
+    # per-bucket pad waste)
+    pad = sum(p.pad_bytes for p in o.plan.plans)
+    assert o.shard_bytes <= o.replicated_bytes / size + pad + 8
+    o.free()
+    """, 2, mca=MCA_SMALL)
+
+
+def test_out_of_window_fetch_is_a_miss():
+    """A fetch the prefetcher never issued (jumping past the window)
+    counts a miss, gathers on the spot, and still returns correct
+    values — the accounting contract the smoke lane's 100% assert
+    rides on."""
+    run_ranks(_PARAMS + """
+    from ompi_tpu.core import pvar
+    from ompi_tpu.zero import Zero3Optimizer
+    o = Zero3Optimizer(comm, params, lr=0.05, deterministic="linear")
+    s = pvar.session()
+    o.start_pass()
+    ws = o.fetch(3)   # depth-1 window started layer 0 only
+    assert s.read("zero_prefetch_misses") == 1
+    # leaves follow template flatten order within the layer: b, w
+    np.testing.assert_array_equal(
+        np.asarray(ws[0]), np.asarray(params["layers"][2]["b"]))
+    np.testing.assert_array_equal(
+        np.asarray(ws[1]), np.asarray(params["layers"][2]["w"]))
+    o.release(3)
+    o.free()
+    """, 2, mca=MCA)
+
+
+def test_layer_prefetcher_window():
+    """Unit semantics of the run-ahead scheduler: begin fires depth
+    gathers, every advance tops the window up, unknown layers no-op,
+    reset stops the stream."""
+    from ompi_tpu.part.overlap import LayerPrefetcher
+
+    fired = []
+    pf = LayerPrefetcher(fired.append, depth=2)
+    pf.begin([10, 11, 12, 13, 14])
+    assert fired == [10, 11]
+    pf.advance(10)
+    assert fired == [10, 11, 12]
+    pf.advance(12)
+    assert fired == [10, 11, 12, 13, 14]
+    pf.advance(99)  # unknown layer: caller's miss, no-op here
+    assert pf.issued == 5
+    pf.reset()
+    pf.advance(13)
+    assert fired == [10, 11, 12, 13, 14]
+    # reversed order models the backward pass
+    fired.clear()
+    pf.begin(reversed(range(3)))
+    assert fired == [2, 1]
+    from ompi_tpu import errors
+    with pytest.raises(errors.MPIError):
+        LayerPrefetcher(fired.append, depth=-1)
+
+
+def test_gradient_sync_composed_with_persistent_allgather():
+    """part/overlap GradientSync feeding a persistent
+    Allgather_multi_init — the composition the overlap docstring
+    promises: out-of-order pushes, a local shard update, the
+    persistent gather rebound to the fresh shards, restarted across
+    cycles, then freed (a started freed request is erroneous)."""
+    run_ranks("""
+    import jax
+    import jax.numpy as jnp
+    from ompi_tpu import errors
+    from ompi_tpu.part import GradientSync
+    from ompi_tpu.zero import layout as zl
+    template = [jnp.zeros((40,), jnp.float32),
+                jnp.zeros((6, 5), jnp.float32),
+                jnp.zeros((17,), jnp.float32)]
+    sync = GradientSync(comm, template, deterministic="linear")
+    pstate = zl.ShardedState.from_full(
+        comm, [jnp.ones((40,), jnp.float32),
+               jnp.full((6, 5), 2.0, jnp.float32),
+               jnp.full((17,), 3.0, jnp.float32)])
+    req = comm.Allgather_multi_init(pstate)
+    for cycle in range(3):
+        sync.start()
+        for i in reversed(range(sync.n_leaves)):   # any order
+            sync.push(i, jnp.full(template[i].shape,
+                                  float(rank + cycle), jnp.float32))
+        summed = sync.finish()
+        ref = sum(range(size)) + size * cycle
+        for leaf in summed:
+            np.testing.assert_array_equal(
+                np.asarray(leaf),
+                np.full(leaf.shape, float(ref), np.float32))
+        # local shard update -> rebind -> the SAME compiled gather
+        gstate = zl.ShardedState.from_full(comm, summed,
+                                           plan=pstate.plan)
+        pstate = pstate.map(
+            lambda p, g: p - np.asarray(0.1, p.dtype) * g, gstate)
+        req.rebind(pstate)
+        req.start()
+        req.wait()
+        outs = req.array
+        ref_full = comm.Allgather_multi(pstate)
+        for a, b in zip(outs, ref_full):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b))
+        req.discard()
+    req.free()
+    try:
+        req.start()
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_REQUEST
+    sync.free()
+    """, 2, mca=MCA_SMALL)
+
+
+def test_persistent_allgather_rebind_validation():
+    """rebind swaps same-plan shards with no re-init; a different
+    bucket layout raises ERR_ARG; released operands make start
+    erroneous until a rebind."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import errors
+    from ompi_tpu.zero import layout as zl
+    st = zl.ShardedState.from_full(
+        comm, [jnp.ones((30,), jnp.float32)])
+    req = comm.Allgather_multi_init(st)
+    req.start(); req.wait()
+    one = np.asarray(req.array[0]).copy()
+    st2 = st.map(lambda s: s * np.asarray(2.0, s.dtype))
+    req.rebind(st2)
+    req.start(); req.wait()
+    np.testing.assert_array_equal(np.asarray(req.array[0]), one * 2)
+    other = zl.ShardedState.from_full(
+        comm, [jnp.ones((12,), jnp.float32),
+               jnp.ones((300,), jnp.float32)])
+    try:
+        req.rebind(other)
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_ARG
+    req.free()
+    try:
+        req.rebind(st2)
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_REQUEST
+    """, 2, mca=MCA)
+
+
+def test_zero_ag_skipped_frozen_buckets():
+    """Satellite: frozen leaves. An all-frozen bucket's shard keeps
+    its version, the allgather tail reuses the cached gathered leaves
+    (zero_ag_skipped counts it), the frozen values never move, and a
+    frozen leaf sharing a bucket with live ones stays put too."""
+    run_ranks("""
+    import jax
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    from ompi_tpu.zero import ZeroOptimizer
+    params = {"frozen_emb": jnp.arange(16, dtype=jnp.float32)
+                            .reshape(4, 4),
+              "w1": jnp.ones((4, 4), jnp.float32),
+              "w2": jnp.ones((4, 4), jnp.float32)}
+    frozen = {"frozen_emb": True, "w1": False, "w2": False}
+    opt = ZeroOptimizer(comm, params, lr=0.1, momentum=0.9,
+                        deterministic="linear", frozen=frozen)
+    s = pvar.session()
+    g = jax.tree.map(lambda p: jnp.ones(p.shape, p.dtype), params)
+    p1 = opt.step(g)
+    p2 = opt.step(g)
+    np.testing.assert_array_equal(np.asarray(p2["frozen_emb"]),
+                                  np.asarray(params["frozen_emb"]))
+    assert not np.array_equal(np.asarray(p2["w1"]),
+                              np.asarray(params["w1"]))
+    # 64-byte buckets -> one leaf per bucket -> the frozen bucket is
+    # skippable from the second gather on
+    assert s.read("zero_ag_skipped") >= 1
+    assert s.read("zero_rs_launches") > 0
+    """, 2, mca=MCA_LEAF)
+
+
+def test_frozen_mixed_bucket_and_validation():
+    """Frozen correctness does not depend on bucket boundaries (big
+    buckets put frozen and live leaves together — the masked gradient
+    keeps the frozen leaf bitwise put); bad flag counts and the
+    fused combination raise MPIError."""
+    run_ranks("""
+    import jax
+    import jax.numpy as jnp
+    from ompi_tpu import errors
+    from ompi_tpu.core import pvar
+    from ompi_tpu.zero import ZeroOptimizer
+    params = {"a": jnp.arange(16, dtype=jnp.float32).reshape(4, 4),
+              "b": jnp.ones((4, 4), jnp.float32)}
+    opt = ZeroOptimizer(comm, params, lr=0.1, momentum=0.9,
+                        deterministic="linear",
+                        frozen={"a": True, "b": False})
+    g = jax.tree.map(lambda p: jnp.ones(p.shape, p.dtype), params)
+    out = opt.step(g)
+    np.testing.assert_array_equal(np.asarray(out["a"]),
+                                  np.asarray(params["a"]))
+    assert not np.array_equal(np.asarray(out["b"]),
+                              np.asarray(params["b"]))
+    try:
+        ZeroOptimizer(comm, params, frozen={"a": True})
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_COUNT
+    try:
+        ZeroOptimizer(comm, params, fused=True,
+                      frozen={"a": True, "b": False})
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_ARG
+    """, 2, mca=MCA)
+
+
+def test_zero3_host_cycle():
+    """Host (numpy) parameters run the same stream — eager blocking
+    prefetch (every prefetched fetch a hit), identical trajectory to
+    the host stage-1 cycle."""
+    run_ranks("""
+    import jax
+    from ompi_tpu.core import pvar
+    from ompi_tpu.zero import Zero3Optimizer, ZeroOptimizer
+    params = {"embed": np.arange(32, dtype=np.float32).reshape(8, 4),
+              "layers": [{"w": np.ones((4, 4), np.float32)}
+                         for _ in range(2)]}
+    o3 = Zero3Optimizer(comm, params, lr=0.1, momentum=0.9,
+                        deterministic="linear")
+    o1 = ZeroOptimizer(comm, params, lr=0.1, momentum=0.9, stage=1,
+                       deterministic="linear")
+    s = pvar.session()
+    for step in range(3):
+        o3.start_pass()
+        for g in range(o3.plan.n_layers):
+            with o3.layer(g):
+                pass
+        grads = jax.tree.map(
+            lambda p: np.full(p.shape, float(rank + 1), p.dtype),
+            params)
+        o3.step(grads)
+        ref = o1.step(grads)
+    assert s.read("zero_prefetch_misses") == 0
+    got = o3.gathered_params()
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    """, 2, mca=MCA)
+
+
+def test_zero3_fused_gather_matmul_pallas():
+    """coll_pallas on: a single-leaf 2-D layer consumes through
+    zero3_gather_matmul_dev (the shard goes straight into the
+    allgather@matmul kernel; zero3_fused_matmuls counts it) and the
+    product equals gather-then-dot; a multi-leaf layer falls through
+    to fetch + dot."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    from ompi_tpu.zero import Zero3Optimizer
+    params = {"wide": jnp.arange(64, dtype=jnp.float32)
+                      .reshape(8, 8) / 9.0}
+    o = Zero3Optimizer(comm, params, lr=0.1)
+    rhs = jnp.ones((8, 3), jnp.float32) * 0.5
+    s = pvar.session()
+    o.start_pass()
+    out = np.asarray(o.matmul(0, rhs))
+    assert s.read("zero3_fused_matmuls") == 1, "fused path not taken"
+    ref = np.asarray(params["wide"]) @ np.asarray(rhs)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    o.free()
+    """, 2, mca=MCA_PALLAS)
+
+
+def test_zero3_matmul_fallthrough_without_pallas():
+    """Without coll_pallas the same call resolves through fetch +
+    local dot — staged fallthrough, same result."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    from ompi_tpu.zero import Zero3Optimizer
+    params = {"wide": jnp.arange(64, dtype=jnp.float32)
+                      .reshape(8, 8) / 9.0}
+    o = Zero3Optimizer(comm, params, lr=0.1)
+    rhs = jnp.ones((8, 3), jnp.float32) * 0.5
+    s = pvar.session()
+    o.start_pass()
+    out = np.asarray(o.matmul(0, rhs))
+    assert s.read("zero3_fused_matmuls") == 0
+    ref = np.asarray(params["wide"]) @ np.asarray(rhs)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    o.free()
+    """, 2, mca=MCA)
+
+
+def test_elastic_context_refuses_stage3():
+    """Satellite: ElasticContext(stage=3) raises a named
+    MPIError(ERR_NOT_SUPPORTED) at construction — shrink would
+    re-shard only grad/momentum state and corrupt sharded params."""
+    run_ranks("""
+    from ompi_tpu import errors
+    from ompi_tpu.elastic import ElasticContext
+    try:
+        ElasticContext(comm, {"w": np.ones((4,), np.float32)},
+                       stage=3)
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_NOT_SUPPORTED
+        assert "zero3" in str(e)
+    """, 1)
+
+
+def test_zero3_erroneous_calls_raise_mpierror():
+    """MPI erroneous-call policy on the new surface: out-of-range
+    fetch, wrong gradient leaf count, ZeroOptimizer stage=3 pointing
+    at zero3, empty parameter tree."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import errors
+    from ompi_tpu.zero import Zero3Optimizer, ZeroOptimizer
+    from ompi_tpu.zero.zero3 import Zero3Plan
+    params = {"w": jnp.ones((6, 4), jnp.float32)}
+    o = Zero3Optimizer(comm, params, lr=0.1)
+    try:
+        o.fetch(5)
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_COUNT
+    try:
+        o.step([jnp.ones((6, 4), jnp.float32)] * 2)
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_COUNT
+    o.free()
+    try:
+        ZeroOptimizer(comm, params, stage=3)
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_ARG
+        assert "zero3" in str(e)
+    try:
+        Zero3Plan({}, comm.size)
+        assert False, "expected MPIError"
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_ARG
+    """, 2, mca=MCA)
+
+
+def test_zero3_size1_trivial_path():
+    """size-1 comm on the host plane: the whole stream degenerates
+    to local arithmetic but keeps the same surface and trajectory."""
+    run_ranks("""
+    from ompi_tpu.zero import Zero3Optimizer
+    params = {"w": np.ones((4, 4), np.float32)}
+    o = Zero3Optimizer(comm, params, lr=0.5, deterministic="linear")
+    for step in range(2):
+        o.start_pass()
+        with o.layer(0) as ws:
+            pass
+        o.step({"w": np.ones((4, 4), np.float32)})
+    got = o.gathered_params()
+    np.testing.assert_allclose(np.asarray(got["w"]),
+                               np.zeros((4, 4), np.float32))
+    o.free()
+    """, 1)
+
+
+def test_refresh_falls_back_to_reinit_when_rebind_gated():
+    """A launch path without the rebind hook raises
+    ERR_NOT_SUPPORTED; the optimizer's per-step refresh swallows
+    exactly that class, frees the old request and re-inits — the
+    stream keeps going with correct values."""
+    run_ranks("""
+    import jax
+    import jax.numpy as jnp
+    from ompi_tpu import errors
+    from ompi_tpu.zero import Zero3Optimizer
+    params = {"w": jnp.ones((8, 4), jnp.float32)}
+    o = Zero3Optimizer(comm, params, lr=0.5, deterministic="linear")
+    class _Gated:
+        def __init__(self, inner):
+            self._inner = inner
+        def rebind(self, *a, **k):
+            raise errors.MPIError(errors.ERR_NOT_SUPPORTED, "gated")
+        def free(self):
+            self._inner.free()
+    o._reqs[0] = _Gated(o._reqs[0])
+    grads = {"w": jnp.ones((8, 4), jnp.float32)}
+    o.step(grads)          # refresh hits the gate -> free + re-init
+    o.start_pass()
+    with o.layer(0) as ws:
+        np.testing.assert_allclose(np.asarray(ws[0]),
+                                   np.full((8, 4), 0.5, np.float32))
+    o.free()
+    """, 2, mca=MCA)
