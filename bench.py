@@ -34,12 +34,14 @@ import time
 
 _T0 = time.time()
 
-if "--pallas" in sys.argv and "xla_force_host_platform_device_count" \
+if ("--pallas" in sys.argv or "--hier" in sys.argv) \
+        and "xla_force_host_platform_device_count" \
         not in os.environ.get("XLA_FLAGS", ""):
     # the pallas switchpoint card races algorithms across >= 2
-    # devices; on a CPU host fork 4 virtual devices BEFORE jax first
-    # initializes (the TPU path brings its own device count and the
-    # flag only affects the host platform)
+    # devices and the hier card needs a 2x2 grid; on a CPU host fork
+    # 4 virtual devices BEFORE jax first initializes (the TPU path
+    # brings its own device count and the flag only affects the host
+    # platform)
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=4")
@@ -896,6 +898,121 @@ def _bench_pallas():
     }
 
 
+def _bench_hier():
+    """coll/hier switchpoint card (``--hier``): the two-level ICI x
+    DCN allreduce raced against the flat lowering per payload size on
+    a 2x2 grid. Emits flat/hier timings, the per-level byte model
+    (what the traffic attribution charges each axis), ready-to-ingest
+    ``coll_hier_switchpoints`` entries ('flat' where the single
+    program still wins), and a ``bit_identical_linear`` flag
+    re-proving the rank-order composition against the flat linear
+    fold. On CPU the two axes share one memory system — crossover
+    sizes are dispatch-cost numbers; the real ICI/DCN bandwidth gap
+    needs a multi-slice TPU round."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ompi_tpu import op as op_mod
+    from ompi_tpu.monitoring import algo as malgo
+    from ompi_tpu.parallel import collectives as C
+    from ompi_tpu.parallel import hierarchical as H
+    from ompi_tpu.util import jaxcompat as jc
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        raise RuntimeError(
+            "hier bench needs >= 4 devices for the 2x2 grid "
+            "(bench.py forces 4 host devices when --hier is passed "
+            "before jax initializes)")
+    devs = devs[:4]
+    n_dcn = n_ici = 2
+    mesh2 = Mesh(np.array(devs).reshape(n_dcn, n_ici),
+                 (H.DCN_AXIS, H.ICI_AXIS))
+    mesh1 = Mesh(np.array(devs), ("rk",))
+    interp = devs[0].platform != "tpu"
+
+    def split_level(x):
+        part = C.reduce_scatter(x, H.ICI_AXIS, op_mod.SUM,
+                                scatter_dim=0, tiled=True)
+        part = C.allreduce(part, H.DCN_AXIS, op_mod.SUM)
+        return C.allgather(part, H.ICI_AXIS, tiled=True, gather_dim=0)
+
+    def compiled2(call):
+        return jax.jit(jc.shard_map(
+            lambda x: call(x[0]), mesh=mesh2,
+            in_specs=P((H.DCN_AXIS, H.ICI_AXIS)), out_specs=P(),
+            check_vma=False))
+
+    def compiled1(call):
+        return jax.jit(jc.shard_map(
+            lambda x: call(x[0]), mesh=mesh1, in_specs=P("rk"),
+            out_specs=P(), check_vma=False))
+
+    algos = {
+        "flat": (compiled1,
+                 lambda x: C.allreduce(x, "rk", op_mod.SUM)),
+        "hier": (compiled2, split_level),
+    }
+    sizes = ((1 << 14, 1 << 17, 1 << 20) if interp
+             else (1 << 16, 1 << 20, 1 << 24))
+    reps = 3 if interp else 20
+    rows, switchpoints = [], []
+    bit_ok = True
+    best = 0.0
+    for nbytes in sizes:
+        elems = nbytes // 4
+        base = np.arange(elems, dtype=np.float32) % 251 * 0.125 - 15.0
+        stacked = np.stack([base * (r + 1) for r in range(4)])
+        g2 = jax.device_put(
+            stacked, NamedSharding(mesh2, P((H.DCN_AXIS, H.ICI_AXIS))))
+        g1 = jax.device_put(stacked, NamedSharding(mesh1, P("rk")))
+        ici_b, dcn_b = malgo.hier_level_bytes(
+            "allreduce", n_dcn, n_ici, nbytes)
+        row = {"op": "allreduce", "dtype": "float32",
+               "nbytes": nbytes, "log2": malgo.log2_bucket(nbytes),
+               "model_ici_bytes": int(ici_b),
+               "model_dcn_bytes": int(dcn_b)}
+        for name, (comp, call) in algos.items():
+            fn = comp(call)
+            g = g2 if name == "hier" else g1
+            out = fn(g)
+            jax.block_until_ready(out)  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn(g)
+            jax.block_until_ready(out)
+            row[f"{name}_ms"] = round(
+                (time.perf_counter() - t0) / reps * 1e3, 3)
+        # the reproducibility contract on bench shapes: the two-level
+        # rank-order fold == the flat linear fold bit for bit
+        ro = compiled2(lambda x: H.allreduce_rankorder(x))(g2)
+        lin = compiled1(lambda x: C.allreduce(
+            x, "rk", op_mod.SUM, deterministic="linear"))(g1)
+        bit_ok = bool(bit_ok and (
+            np.asarray(ro).view(np.uint32)
+            == np.asarray(lin).view(np.uint32)).all())
+        winner = "hier" if row["hier_ms"] <= row["flat_ms"] else "flat"
+        row["winner"] = winner
+        if winner == "hier":
+            best = max(best, row["flat_ms"] / max(row["hier_ms"],
+                                                  1e-9))
+        rows.append(row)
+        switchpoints.append(
+            {"op": "allreduce", "dtype": "float32",
+             "mesh": [n_dcn, n_ici], "log2": row["log2"],
+             "algorithm": winner})
+    return {
+        "mesh": [n_dcn, n_ici],
+        "interpret": interp,
+        "table": rows,
+        "switchpoints": switchpoints,
+        "bit_identical_linear": bit_ok,
+        "hier_speedup_vs_flat": round(best, 3),
+    }
+
+
 #: microbench extras compared across rounds once a TPU round records
 #: them in bench_baseline.json: (section, key, higher_is_better)
 _EXTRA_BASELINE_KEYS = (
@@ -917,6 +1034,7 @@ _EXTRA_BASELINE_KEYS = (
     ("ckpt", "ckpt_overhead_pct", False),
     ("ckpt", "restore_step1_s", False),
     ("pallas", "best_speedup_vs_xla", True),
+    ("hier", "hier_speedup_vs_flat", True),
 )
 
 
@@ -1065,6 +1183,13 @@ def main() -> None:
             _phase("pallas microbench done")
         except Exception as e:
             _phase(f"pallas microbench skipped: {e!r}")
+    hier = None
+    if "--hier" in sys.argv:
+        try:
+            hier = _bench_hier()
+            _phase("hier microbench done")
+        except Exception as e:
+            _phase(f"hier microbench skipped: {e!r}")
     if trace_path is not None:
         from ompi_tpu.trace import export as trace_export
         from ompi_tpu.trace import recorder as trace_rec
@@ -1105,7 +1230,8 @@ def main() -> None:
                                    "zero3": zero3,
                                    "ingest": ingest,
                                    "ckpt": ckpt,
-                                   "pallas": pallas})
+                                   "pallas": pallas,
+                                   "hier": hier})
         except Exception:
             pass
 
@@ -1152,6 +1278,7 @@ def main() -> None:
             "ingest": ingest,
             "ckpt": ckpt,
             "pallas": pallas,
+            "hier": hier,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),
             # wall attribution from the prof-plane phase ledger

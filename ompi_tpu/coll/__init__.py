@@ -147,8 +147,8 @@ def comm_select(comm) -> None:
 
 def _register_builtin() -> None:
     from ompi_tpu.coll import (  # noqa: F401
-        accelerator, adapt, basic, han, inter, libnbc, pallas, sync,
-        tuned, xla,
+        accelerator, adapt, basic, han, hier, inter, libnbc, pallas,
+        sync, tuned, xla,
     )
 
 

@@ -76,6 +76,17 @@ class _Levels:
             return obj
         return low.recv(source=0, tag=1)
 
+    def release(self) -> None:
+        """Free both sub-communicators — called from the parent
+        Comm.free teardown; without it every han-served comm leaked
+        its low/up splits (cids, coll tables, device ctxs) for the
+        life of the job."""
+        for sub in (self.low, self.up):
+            if sub is not None and not getattr(sub, "_freed", False):
+                sub.free()
+        self.low = None
+        self.up = None
+
 
 def _levels(comm) -> _Levels:
     lv = getattr(comm, "_han_levels", None)

@@ -205,8 +205,8 @@ class _Ctx:
     def _detect_slices(devs) -> int:
         """Number of DCN groups (0 = stay flat). 'auto' requires comm
         rank order to be slice-contiguous with equal-size slices so
-        mesh rows ARE physical slices; anything else degrades to flat
-        (correct, just not hierarchy-optimized)."""
+        mesh rows ARE physical slices (H.slice_split); anything else
+        degrades to flat (correct, just not hierarchy-optimized)."""
         mode = _hier_var.get()
         if mode == "off":
             return 0
@@ -216,20 +216,9 @@ class _Ctx:
             except ValueError:
                 return 0
             return n if n > 1 and len(devs) % n == 0 else 0
-        slices = [getattr(d, "slice_index", None) for d in devs]
-        if any(s is None for s in slices):
-            return 0
-        groups = []
-        for s in slices:  # must be contiguous runs of equal length
-            if not groups or groups[-1][0] != s:
-                groups.append([s, 0])
-            groups[-1][1] += 1
-        ids = [g[0] for g in groups]
-        if len(set(ids)) != len(ids):  # a slice appears twice: ranks
-            return 0                   # interleave slices -> flat
-        if len({g[1] for g in groups}) != 1:
-            return 0  # ragged slices cannot form a mesh
-        return len(groups) if len(groups) > 1 else 0
+        from ompi_tpu.parallel import hierarchical as H
+
+        return H.slice_split(devs)
 
     def replica_groups(self):
         """Device-id groups this comm's collectives compile to

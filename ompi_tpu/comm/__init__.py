@@ -299,6 +299,16 @@ class Communicator(AttrHost):
             ctx.release()
         self.__dict__.pop("_coll_xla_scatter_meta", None)
         self.__dict__.pop("_coll_xla_a2av_meta", None)
+        # coll/hier grid plan (Mesh + sharding over this comm's
+        # devices) dies with the comm
+        self.__dict__.pop("_coll_hier_plan", None)
+        # coll/han lazy sub-communicators: the low/up splits are full
+        # Comms with their own cids and coll state — free them with
+        # the parent instead of leaking them for the life of the job
+        levels = self.__dict__.pop("_han_levels", None)
+        if levels is not None:
+            levels.release()
+        self.__dict__.pop("_han_colors", None)
         # partitioned-p2p pairing epochs (part/host) die with the cid
         self.__dict__.pop("_part_epochs", None)
         # ULFM agreement/shrink epochs die with the cid too — a

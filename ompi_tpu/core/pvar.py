@@ -123,6 +123,12 @@ WELL_KNOWN = (
     # --pallas reads back)
     "pallas_launches", "pallas_fused_launches", "pallas_fallthrough",
     "pallas_ring_bytes", "pallas_bidir_bytes", "pallas_linear_bytes",
+    # coll/hier (two-level ICI x DCN collectives): hierarchical
+    # launches, fused bucket launches riding the two-level lowering,
+    # staged fallthroughs to the flat path, and per-level bytes — the
+    # DCN figure is the one the smoke lane bounds at payload/ici_size
+    "hier_launches", "hier_fused_launches", "hier_fallthrough",
+    "hier_ici_bytes", "hier_dcn_bytes",
     # ft/ failure plane: heartbeats emitted by the detector thread,
     # faults/revocations applied on the progress engine, and the
     # eventful-sweep wall (the hot no-news path is untimed — the

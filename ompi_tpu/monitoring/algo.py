@@ -86,6 +86,71 @@ def pallas_per_peer(op: str, algorithm: str, rank: int, n: int,
     return {nxt: total}
 
 
+def hier_level_bytes(op: str, n_dcn: int, n_ici: int,
+                     nbytes: int, linear: bool = False):
+    """(ici_bytes, dcn_bytes) one rank moves for a coll/hier launch —
+    the two-level schedules' send-side transport models:
+
+    - split-level **allreduce**: ICI ring reduce_scatter + allgather
+      on the full payload (2 * (n_ici-1)/n_ici * B); the DCN phase
+      allreduces the 1/n_ici chunk (2 * (B/n_ici) * (n_dcn-1)/n_dcn)
+      — the whole point of the composition: DCN carries <= B/n_ici.
+    - **reduce_scatter** family: one scatter per level, same chunk
+      shrink; **allgather** family inverts it (DCN gathers the shard,
+      ICI replicates the n_dcn-fold row).
+    - **alltoall**: each byte crosses each level at most once.
+    - **bcast**: one DCN column hop + the full ICI fanout row.
+    - ``linear`` (the rank-order fold): gather transport — DCN ships
+      the block to n_dcn-1 group peers, ICI replicates the gathered
+      n_dcn-stack to n_ici-1 row peers.
+
+    Unknown ops return (0, 0) — under-count rather than guess, like
+    :func:`per_peer`."""
+    b = float(nbytes)
+    if n_dcn <= 1 or n_ici <= 1:
+        return (0.0, 0.0)
+    if linear:
+        return (b * n_dcn * (n_ici - 1), b * (n_dcn - 1))
+    if op in _RS_AG:
+        return (2.0 * b * (n_ici - 1) / n_ici,
+                2.0 * (b / n_ici) * (n_dcn - 1) / n_dcn)
+    if op in ("reduce_scatter", "reduce_scatter_block",
+              "reduce_scatter_multi"):
+        return (b * (n_ici - 1) / n_ici,
+                (b / n_ici) * (n_dcn - 1) / n_dcn)
+    if op in ("allgather", "allgatherv", "allgather_multi"):
+        return (b * n_dcn * (n_ici - 1) / n_ici,
+                b * (n_dcn - 1) / n_dcn)
+    if op == "alltoall":
+        return (b * (n_ici - 1) / n_ici, b * (n_dcn - 1) / n_dcn)
+    if op == "bcast":
+        return (b, b * (n_dcn - 1) / n_dcn)
+    return (0.0, 0.0)
+
+
+def hier_per_peer(op: str, rank: int, n_dcn: int, n_ici: int,
+                  nbytes: int,
+                  linear: bool = False) -> Dict[int, float]:
+    """Bytes `rank` SENDS per comm-local peer for one coll/hier
+    launch, split by level: the ICI share rides the intra-slice ring
+    edge (rank's row successor), the DCN share the inter-slice edge
+    (same column, next slice) — so the link map separates fast-axis
+    from slow-axis load instead of smearing both onto one flat ring
+    edge."""
+    ici_b, dcn_b = hier_level_bytes(op, n_dcn, n_ici, nbytes,
+                                    linear=linear)
+    if not ici_b and not dcn_b:
+        return {}
+    s, j = divmod(rank, n_ici)
+    out: Dict[int, float] = {}
+    if ici_b:
+        out[s * n_ici + (j + 1) % n_ici] = float(ici_b)
+    if dcn_b:
+        peer = ((s + 1) % n_dcn) * n_ici + j
+        out[peer] = out.get(peer, 0.0) + float(dcn_b)
+    return out
+
+
 def per_peer(op: str, rank: int, n: int, nbytes: int,
              root: int = 0,
              counts: Optional[Sequence[int]] = None,

@@ -82,6 +82,8 @@ class TrafficMatrix:
         # (op, log2 size bucket, dtype, mesh shape) -> [launches, bytes]
         self.coll_records: Dict[Tuple[str, int, str, Tuple[int, ...]],
                                 List[float]] = {}
+        # coll/hier per-level totals: op -> [launches, ici_b, dcn_b]
+        self.hier_levels: Dict[str, List[float]] = {}
         self.link_bytes: Dict[Link, float] = {}
         self.expert: Dict[int, int] = {}
         self.series: List[Tuple[int, str, float]] = []
@@ -147,6 +149,20 @@ class TrafficMatrix:
         pvar.record("monitoring_coll_launches", 1)
         for peer, b in per_peer.items():
             self.count(ctx, world_rank(comm, peer), b)
+
+    def hier(self, op: str, ici_bytes: float,
+             dcn_bytes: float) -> None:
+        """Account one coll/hier launch's per-level byte split — the
+        table that lets the report answer "which level is the
+        bottleneck" (the per-peer spatial view goes through
+        :meth:`coll` separately)."""
+        with self.lock:
+            rec = self.hier_levels.get(op)
+            if rec is None:
+                rec = self.hier_levels[op] = [0, 0.0, 0.0]
+            rec[0] += 1
+            rec[1] += float(ici_bytes)
+            rec[2] += float(dcn_bytes)
 
     @staticmethod
     def _mesh_shape(comm) -> Tuple[int, ...]:

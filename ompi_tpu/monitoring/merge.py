@@ -39,6 +39,8 @@ def snapshot_doc(tm) -> Dict[str, object]:
         link_bytes = {link_name(k): v
                       for k, v in tm.link_bytes.items()}
         expert = {str(e): c for e, c in tm.expert.items()}
+        hier = {op: list(rec)
+                for op, rec in sorted(tm.hier_levels.items())}
     return {
         "schema": SCHEMA,
         "rank": tm.rank,
@@ -48,6 +50,7 @@ def snapshot_doc(tm) -> Dict[str, object]:
         "coll_records": coll_records,
         "link_bytes": link_bytes,
         "expert_tokens": expert,
+        "hier_levels": hier,
     }
 
 
@@ -135,6 +138,14 @@ def merge(docs: List[Dict[str, object]]) -> Dict[str, object]:
         for e, c in doc.get("expert_tokens", {}).items():
             expert[int(e)] = expert.get(int(e), 0) + int(c)
 
+    hier_levels: Dict[str, List[float]] = {}
+    for doc in docs:
+        for op, rec in doc.get("hier_levels", {}).items():
+            got = hier_levels.setdefault(op, [0, 0.0, 0.0])
+            got[0] += rec[0]
+            got[1] += rec[1]
+            got[2] += rec[2]
+
     return {
         "schema": SCHEMA + "+merged",
         "nranks": nranks,
@@ -152,6 +163,8 @@ def merge(docs: List[Dict[str, object]]) -> Dict[str, object]:
             for (op, bucket, dt, mesh), rec in
             sorted(coll_records.items())],
         "expert_tokens": expert,
+        "hier_levels": {op: list(rec)
+                        for op, rec in sorted(hier_levels.items())},
     }
 
 

@@ -116,6 +116,28 @@ def render(merged: Dict[str, object], top: int = 5) -> str:
                 f"mesh{tuple(rec['mesh'])!r:<10} "
                 f"{rec['launches']:.0f} launches "
                 f"{_fmt_bytes(float(rec['bytes']))}")
+    hier = merged.get("hier_levels", {})
+    if hier:
+        tot_ici = sum(rec[1] for rec in hier.values())
+        tot_dcn = sum(rec[2] for rec in hier.values())
+        # which level is the bottleneck: weight the slow axis by the
+        # nominal ICI/DCN bandwidth gap (order of magnitude) before
+        # comparing byte loads
+        if tot_dcn > 0:
+            verdict = "DCN-bound" if tot_dcn * 10.0 >= tot_ici \
+                else "ICI-bound"
+            out.append(f"[hier] two-level collectives: "
+                       f"ICI {_fmt_bytes(tot_ici)} / "
+                       f"DCN {_fmt_bytes(tot_dcn)} "
+                       f"(ratio {tot_ici / tot_dcn:.1f}:1; {verdict} "
+                       "at a nominal 10x slower DCN)")
+        else:
+            out.append(f"[hier] two-level collectives: "
+                       f"ICI {_fmt_bytes(tot_ici)} / DCN 0B")
+        for op, rec in list(hier.items())[:top]:
+            out.append(f"  {op:<22s} {rec[0]:.0f} launches  "
+                       f"ICI {_fmt_bytes(float(rec[1])):>10s}  "
+                       f"DCN {_fmt_bytes(float(rec[2])):>10s}")
     experts = merged.get("expert_tokens", {})
     if experts:
         total = sum(experts.values()) or 1

@@ -27,14 +27,88 @@ from __future__ import annotations
 
 from typing import Optional, Sequence, Tuple
 
+import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
-from ompi_tpu import op as op_mod
+from ompi_tpu import errors, op as op_mod
 from ompi_tpu.parallel import collectives as C
 
 #: canonical axis names for the two levels
 DCN_AXIS = "dcn"
 ICI_AXIS = "ici"
+
+
+def slice_split(devices) -> int:
+    """Number of DCN groups a device list forms (0 = stay flat).
+
+    Groups by ``device.slice_index``; the order must be contiguous
+    runs of equal length so mesh rows ARE physical slices — anything
+    else (no slice info, interleaved ranks, ragged slices) returns 0
+    and the caller stays on the flat schedule (correct, just not
+    hierarchy-optimized). Pure: no cvar consultation, so both
+    coll/xla's auto mode and coll/hier's plan builder share it."""
+    slices = [getattr(d, "slice_index", None) for d in devices]
+    if any(s is None for s in slices):
+        return 0
+    groups = []
+    for s in slices:  # must be contiguous runs of equal length
+        if not groups or groups[-1][0] != s:
+            groups.append([s, 0])
+        groups[-1][1] += 1
+    ids = [g[0] for g in groups]
+    if len(set(ids)) != len(ids):  # a slice appears twice: ranks
+        return 0                   # interleave slices -> flat
+    if len({g[1] for g in groups}) != 1:
+        return 0  # ragged slices cannot form a mesh
+    return len(groups) if len(groups) > 1 else 0
+
+
+def parse_split(spec: str, n_devices: int,
+                devices=None) -> Optional[Tuple[int, int]]:
+    """Resolve a ``coll_hier_split`` spec to ``(n_dcn, n_ici)``.
+
+    'off' -> None (flat); 'auto' -> group ``devices`` by slice_index
+    (None when they form no nested mesh); 'DxI' -> an explicit grid;
+    an integer N -> N equal slices. Malformed or indivisible specs
+    raise MPIError(ERR_ARG) naming the counts — a silently-flat
+    mis-spec would void the hierarchy the operator asked for."""
+    spec = (spec or "auto").strip().lower()
+    if spec == "off":
+        return None
+    if spec == "auto":
+        n_dcn = slice_split(devices) if devices is not None else 0
+        if n_dcn < 2:
+            return None
+        return n_dcn, n_devices // n_dcn
+    if "x" in spec:
+        parts = spec.split("x")
+        try:
+            d, i = (int(v) for v in parts)
+        except ValueError:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                f"coll_hier_split={spec!r}: expected 'DxI' (e.g. "
+                "'2x4'), an integer slice count, 'auto' or 'off'")
+        if d < 1 or i < 1 or d * i != n_devices:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                f"coll_hier_split={spec!r}: a {d}x{i} grid needs "
+                f"{d * i} devices, the communicator has {n_devices}")
+        return d, i
+    try:
+        d = int(spec)
+    except ValueError:
+        raise errors.MPIError(
+            errors.ERR_ARG,
+            f"coll_hier_split={spec!r}: expected 'DxI', an integer "
+            "slice count, 'auto' or 'off'")
+    if d < 1 or n_devices % d:
+        raise errors.MPIError(
+            errors.ERR_ARG,
+            f"coll_hier_split={spec!r}: {n_devices} devices do not "
+            f"split into {d} equal slices")
+    return (d, n_devices // d) if d > 1 else None
 
 
 def hier_mesh(devices=None, n_slices: Optional[int] = None,
@@ -64,13 +138,15 @@ def hier_mesh(devices=None, n_slices: Optional[int] = None,
         else:
             rows = [by_slice[k] for k in sorted(by_slice)]
             if len({len(r) for r in rows}) != 1:
-                raise ValueError(
+                raise errors.MPIError(
+                    errors.ERR_ARG,
                     f"ragged slices: {[len(r) for r in rows]} devices "
                     "per slice; a mesh needs equal rows")
             return Mesh(np.array(rows), axis_names)
         n_slices = 1  # no slice info: a single DCN group
     if len(devices) % n_slices:
-        raise ValueError(
+        raise errors.MPIError(
+            errors.ERR_ARG,
             f"{len(devices)} devices do not split into {n_slices} "
             "equal slices")
     grid = np.array(devices).reshape(n_slices, len(devices) // n_slices)
@@ -180,3 +256,89 @@ def barrier(ici_axis: str = ICI_AXIS, dcn_axis: str = DCN_AXIS):
     :func:`C.barrier`, synchronization only exists through data
     dependence; an unused token is dead-code-eliminated by XLA."""
     return C.barrier(ici_axis) + C.barrier(dcn_axis)
+
+
+# ---------------------------------------------------------------------------
+# flat-rank-order compositions (bit-identical to single-axis lowerings)
+#
+# The split-level schedules above are bandwidth-optimal but fold in
+# (ici, dcn) group order, so their float results differ in the last ulp
+# from a flat rank-0..n-1 fold. These variants reproduce the flat
+# `deterministic='linear'` contract exactly over a two-axis mesh: gather
+# everything into a rank-major stack, then fold in the same statically
+# unrolled order as :func:`C._allreduce_linear`. DCN still carries only
+# the (n_dcn-1)/n_dcn gather fraction — the first gather runs on the
+# slow axis *before* ICI replicates it.
+
+
+def gather_rankorder(x, ici_axis: str = ICI_AXIS,
+                     dcn_axis: str = DCN_AXIS):
+    """All ranks' shards as a rank-major ``(n, *x.shape)`` stack —
+    exactly what ``lax.all_gather`` over a flat axis yields.
+
+    Gathers DCN first (small payload crosses the slow wire once), then
+    ICI; the result's (ici, dcn) leading axes transpose statically to
+    rank order ``world = dcn_index * n_ici + ici_index``."""
+    g = lax.all_gather(x, dcn_axis)   # [n_dcn, ...]
+    g = lax.all_gather(g, ici_axis)   # [n_ici, n_dcn, ...]
+    n = g.shape[0] * g.shape[1]
+    # [j, s] holds rank s*n_ici + j -> swap to [s, j], flatten rank-major
+    return g.swapaxes(0, 1).reshape((n,) + x.shape)
+
+
+def allreduce_rankorder(x, ici_axis: str = ICI_AXIS,
+                        dcn_axis: str = DCN_AXIS, op=op_mod.SUM):
+    """Allreduce folding in flat rank order — bit-identical to
+    ``C.allreduce(x, flat_axis, op, deterministic='linear')`` on the
+    corresponding 1-axis mesh (same gathered operands, same statically
+    unrolled fold, same logical-op bool casting)."""
+    op = C._op_of(op)
+    logical = op.name in ("MPI_LAND", "MPI_LOR", "MPI_LXOR")
+    xin = x.astype(jnp.bool_) if logical else x
+    g = gather_rankorder(xin, ici_axis, dcn_axis)
+    fn = C.combine_fn(op)
+    acc = g[0]
+    for i in range(1, g.shape[0]):
+        acc = fn(acc, g[i])
+    return acc.astype(x.dtype) if logical else acc
+
+
+def reduce_scatter_block_rankorder(x, ici_axis: str = ICI_AXIS,
+                                   dcn_axis: str = DCN_AXIS,
+                                   op=op_mod.SUM):
+    """MPI rank-major reduce_scatter_block, bit-identical to the flat
+    linear lowering: rank-order allreduce, then each rank slices block
+    ``world_rank`` (the same allreduce-then-slice shape coll/xla uses
+    for its 'linear' mode)."""
+    n_ici = C.axis_size(ici_axis)
+    n = C.axis_size(dcn_axis) * n_ici
+    full = allreduce_rankorder(x, ici_axis, dcn_axis, op)
+    k = x.shape[0] // n
+    idx = C.axis_index(dcn_axis) * n_ici + C.axis_index(ici_axis)
+    return lax.dynamic_slice_in_dim(full, idx * k, k, axis=0)
+
+
+def reduce_scatter_rankmajor(x, ici_axis: str = ICI_AXIS,
+                             dcn_axis: str = DCN_AXIS, op=op_mod.SUM,
+                             deterministic: Optional[str] = None):
+    """Split-level reduce_scatter with MPI rank-major placement.
+
+    :func:`reduce_scatter` above is ici-major (rank (s,j) holds block
+    j*n_dcn+s) — fine for closed allreduce compositions, wrong for the
+    MPI contract. A static row pre-permutation makes the two-phase
+    schedule land block ``s*n_ici + j`` on rank (s,j): after the
+    permute, body block j*n_dcn+s is original block s*n_ici+j, phase 1
+    hands ICI-rank j the blocks {*, j}, phase 2 hands DCN-rank s its
+    one block. Bulk bytes stay on ICI; DCN moves 1/n_ici of the input.
+    """
+    n_ici = C.axis_size(ici_axis)
+    n_dcn = C.axis_size(dcn_axis)
+    n = n_dcn * n_ici
+    k = x.shape[0] // n
+    rest = x.shape[1:]
+    body = x.reshape((n_dcn, n_ici, k) + rest).swapaxes(0, 1)
+    body = body.reshape((n * k,) + rest)
+    part = C.reduce_scatter(body, ici_axis, op, scatter_dim=0,
+                            tiled=True, deterministic=deterministic)
+    return C.reduce_scatter(part, dcn_axis, op, scatter_dim=0,
+                            tiled=True, deterministic=deterministic)

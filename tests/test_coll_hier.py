@@ -1,0 +1,263 @@
+"""coll/hier — two-level ICI x DCN hierarchical collectives.
+
+``coll_hier_split DxI`` fakes the nested topology on the virtual CPU
+mesh (the coll_han ``modulo:K`` analog, one plane down), so the whole
+two-level schedule — split-level allreduce, rank-order linear mode,
+fused buckets, persistent restarts — is proven in tier-1 without
+hardware. The bit-identity bar: ``deterministic='linear'`` must match
+the flat coll/xla lowering bit for bit on every grid shape, because
+the rank-order compositions fold in flat comm-rank order regardless
+of the topology underneath.
+"""
+
+import pytest
+
+from tests.harness import run_ranks
+
+
+def _mca(split):
+    return {"device_plane": "on", "coll_hier": "on",
+            "coll_hier_split": split}
+
+
+@pytest.mark.parametrize("n,split",
+                         [(4, "2x2"), (6, "2x3"), (8, "2x4")])
+def test_linear_bit_identical_to_flat(n, split):
+    """allreduce / reduce_scatter_block under 'linear' and the pure
+    data movers (allgather, bcast, alltoall) must match the flat
+    coll/xla lowering bitwise on every nested grid; the default
+    split-level allreduce is allclose (different add order is the
+    point)."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.coll import xla as cx
+    for slot in ("allreduce_dev", "reduce_scatter_block_dev",
+                 "allgather_dev", "bcast_dev", "alltoall_dev"):
+        assert comm.coll.providers[slot] == "hier", slot
+    rng = np.random.default_rng(13)
+    h = (rng.standard_normal(6 * size)
+         * (10.0 ** rng.integers(-3, 4, 6 * size))).astype(np.float32)
+    x = jnp.asarray(np.roll(h, rank * 5)).reshape(size, 6)
+    p = np.asarray(comm.coll.allreduce_dev(
+        comm, x, deterministic="linear"))
+    r = np.asarray(cx.allreduce_dev(comm, x, deterministic="linear"))
+    assert (p.view(np.uint32) == r.view(np.uint32)).all()
+    p = np.asarray(comm.coll.reduce_scatter_block_dev(
+        comm, x, deterministic="linear"))
+    r = np.asarray(cx.reduce_scatter_block_dev(
+        comm, x, deterministic="linear"))
+    assert (p.view(np.uint32) == r.view(np.uint32)).all()
+    # default mode: two-level fold, numerically equivalent only
+    p = np.asarray(comm.coll.allreduce_dev(comm, x))
+    r = np.asarray(cx.allreduce_dev(comm, x))
+    np.testing.assert_allclose(p, r, rtol=1e-5, atol=1e-5)
+    p = np.asarray(comm.coll.reduce_scatter_block_dev(comm, x))
+    r = np.asarray(cx.reduce_scatter_block_dev(comm, x))
+    np.testing.assert_allclose(p, r, rtol=1e-5, atol=1e-5)
+    # pure data movement: exact on any grid
+    y = jnp.asarray(rng.standard_normal((5, 3)).astype(np.float32)) \\
+        + rank
+    pg = np.asarray(comm.coll.allgather_dev(comm, y))
+    rg = np.asarray(cx.allgather_dev(comm, y))
+    assert pg.shape == (size, 5, 3)
+    np.testing.assert_array_equal(pg, rg)
+    b = jnp.asarray(np.float32(rank)) + jnp.zeros(7, jnp.float32)
+    pb = np.asarray(comm.coll.bcast_dev(comm, b, 1))
+    rb = np.asarray(cx.bcast_dev(comm, b, 1))
+    np.testing.assert_array_equal(pb, rb)
+    assert pb[0] == 1.0
+    z = jnp.asarray(rng.standard_normal((size * 2, 3)
+                                        ).astype(np.float32)) + rank
+    pa = np.asarray(comm.coll.alltoall_dev(comm, z))
+    ra = np.asarray(cx.alltoall_dev(comm, z))
+    np.testing.assert_array_equal(pa, ra)
+    """, n, mca=_mca(split))
+
+
+def test_dcn_bytes_bounded_and_attributed():
+    """The acceptance bound: a split-level allreduce puts at most
+    payload/ici_size bytes on the DCN axis (the flat ring would carry
+    ~2x payload), and the per-level pvars attribute it."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    x = jnp.arange(4096, dtype=jnp.float32) + rank
+    s = pvar.session()
+    comm.coll.allreduce_dev(comm, x)
+    nbytes = 4096 * 4
+    dcn = s.read("hier_dcn_bytes")
+    ici = s.read("hier_ici_bytes")
+    assert 0 < dcn <= nbytes // 2, dcn   # ici_size = 2 on the 2x2
+    assert ici > 0
+    assert s.read("hier_launches") == 1
+    """, 4, mca=_mca("2x2"))
+
+
+def test_ring_det_and_force_flat_fall_through():
+    """deterministic='ring' pins the flat ring order (the two-level
+    chunk schedule cannot reproduce it) and coll_hier_force=flat is
+    the A/B switch: both must delegate, bitwise-identical to the
+    lowered flat slot, with the delegation counted."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import cvar, pvar
+    from ompi_tpu.coll import xla as cx
+    x = jnp.arange(64, dtype=jnp.float32) * (rank + 1)
+    s = pvar.session()
+    p = np.asarray(comm.coll.allreduce_dev(
+        comm, x, deterministic="ring"))
+    r = np.asarray(cx.allreduce_dev(comm, x, deterministic="ring"))
+    assert (p.view(np.uint32) == r.view(np.uint32)).all()
+    assert s.read("hier_fallthrough") == 1
+    assert s.read("hier_launches") == 0
+    try:
+        cvar.set("coll_hier_force", "flat")
+        s = pvar.session()
+        comm.coll.allreduce_dev(comm, x)
+        assert s.read("hier_fallthrough") == 1
+        assert s.read("hier_launches") == 0
+    finally:
+        cvar.set("coll_hier_force", "")
+    """, 4, mca=_mca("2x2"))
+
+
+def test_fused_multi_linear_bit_identical():
+    """The fused bucketed form rides the two-level lowering: under
+    'linear' every leaf matches the flat fused path bitwise (the
+    rank-order fold is concat-invariant), and the buckets are counted
+    as hier launches."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    from ompi_tpu.coll import xla as cx
+    rng = np.random.default_rng(rank)
+    bufs = {"w": jnp.asarray(rng.standard_normal((3, 5)
+                                                 ).astype(np.float32)),
+            "b": jnp.asarray(rng.standard_normal((7,)
+                                                 ).astype(np.float32)),
+            "i": jnp.arange(5, dtype=jnp.int32) + rank}
+    s = pvar.session()
+    p = comm.coll.allreduce_multi_dev(comm, bufs,
+                                      deterministic="linear")
+    r = cx.allreduce_multi_dev(comm, bufs, deterministic="linear")
+    for k in bufs:
+        pu = np.asarray(p[k]).view(np.uint32)
+        ru = np.asarray(r[k]).view(np.uint32)
+        assert (pu == ru).all(), k
+    assert s.read("hier_fused_launches") >= 1
+    """, 4, mca=_mca("2x2"))
+
+
+def test_persistent_restart_cycles():
+    """Persistent two-level collectives: init preps once, every
+    start() relaunches the cached bucket programs with per-cycle
+    attribution — three cycles, bit-identical to the flat persistent
+    form each time."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    from ompi_tpu.coll import xla as cx
+    from ompi_tpu.pml import request as rq
+    rng = np.random.default_rng(rank + 3)
+    bufs = [jnp.asarray(rng.standard_normal((4, 3)
+                                            ).astype(np.float32)),
+            jnp.asarray(rng.standard_normal((6,)
+                                            ).astype(np.float32))]
+    req = comm.coll.allreduce_multi_init_dev(
+        comm, bufs, deterministic="linear")
+    ref = cx.allreduce_multi_init_dev(
+        comm, bufs, deterministic="linear")
+    s = pvar.session()
+    for cycle in range(3):
+        req.start()
+        ref.start()
+        rq.wait_all([req, ref], timeout=60)
+        for a, b in zip(req.array, ref.array):
+            au = np.asarray(a).view(np.uint32)
+            bu = np.asarray(b).view(np.uint32)
+            assert (au == bu).all(), cycle
+    assert s.read("hier_launches") == 3
+    req.free(); ref.free()
+    # the single-buffer persistent form restarts the same way
+    x = jnp.full(8, float(rank + 1), jnp.float32)
+    r1 = comm.coll.allreduce_init_dev(comm, x)
+    for cycle in range(2):
+        r1.start()
+        rq.wait_all([r1], timeout=60)
+        assert np.asarray(r1.array)[0] == sum(range(1, size + 1))
+    r1.free()
+    """, 4, mca=_mca("2x2"))
+
+
+def test_bad_split_raises_at_first_collective():
+    """An indivisible coll_hier_split must surface as
+    MPIError(ERR_ARG) naming the counts at the first collective —
+    never silently run flat, and never vanish inside comm_select's
+    query (which swallows exceptions)."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import errors
+    assert comm.coll.providers["allreduce_dev"] == "hier"
+    x = jnp.ones(16, jnp.float32)
+    for attempt in range(2):  # NOT cached: raises every call
+        try:
+            comm.coll.allreduce_dev(comm, x)
+        except errors.MPIError as e:
+            assert e.error_class == errors.ERR_ARG, e
+            assert "3x2" in str(e) and "4" in str(e), e
+        else:
+            raise AssertionError("bad split did not raise")
+    """, 4, mca=_mca("3x2"))
+
+
+def test_switchpoint_table_flat_entries():
+    """A measured hier-vs-flat table (the coll_pallas_switchpoints
+    shape one level up): 'flat' entries above their log2 threshold
+    fall through, sizes below it stay hierarchical."""
+    run_ranks("""
+    import json, jax.numpy as jnp
+    from ompi_tpu.core import cvar, pvar
+    path = "/tmp/ompi_tpu_hier_sw_%d.json" % rank
+    with open(path, "w") as f:
+        json.dump([
+            {"op": "allreduce", "dtype": "float32", "mesh": [2, 2],
+             "log2": 12, "algorithm": "flat"},
+        ], f)
+    try:
+        cvar.set("coll_hier_switchpoints", path)
+        small = jnp.arange(64, dtype=jnp.float32) + rank   # 256 B
+        big = jnp.arange(2048, dtype=jnp.float32) + rank   # 8 KiB
+        s = pvar.session()
+        comm.coll.allreduce_dev(comm, small)
+        assert s.read("hier_launches") == 1
+        s = pvar.session()
+        comm.coll.allreduce_dev(comm, big)
+        assert s.read("hier_fallthrough") == 1
+        assert s.read("hier_launches") == 0
+    finally:
+        cvar.set("coll_hier_switchpoints", "")
+    """, 4, mca=_mca("2x2"))
+
+
+def test_han_levels_freed_with_comm():
+    """The coll/han satellite: freeing a comm must free its lazily
+    built low/up sub-communicators (the leak every han-served comm
+    paid for the life of the job)."""
+    run_ranks("""
+    from ompi_tpu.coll import han
+    sub = comm.split(0, key=rank)
+    lv = han._levels(sub)
+    low = lv.low
+    assert low is not None and not getattr(low, "_freed", False)
+    sub.free()
+    assert lv.low is None and lv.up is None
+    assert getattr(low, "_freed", False)
+    """, 4, mca={"coll_han_split": "modulo:2"})
+
+
+def test_off_by_default():
+    """Without the opt-in the flat providers are untouched (the
+    stacking contract every provider-asserting test relies on)."""
+    run_ranks("""
+    assert comm.coll.providers["allreduce_dev"] == "xla"
+    """, 2, mca={"device_plane": "on"})

@@ -12,6 +12,7 @@ jax = pytest.importorskip("jax")
 
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
+from ompi_tpu import errors  # noqa: E402
 from ompi_tpu.util import jaxcompat  # noqa: E402
 from ompi_tpu.parallel import collectives as C  # noqa: E402
 from ompi_tpu.parallel import hierarchical as H  # noqa: E402
@@ -40,8 +41,10 @@ def test_hier_mesh_shape():
 
 
 def test_hier_mesh_rejects_ragged():
-    with pytest.raises(ValueError):
+    with pytest.raises(errors.MPIError) as exc:
         H.hier_mesh(n_slices=3)  # 8 devices don't split into 3
+    assert exc.value.error_class == errors.ERR_ARG
+    assert "3" in str(exc.value)  # names the offending counts
 
 
 def test_allreduce_matches_flat():
