@@ -1003,6 +1003,84 @@ def _bench_hier():
             {"op": "allreduce", "dtype": "float32",
              "mesh": [n_dcn, n_ici], "log2": row["log2"],
              "algorithm": winner})
+
+    # -- compressed DCN wire formats: the cast-compress transport
+    # raced against the exact split on the largest payload. Per wire
+    # dtype: timing, the wire-byte model (asserted against the
+    # bf16<=1/2 / fp8<=1/4 contract the smoke lane enforces), and the
+    # worst element error in units of the wire format's epsilon.
+    import ml_dtypes
+
+    nbytes = sizes[-1]
+    _, nominal_dcn = malgo.hier_level_bytes("allreduce", n_dcn,
+                                            n_ici, nbytes)
+    exact = np.asarray(compiled2(split_level)(g2))
+    dcn_rows = []
+    for wire in H.WIRE_DTYPES:
+        wdt = jc.wire_dtype(wire)
+        if wdt is None:
+            continue
+
+        def comp_level(x, w=wire):
+            part = C.reduce_scatter(x, H.ICI_AXIS, op_mod.SUM,
+                                    scatter_dim=0, tiled=True)
+            part = H.dcn_wire_allreduce(part, w, H.DCN_AXIS)
+            return C.allgather(part, H.ICI_AXIS, tiled=True,
+                               gather_dim=0)
+
+        fn = compiled2(comp_level)
+        out = fn(g2)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(g2)
+        jax.block_until_ready(out)
+        wire_b = malgo.hier_wire_bytes("allreduce", n_dcn, n_ici,
+                                       nbytes, wire=wire, itemsize=4)
+        rel = np.abs(np.asarray(out) - exact) / np.maximum(
+            np.abs(exact), np.float32(1e-30))
+        eps = float(ml_dtypes.finfo(wdt).eps)
+        bound = 0.5 if wire == "bf16" else 0.25
+        dcn_rows.append({
+            "wire": wire,
+            "compressed_ms": round(
+                (time.perf_counter() - t0) / reps * 1e3, 3),
+            "exact_ms": rows[-1]["hier_ms"],
+            "model_dcn_bytes": int(nominal_dcn),
+            "model_wire_bytes": int(wire_b),
+            "compression": round(nominal_dcn / max(wire_b, 1e-9), 2),
+            "model_ok": bool(wire_b <= nominal_dcn * bound),
+            "max_err_wire_eps": round(float(rel.max()) / eps, 2),
+        })
+
+    # -- SGD loss parity with error feedback: a conditioning-spread
+    # quadratic trained with exact, quantized (no carry), and
+    # EF-compensated gradients — the card's convergence answer to
+    # "does quantized DCN hurt training"
+    from ompi_tpu.zero import layout as zlayout
+
+    curv = np.array([2.0, 1.0, 0.5, 0.1, 1.5, 0.25, 0.75, 1.25],
+                    np.float32)
+    tgt = np.array([3.0, -2.0, 0.5, 10.0, -0.25, 4.0, -8.0, 1.0],
+                   np.float32)
+    ef_wire = "fp8_e4m3" if jc.wire_dtype("fp8_e4m3") is not None \
+        else "bf16"
+
+    def sgd(quant):
+        w = np.zeros(8, np.float32)
+        for _ in range(120):
+            gvec = curv * (w - tgt)
+            if quant is not None:
+                gvec = quant(gvec)
+            w = w - np.float32(0.4) * gvec
+        return float(0.5 * np.sum(curv * (w - tgt) ** 2))
+
+    ef = zlayout.ErrorFeedback(ef_wire)
+    loss_exact = sgd(None)
+    loss_noef = sgd(lambda gv: H.wire_quantize(gv, ef_wire))
+    loss_ef = sgd(lambda gv: ef.apply([gv], 2)[0])
+    ef_parity = bool(loss_ef <= loss_exact + 0.05)
+
     return {
         "mesh": [n_dcn, n_ici],
         "interpret": interp,
@@ -1010,6 +1088,15 @@ def _bench_hier():
         "switchpoints": switchpoints,
         "bit_identical_linear": bit_ok,
         "hier_speedup_vs_flat": round(best, 3),
+        "dcn_wire": dcn_rows,
+        "hier_dcn_compression": round(
+            max([r["compression"] for r in dcn_rows], default=0.0), 2),
+        "dcn_model_ok": bool(all(r["model_ok"] for r in dcn_rows)),
+        "ef_wire": ef_wire,
+        "ef_loss_exact": round(loss_exact, 6),
+        "ef_loss_noef": round(loss_noef, 6),
+        "ef_loss": round(loss_ef, 6),
+        "ef_loss_parity": ef_parity,
     }
 
 
@@ -1035,6 +1122,7 @@ _EXTRA_BASELINE_KEYS = (
     ("ckpt", "restore_step1_s", False),
     ("pallas", "best_speedup_vs_xla", True),
     ("hier", "hier_speedup_vs_flat", True),
+    ("hier", "hier_dcn_compression", True),
 )
 
 

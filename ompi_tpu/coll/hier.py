@@ -51,7 +51,7 @@ monitoring report renders to answer "which level is the bottleneck".
 from __future__ import annotations
 
 import json
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -123,6 +123,82 @@ _switch_var = cvar.register(
          "largest log2 <= the payload's log2 bucket wins (the "
          "coll_pallas_switchpoints shape, one level up). Empty "
          "[default] = hierarchical whenever a split exists.", level=5)
+
+# NOTE: the dcn_dtype cvars register WITHOUT choices= on purpose —
+# choices validate at set() time, but this family's contract is the
+# bad-split one: an unknown value must surface as MPIError(ERR_ARG)
+# at the FIRST COLLECTIVE (uncached, never swallowed by query), so
+# an operator typo in an mca file fails where the collectives run.
+_dcn_dtype_var = cvar.register(
+    "coll_hier_dcn_dtype", "off", str,
+    help="Wire dtype for the hier plane's inter-slice (DCN) phase: "
+         "'off' [default] transmits the accumulate dtype — bitwise "
+         "identical to the uncompressed plane; 'bf16', 'fp8_e4m3', "
+         "'fp8_e5m2' cast-compress the DCN payload (gather in the "
+         "wire dtype + local upcast-sum; fp8 adds a per-launch scale "
+         "factor agreed by pmax in the same program). Applies to SUM "
+         "reductions of float payloads only; 'linear' determinism "
+         "and non-float dtypes always run exact. fp8 degrades to "
+         "bf16 on jax builds without fp8 lowerings. Unknown values "
+         "raise MPIError(ERR_ARG) at the first collective.", level=5)
+
+_dcn_dtype_op_vars = {
+    kind: cvar.register(
+        f"coll_hier_dcn_dtype_{kind}", "", str,
+        help=f"Per-op override of coll_hier_dcn_dtype for {kind} "
+             "launches ('off'/'bf16'/'fp8_e4m3'/'fp8_e5m2'; empty "
+             "[default] inherits the global setting) — the per-level "
+             "algorithm-choice shape coll/tuned tables use.", level=5)
+    for kind in ("allreduce", "allreduce_multi",
+                 "reduce_scatter_block")
+}
+
+#: wire-format spellings _wire_dtype accepts (resolution/probing in
+#: util.jaxcompat; byte model in monitoring.algo.WIRE_ITEMSIZE)
+_WIRE_NAMES = H.WIRE_DTYPES
+
+
+def _wire_dtype(kind: str, dtype: str, det: Optional[str],
+                opn) -> Optional[str]:
+    """The DCN wire format for this launch, or None = exact.
+
+    Resolution: per-op override > coll_hier_dcn_dtype > off. Unknown
+    values raise MPIError(ERR_ARG) HERE — slot-call time, per call,
+    the bad-split contract. Compression is declined silently (exact
+    lowering, no error) whenever the result must be bit-stable or the
+    cast cannot help: 'linear' determinism, non-SUM ops, non-float
+    payloads, or a wire format no narrower than the input dtype.
+    Unavailable fp8 degrades to bf16 (the jaxcompat capability probe)
+    with a verbose note instead of failing."""
+    v = _dcn_dtype_op_vars.get(kind)
+    spec = v.get().strip().lower() if v is not None else ""
+    if not spec:
+        spec = _dcn_dtype_var.get().strip().lower()
+    if not spec or spec == "off":
+        return None
+    if spec not in _WIRE_NAMES:
+        raise errors.MPIError(
+            errors.ERR_ARG,
+            f"coll_hier_dcn_dtype={spec!r}: expected 'off', 'bf16', "
+            "'fp8_e4m3' or 'fp8_e5m2'")
+    if det == "linear" or opn.name != "MPI_SUM":
+        return None
+    from ompi_tpu.util import jaxcompat as _jc
+
+    try:
+        ndt = _jc.np_dtype(dtype)
+    except TypeError:
+        return None
+    if ndt.kind != "f":
+        return None
+    wire = _jc.wire_degrade(spec)
+    if wire != spec:
+        _out.verbose(1, "coll_hier_dcn_dtype=%s unavailable on this "
+                        "jax: degrading to %s", spec, wire)
+    if _jc.wire_itemsize(wire) >= ndt.itemsize:
+        return None  # the "compression" would not shrink the wire
+    return wire
+
 
 #: flat-path slots coll/pallas can serve (one priority level down)
 _PALLAS_SLOTS = frozenset((
@@ -327,36 +403,76 @@ def _launch(launcher, op: str, plan: _Plan):
     return out
 
 
+def _itemsize(dtype: str) -> int:
+    """Element bytes of a dtype string over the ml_dtypes-extended
+    namespace (0 for unparseable — wire accounting then degrades to
+    the nominal model)."""
+    from ompi_tpu.util import jaxcompat as _jc
+
+    try:
+        return _jc.np_dtype(dtype).itemsize
+    except TypeError:
+        return 0
+
+
 def _account(kind: str, comm, nbytes: int, dtype: str, plan: _Plan,
-             linear: bool = False) -> None:
-    """Per-level attribution: the launch and per-level byte pvars,
-    the link map split across the ICI-axis and DCN-axis neighbor
-    edges, and the per-level totals the report renders."""
-    ici_b, dcn_b = _algo.hier_level_bytes(
-        kind, plan.n_dcn, plan.n_ici, nbytes, linear=linear)
+             linear: bool = False, wire: Optional[str] = None,
+             parts=None) -> None:
+    """Per-level attribution: the launch and per-level byte pvars
+    (nominal DCN model + actual wire bytes), the link map split
+    across the ICI-axis and DCN-axis neighbor edges, and the
+    per-level totals the report renders. ``parts`` — a list of
+    (nbytes, dtype, wire) — covers the fused multi path, whose
+    dtype-segregated buckets can mix compressed float and exact int
+    payloads in one launch; the models are linear in nbytes, so the
+    per-part sums equal the whole."""
+    if parts is None:
+        parts = ((nbytes, dtype, wire),)
+    ici_b = dcn_b = wire_b = 0.0
+    peers: dict = {}
+    for nb, dt, w in parts:
+        isz = _itemsize(dt) if w else 0
+        i_b, d_b = _algo.hier_level_bytes(
+            kind, plan.n_dcn, plan.n_ici, nb, linear=linear)
+        ici_b += i_b
+        dcn_b += d_b
+        wire_b += _algo.hier_wire_bytes(
+            kind, plan.n_dcn, plan.n_ici, nb, wire=w, itemsize=isz,
+            linear=linear)
+        for peer, b in _algo.hier_per_peer(
+                kind, comm.rank, plan.n_dcn, plan.n_ici, nb,
+                linear=linear, wire=w, itemsize=isz).items():
+            peers[peer] = peers.get(peer, 0.0) + b
     pvar.record("hier_launches")
     pvar.record("hier_ici_bytes", int(ici_b))
     pvar.record("hier_dcn_bytes", int(dcn_b))
+    pvar.record("hier_dcn_wire_bytes", int(wire_b))
     tm = _mon.TRAFFIC
     if tm is not None:
-        tm.coll(kind, comm, nbytes, dtype=dtype,
-                per_peer=_algo.hier_per_peer(
-                    kind, comm.rank, plan.n_dcn, plan.n_ici, nbytes,
-                    linear=linear))
-        tm.hier(kind, ici_b, dcn_b)
+        tm.coll(kind, comm, nbytes, dtype=dtype, per_peer=peers)
+        tm.hier(kind, ici_b, dcn_b, wire_b)
 
 
 # ---------------------------------------------------------------------------
 # lowerings — bodies run inside shard_map over the plan's 2-axis mesh
 
 
-def _split_level(flat, opn, inner: str, interp: bool):
+def _split_level(flat, opn, inner: str, interp: bool,
+                 wire: Optional[str] = None):
     """The han split-level allreduce on a flat vector whose length is
     a multiple of n_ici: ICI reduce_scatter -> DCN allreduce of the
     1/n_ici chunk -> ICI allgather. ``inner`` picks the ICI-phase
     kernels; the RS/AG pair always matches so chunk placement
-    round-trips."""
+    round-trips. ``wire`` swaps the DCN step for the cast-compressed
+    transport (``H.dcn_wire_allreduce``: gather in the wire dtype +
+    local upcast-sum, fp8 scale agreed in the same traced body) —
+    still one compiled program, the ICI phases untouched."""
     from ompi_tpu.parallel import collectives as C
+
+    def dcn_step(part):
+        if wire is not None:
+            return H.dcn_wire_allreduce(part, wire, H.DCN_AXIS)
+        return C.allreduce(part, H.DCN_AXIS, opn)
 
     if inner in ("ring", "bidir"):
         fnc = C.combine_fn(opn)
@@ -366,19 +482,19 @@ def _split_level(flat, opn, inner: str, interp: bool):
         else:
             part = K.ring_reduce_scatter(flat, H.ICI_AXIS, fnc,
                                          interpret=interp)
-        part = C.allreduce(part, H.DCN_AXIS, opn)
+        part = dcn_step(part)
         if inner == "bidir":
             return K.bidir_allgather(part, H.ICI_AXIS,
                                      interpret=interp)
         return K.ring_allgather(part, H.ICI_AXIS, interpret=interp)
     part = C.reduce_scatter(flat, H.ICI_AXIS, opn, scatter_dim=0,
                             tiled=True)
-    part = C.allreduce(part, H.DCN_AXIS, opn)
+    part = dcn_step(part)
     return C.allgather(part, H.ICI_AXIS, tiled=True, gather_dim=0)
 
 
 def _allreduce_prep(comm, sendbuf, opn, det: Optional[str],
-                    plan: _Plan):
+                    plan: _Plan, wire: Optional[str] = None):
     ctx = _xla._ctx(comm)
     if det == "linear":
         def build():
@@ -405,16 +521,20 @@ def _allreduce_prep(comm, sendbuf, opn, det: Optional[str],
                 flat = a[0].reshape(-1)
                 if pad:
                     flat = jnp.pad(flat, (0, pad))
-                red = _split_level(flat, opn, inner, interp)
+                red = _split_level(flat, opn, inner, interp, wire)
                 if pad:
                     red = red[:size]
                 return red.reshape(shape)
 
             return _smap(ctx, plan, body, out_varying=False)
 
+        # wire in the key: exact and compressed programs must never
+        # collide (toggling coll_hier_dcn_dtype back and forth reuses
+        # both cached executables, zero recompiles)
         fn = ctx.compiled(
             _xla._key(sendbuf, "hier_allreduce", "split", opn.name,
-                      plan.n_dcn, plan.n_ici, inner, interp), build)
+                      plan.n_dcn, plan.n_ici, inner, interp, wire),
+            build)
     g = ctx.to_global(sendbuf, plan.sharding)
     return lambda: ctx.my_shard(ctx.launch(fn, g))
 
@@ -432,9 +552,13 @@ def allreduce_dev(comm, sendbuf, op=op_mod.SUM,
         return _fallthrough(comm, "allreduce_dev", sendbuf, op,
                             deterministic)
     opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
+    # resolve the wire format BEFORE accounting: an unknown
+    # coll_hier_dcn_dtype raises here, per call, with nothing counted
+    wire = _wire_dtype("allreduce", str(sendbuf.dtype), det, opn)
     _account("allreduce", comm, int(sendbuf.nbytes),
-             str(sendbuf.dtype), plan, linear=det == "linear")
-    launcher = _allreduce_prep(comm, sendbuf, opn, det, plan)
+             str(sendbuf.dtype), plan, linear=det == "linear",
+             wire=wire)
+    launcher = _allreduce_prep(comm, sendbuf, opn, det, plan, wire)
     fl = _flight.FLIGHT
     if fl is None:
         return _launch(launcher, "allreduce", plan)
@@ -556,20 +680,21 @@ def alltoall_dev(comm, sendbuf):
 
 
 def _reduce_scatter_block_prep(comm, sendbuf, opn,
-                               det: Optional[str], plan: _Plan):
+                               det: Optional[str], plan: _Plan,
+                               wire: Optional[str] = None):
     ctx = _xla._ctx(comm)
     if det == "linear":
         body = lambda a: H.reduce_scatter_block_rankorder(  # noqa: E731
             a[0], op=opn)
     else:
         body = lambda a: H.reduce_scatter_rankmajor(  # noqa: E731
-            a[0], op=opn)
+            a[0], op=opn, wire=wire)
 
     def build():
         return _smap(ctx, plan, body, out_varying=True)
 
     fn = ctx.compiled(_xla._key(sendbuf, "hier_rsb", opn.name, det,
-                                plan.n_dcn, plan.n_ici), build)
+                                plan.n_dcn, plan.n_ici, wire), build)
     g = ctx.to_global(sendbuf, plan.sharding)
     return lambda: ctx.my_shard(ctx.launch(fn, g))
 
@@ -588,10 +713,13 @@ def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
         return _fallthrough(comm, "reduce_scatter_block_dev", sendbuf,
                             op, deterministic)
     opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
+    wire = _wire_dtype("reduce_scatter_block", str(sendbuf.dtype),
+                       det, opn)
     _account("reduce_scatter_block", comm, int(sendbuf.nbytes),
-             str(sendbuf.dtype), plan, linear=det == "linear")
+             str(sendbuf.dtype), plan, linear=det == "linear",
+             wire=wire)
     launcher = _reduce_scatter_block_prep(comm, sendbuf, opn, det,
-                                          plan)
+                                          plan, wire)
     fl = _flight.FLIGHT
     if fl is None:
         return _launch(launcher, "reduce_scatter_block", plan)
@@ -612,12 +740,16 @@ def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
 
 
 def _hier_bucket_fn(ctx, metas, idxs, opn, det: Optional[str],
-                    plan: _Plan, interp: bool):
+                    plan: _Plan, interp: bool,
+                    wire: Optional[str] = None):
     """ONE compiled concat + two-level-allreduce + split program per
     bucket. Under 'linear' the body is the rank-order fold —
     concatenation never changes an element's per-rank fold order, so
     fused == per-buffer bit for bit (the same argument as the flat
-    fused path, tested)."""
+    fused path, tested). ``wire`` (per bucket — buckets are
+    dtype-segregated, so a float bucket can compress while its int
+    sibling runs exact in the same multi launch) swaps the DCN step,
+    and joins the cache key so exact/compressed never collide."""
     sig = tuple((metas[i][0], metas[i][1]) for i in idxs)
     elems = sum(int(np.prod(metas[i][0], dtype=np.int64))
                 for i in idxs)
@@ -642,7 +774,7 @@ def _hier_bucket_fn(ctx, metas, idxs, opn, det: Optional[str],
             else:
                 if pad:
                     flat = jnp.pad(flat, (0, pad))
-                red = _split_level(flat, opn, inner, interp)
+                red = _split_level(flat, opn, inner, interp, wire)
                 if pad:
                     red = red[:elems]
             outs, off = [], 0
@@ -655,8 +787,8 @@ def _hier_bucket_fn(ctx, metas, idxs, opn, det: Optional[str],
         return _smap(ctx, plan, body, out_varying=False)
 
     return ctx.compiled(("hier_fused", sig, opn.name, det,
-                         plan.n_dcn, plan.n_ici, inner, interp),
-                        build)
+                         plan.n_dcn, plan.n_ici, inner, interp,
+                         wire), build)
 
 
 def _hier_fuse_prep(comm, leaves, treedef, opn, det: Optional[str],
@@ -670,7 +802,10 @@ def _hier_fuse_prep(comm, leaves, treedef, opn, det: Optional[str],
 
     launches = []
     for idxs in fplan.buckets:
-        fn = _hier_bucket_fn(ctx, metas, idxs, opn, det, plan, interp)
+        wire = _wire_dtype("allreduce_multi", metas[idxs[0]][1], det,
+                           opn)
+        fn = _hier_bucket_fn(ctx, metas, idxs, opn, det, plan, interp,
+                             wire)
         gs = tuple(ctx.to_global(leaves[i], plan.sharding)
                    for i in idxs)
         launches.append((fn, gs, idxs))
@@ -686,6 +821,21 @@ def _hier_fuse_prep(comm, leaves, treedef, opn, det: Optional[str],
         return jax.tree.unflatten(treedef, outs)
 
     return launch
+
+
+def _multi_parts(leaves, det, opn):
+    """Dtype-grouped (nbytes, dtype, wire) accounting parts for a
+    fused multi launch: the byte models are linear in nbytes, so
+    grouped sums account exactly, and resolving every group's wire
+    here (before ``_account``) keeps the unknown-cvar MPIError
+    per-call with nothing counted."""
+    groups: Dict[str, int] = {}
+    for b in leaves:
+        dt = str(getattr(b, "dtype", ""))
+        groups[dt] = groups.get(dt, 0) + int(getattr(b, "nbytes", 0))
+    return tuple(
+        (nb, dt, _wire_dtype("allreduce_multi", dt, det, opn))
+        for dt, nb in groups.items())
 
 
 def allreduce_multi_dev(comm, bufs, op=op_mod.SUM,
@@ -706,7 +856,8 @@ def allreduce_multi_dev(comm, bufs, op=op_mod.SUM,
     opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
     _, treedef = jax.tree.flatten(bufs)
     _account("allreduce_multi", comm, nb, dt, plan,
-             linear=det == "linear")
+             linear=det == "linear",
+             parts=_multi_parts(leaves, det, opn))
     launcher = _hier_fuse_prep(comm, leaves, treedef, opn, det, plan)
     fl = _flight.FLIGHT
     if fl is None:
@@ -735,12 +886,15 @@ def _allreduce_pprep(comm, sendbuf, op=op_mod.SUM,
         pvar.record("hier_fallthrough")
         return _xla._allreduce_prep(comm, sendbuf, op, deterministic)
     opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
-    raw = _allreduce_prep(comm, sendbuf, opn, det, plan)
+    # wire format resolves at init time, like the plan: a persistent
+    # handle keeps the schedule it was built with across Start() calls
+    wire = _wire_dtype("allreduce", str(sendbuf.dtype), det, opn)
+    raw = _allreduce_prep(comm, sendbuf, opn, det, plan, wire)
     nb, dt = int(sendbuf.nbytes), str(sendbuf.dtype)
 
     def run():
         _account("allreduce", comm, nb, dt, plan,
-                 linear=det == "linear")
+                 linear=det == "linear", wire=wire)
         return raw()
 
     return run
@@ -760,11 +914,15 @@ def _allreduce_multi_pprep(comm, bufs, op=op_mod.SUM,
         return _xla._allreduce_multi_prep(comm, bufs, op,
                                           deterministic)
     opn = op if isinstance(op, op_mod.Op) else op_mod.BUILTIN[op]
+    # per-bucket wire resolves inside _hier_fuse_prep at init time;
+    # the accounting parts are captured alongside so every Start()
+    # reports what the frozen schedule actually transmits
+    parts = _multi_parts(leaves, det, opn)
     raw = _hier_fuse_prep(comm, leaves, treedef, opn, det, plan)
 
     def run():
         _account("allreduce_multi", comm, nb, dt, plan,
-                 linear=det == "linear")
+                 linear=det == "linear", parts=parts)
         return raw()
 
     return run

@@ -126,9 +126,18 @@ WELL_KNOWN = (
     # coll/hier (two-level ICI x DCN collectives): hierarchical
     # launches, fused bucket launches riding the two-level lowering,
     # staged fallthroughs to the flat path, and per-level bytes — the
-    # DCN figure is the one the smoke lane bounds at payload/ici_size
+    # DCN figure is the one the smoke lane bounds at payload/ici_size;
+    # hier_dcn_wire_bytes is what the slow wire ACTUALLY carried
+    # (== nominal for exact launches, smaller under the compressed
+    # bf16/fp8 coll_hier_dcn_dtype formats — the smoke lane bounds
+    # the ratio at <=1/2 / <=1/4)
     "hier_launches", "hier_fused_launches", "hier_fallthrough",
-    "hier_ici_bytes", "hier_dcn_bytes",
+    "hier_ici_bytes", "hier_dcn_bytes", "hier_dcn_wire_bytes",
+    # zero/ error feedback (compressed-gradient residual carry): steps
+    # that ran the quantize-and-carry cycle, and gradient payload
+    # bytes quantized (Seide'14/Lin'18 — the residual keeps lossy
+    # reduction convergence-neutral)
+    "zero_ef_steps", "zero_ef_bytes",
     # ft/ failure plane: heartbeats emitted by the detector thread,
     # faults/revocations applied on the progress engine, and the
     # eventful-sweep wall (the hot no-news path is untimed — the

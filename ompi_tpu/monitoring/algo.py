@@ -128,17 +128,63 @@ def hier_level_bytes(op: str, n_dcn: int, n_ici: int,
     return (0.0, 0.0)
 
 
+#: bytes/element of the compressed-DCN wire formats — a literal copy
+#: of the util.jaxcompat table, kept here so this accounting module
+#: stays import-free (no jax/ml_dtypes just to model bytes)
+WIRE_ITEMSIZE = {"bf16": 2.0, "fp8_e4m3": 1.0, "fp8_e5m2": 1.0}
+
+#: scale-factor exchange cost of one fp8 launch (a 4-byte pmax over
+#: the DCN axis inside the same program)
+_FP8_SCALE_BYTES = 4.0
+
+#: ops whose compressed-DCN transport the hier plane implements
+_WIRE_OPS = _RS_AG | frozenset((
+    "reduce_scatter", "reduce_scatter_block", "reduce_scatter_multi"))
+
+
+def hier_wire_bytes(op: str, n_dcn: int, n_ici: int, nbytes: int,
+                    wire: Optional[str] = None,
+                    itemsize: int = 0, linear: bool = False) -> float:
+    """ACTUAL DCN bytes one rank moves for a coll/hier launch — the
+    figure ``hier_dcn_wire_bytes`` records next to the nominal model
+    of :func:`hier_level_bytes`. Equal to the nominal DCN bytes for an
+    exact launch (``wire`` None/unknown, linear fold, or unknown
+    ``itemsize``); compressed launches transmit the ICI shard once in
+    the wire dtype (gather + local upcast-sum replaces the exact
+    phase's reduce_scatter+allgather pair), so:
+
+    - allreduce family: ``(B·f/n_ici)·(n_dcn-1)/n_dcn`` with
+      ``f = wire_itemsize/itemsize`` — nominal × f/2 (bf16 ¼, fp8 ⅛).
+    - reduce_scatter family: nominal × f (bf16 ½, fp8 ¼).
+    - fp8 adds the 4-byte scale-factor pmax.
+    """
+    _ici, dcn = hier_level_bytes(op, n_dcn, n_ici, nbytes,
+                                 linear=linear)
+    w = WIRE_ITEMSIZE.get(wire or "")
+    if w is None or linear or itemsize <= 0 or op not in _WIRE_OPS:
+        return dcn
+    f = w / float(itemsize)
+    wired = dcn * f / 2.0 if op in _RS_AG else dcn * f
+    if str(wire).startswith("fp8"):
+        wired += _FP8_SCALE_BYTES
+    return wired
+
+
 def hier_per_peer(op: str, rank: int, n_dcn: int, n_ici: int,
-                  nbytes: int,
-                  linear: bool = False) -> Dict[int, float]:
+                  nbytes: int, linear: bool = False,
+                  wire: Optional[str] = None,
+                  itemsize: int = 0) -> Dict[int, float]:
     """Bytes `rank` SENDS per comm-local peer for one coll/hier
     launch, split by level: the ICI share rides the intra-slice ring
     edge (rank's row successor), the DCN share the inter-slice edge
     (same column, next slice) — so the link map separates fast-axis
     from slow-axis load instead of smearing both onto one flat ring
-    edge."""
-    ici_b, dcn_b = hier_level_bytes(op, n_dcn, n_ici, nbytes,
-                                    linear=linear)
+    edge. ``wire``/``itemsize`` charge the DCN edge the ACTUAL
+    (compressed) transmit bytes of :func:`hier_wire_bytes`."""
+    ici_b, _nom = hier_level_bytes(op, n_dcn, n_ici, nbytes,
+                                   linear=linear)
+    dcn_b = hier_wire_bytes(op, n_dcn, n_ici, nbytes, wire=wire,
+                            itemsize=itemsize, linear=linear)
     if not ici_b and not dcn_b:
         return {}
     s, j = divmod(rank, n_ici)

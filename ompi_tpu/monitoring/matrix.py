@@ -82,7 +82,10 @@ class TrafficMatrix:
         # (op, log2 size bucket, dtype, mesh shape) -> [launches, bytes]
         self.coll_records: Dict[Tuple[str, int, str, Tuple[int, ...]],
                                 List[float]] = {}
-        # coll/hier per-level totals: op -> [launches, ici_b, dcn_b]
+        # coll/hier per-level totals:
+        # op -> [launches, ici_b, dcn_b, dcn_wire_b] — dcn_b is the
+        # nominal (accumulate-dtype) model, dcn_wire_b what the wire
+        # actually carried (equal unless the DCN phase is compressed)
         self.hier_levels: Dict[str, List[float]] = {}
         self.link_bytes: Dict[Link, float] = {}
         self.expert: Dict[int, int] = {}
@@ -150,19 +153,24 @@ class TrafficMatrix:
         for peer, b in per_peer.items():
             self.count(ctx, world_rank(comm, peer), b)
 
-    def hier(self, op: str, ici_bytes: float,
-             dcn_bytes: float) -> None:
+    def hier(self, op: str, ici_bytes: float, dcn_bytes: float,
+             dcn_wire_bytes: Optional[float] = None) -> None:
         """Account one coll/hier launch's per-level byte split — the
         table that lets the report answer "which level is the
         bottleneck" (the per-peer spatial view goes through
-        :meth:`coll` separately)."""
+        :meth:`coll` separately). ``dcn_wire_bytes`` is the actual
+        transmitted DCN figure (defaults to nominal = exact launch);
+        the report recomputes its verdict from it."""
+        if dcn_wire_bytes is None:
+            dcn_wire_bytes = dcn_bytes
         with self.lock:
             rec = self.hier_levels.get(op)
             if rec is None:
-                rec = self.hier_levels[op] = [0, 0.0, 0.0]
+                rec = self.hier_levels[op] = [0, 0.0, 0.0, 0.0]
             rec[0] += 1
             rec[1] += float(ici_bytes)
             rec[2] += float(dcn_bytes)
+            rec[3] += float(dcn_wire_bytes)
 
     @staticmethod
     def _mesh_shape(comm) -> Tuple[int, ...]:

@@ -141,10 +141,13 @@ def merge(docs: List[Dict[str, object]]) -> Dict[str, object]:
     hier_levels: Dict[str, List[float]] = {}
     for doc in docs:
         for op, rec in doc.get("hier_levels", {}).items():
-            got = hier_levels.setdefault(op, [0, 0.0, 0.0])
+            got = hier_levels.setdefault(op, [0, 0.0, 0.0, 0.0])
             got[0] += rec[0]
             got[1] += rec[1]
             got[2] += rec[2]
+            # pre-compression dumps carry 3 elements: the wire figure
+            # IS the nominal one (every launch was exact)
+            got[3] += rec[3] if len(rec) > 3 else rec[2]
 
     return {
         "schema": SCHEMA + "+merged",

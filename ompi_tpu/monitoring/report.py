@@ -120,24 +120,39 @@ def render(merged: Dict[str, object], top: int = 5) -> str:
     if hier:
         tot_ici = sum(rec[1] for rec in hier.values())
         tot_dcn = sum(rec[2] for rec in hier.values())
+        # actual transmitted DCN bytes (compressed wire formats);
+        # 3-element records predate compression — wire == nominal
+        tot_wire = sum(rec[3] if len(rec) > 3 else rec[2]
+                       for rec in hier.values())
         # which level is the bottleneck: weight the slow axis by the
         # nominal ICI/DCN bandwidth gap (order of magnitude) before
-        # comparing byte loads
+        # comparing byte loads — against what the wire ACTUALLY
+        # carried, else a compressed job would keep reading DCN-bound
         if tot_dcn > 0:
-            verdict = "DCN-bound" if tot_dcn * 10.0 >= tot_ici \
+            verdict = "DCN-bound" if tot_wire * 10.0 >= tot_ici \
                 else "ICI-bound"
-            out.append(f"[hier] two-level collectives: "
-                       f"ICI {_fmt_bytes(tot_ici)} / "
-                       f"DCN {_fmt_bytes(tot_dcn)} "
-                       f"(ratio {tot_ici / tot_dcn:.1f}:1; {verdict} "
-                       "at a nominal 10x slower DCN)")
+            line = (f"[hier] two-level collectives: "
+                    f"ICI {_fmt_bytes(tot_ici)} / "
+                    f"DCN {_fmt_bytes(tot_wire)} on the wire")
+            if tot_wire < tot_dcn:
+                line += (f" ({_fmt_bytes(tot_dcn)} nominal, "
+                         f"{tot_dcn / max(tot_wire, 1e-9):.1f}x "
+                         "compressed)")
+            line += (f" (ratio {tot_ici / max(tot_wire, 1e-9):.1f}:1;"
+                     f" {verdict} at a nominal 10x slower DCN)")
+            out.append(line)
         else:
             out.append(f"[hier] two-level collectives: "
                        f"ICI {_fmt_bytes(tot_ici)} / DCN 0B")
         for op, rec in list(hier.items())[:top]:
-            out.append(f"  {op:<22s} {rec[0]:.0f} launches  "
-                       f"ICI {_fmt_bytes(float(rec[1])):>10s}  "
-                       f"DCN {_fmt_bytes(float(rec[2])):>10s}")
+            wire = float(rec[3] if len(rec) > 3 else rec[2])
+            line = (f"  {op:<22s} {rec[0]:.0f} launches  "
+                    f"ICI {_fmt_bytes(float(rec[1])):>10s}  "
+                    f"DCN {_fmt_bytes(wire):>10s}")
+            if wire < float(rec[2]):
+                line += (f" (nominal "
+                         f"{_fmt_bytes(float(rec[2]))})")
+            out.append(line)
     experts = merged.get("expert_tokens", {})
     if experts:
         total = sum(experts.values()) or 1

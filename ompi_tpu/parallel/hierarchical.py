@@ -38,6 +38,10 @@ from ompi_tpu.parallel import collectives as C
 DCN_AXIS = "dcn"
 ICI_AXIS = "ici"
 
+#: compressed-DCN wire formats (resolution + the old-jax capability
+#: probe live in util.jaxcompat; byte accounting in monitoring.algo)
+WIRE_DTYPES = ("bf16", "fp8_e4m3", "fp8_e5m2")
+
 
 def slice_split(devices) -> int:
     """Number of DCN groups a device list forms (0 = stay flat).
@@ -250,6 +254,73 @@ def alltoall(x, ici_axis: str = ICI_AXIS, dcn_axis: str = DCN_AXIS):
     return C.alltoall(body, dcn_axis, split_dim=0, concat_dim=0)
 
 
+def dcn_wire_allreduce(x, wire: str, dcn_axis: str = DCN_AXIS):
+    """SUM-allreduce over the DCN axis with the payload transmitted in
+    the ``wire`` dtype (the compressed inter-slice phase; Seide et al.
+    2014 / Lin et al. 2018 established that lossy reduction of this
+    shape is convergence-neutral with error feedback carried locally).
+
+    XLA's ``psum`` cannot split its transfer dtype from its
+    accumulation dtype, and accumulating IN fp8 would saturate after a
+    few addends — so the lowering is gather-in-wire-dtype + local
+    upcast-sum: each rank ships its cast shard once, decodes to the
+    accumulate dtype, and folds the ``n_dcn`` stack locally. DCN
+    carries ``wire_itemsize/itemsize`` of the exact phase's bytes (and
+    half its passes — one gather vs reduce_scatter+allgather).
+
+    fp8 adds a per-shard scale factor ``pmax(amax)/finfo.max`` agreed
+    over the axis inside the same traced body (every rank encodes and
+    decodes with the identical factor, one compiled program); bf16 is
+    a plain cast. SUM only — the callers force exact for other ops.
+    """
+    from ompi_tpu.util import jaxcompat as _jc
+
+    wdt = _jc.wire_dtype(wire)
+    if wdt is None:
+        raise errors.MPIError(
+            errors.ERR_ARG,
+            f"dcn_wire_allreduce: wire dtype {wire!r} unavailable on "
+            f"this stack (supported: {sorted(WIRE_DTYPES)})")
+    acc = x.dtype
+    scale = None
+    if wire.startswith("fp8"):
+        fmax = _jc.wire_finfo_max(wire)
+        amax = lax.pmax(jnp.max(jnp.abs(x)), dcn_axis)
+        scale = jnp.where(amax > 0, amax / fmax,
+                          jnp.ones((), acc)).astype(acc)
+        x = x / scale
+    g = lax.all_gather(x.astype(wdt), dcn_axis)  # [n_dcn, ...] wire
+    red = jnp.sum(g.astype(acc), axis=0)
+    return red if scale is None else red * scale
+
+
+def wire_quantize(x, wire: str):
+    """Eager ``Q(x)``: the value a wire-dtype transport would deliver
+    for ``x``, returned in ``x``'s dtype — the error-feedback residual
+    is ``x - wire_quantize(x)``. Elementwise and deterministic, so a
+    source that carries the residual forward needs nothing back from
+    the collective. fp8 uses the same per-array ``amax/finfo.max``
+    scale shape as :func:`dcn_wire_allreduce`; bf16 is a cast
+    round-trip. Works on numpy and jax arrays alike (the host and
+    device ZeRO paths share it)."""
+    from ompi_tpu.util import jaxcompat as _jc
+
+    wdt = _jc.wire_dtype(wire)
+    if wdt is None:
+        raise errors.MPIError(
+            errors.ERR_ARG,
+            f"wire_quantize: wire dtype {wire!r} unavailable on this "
+            f"stack (supported: {sorted(WIRE_DTYPES)})")
+    xp = np if isinstance(x, np.ndarray) else jnp
+    if wire.startswith("fp8"):
+        fmax = _jc.wire_finfo_max(wire)
+        amax = xp.max(xp.abs(x))
+        scale = xp.where(amax > 0, amax / fmax,
+                         xp.ones((), x.dtype)).astype(x.dtype)
+        return (x / scale).astype(wdt).astype(x.dtype) * scale
+    return x.astype(wdt).astype(x.dtype)
+
+
 def barrier(ici_axis: str = ICI_AXIS, dcn_axis: str = DCN_AXIS):
     """Returns a dependence token (sum of both levels' tokens) the
     caller must thread into downstream computation — as with
@@ -320,7 +391,8 @@ def reduce_scatter_block_rankorder(x, ici_axis: str = ICI_AXIS,
 
 def reduce_scatter_rankmajor(x, ici_axis: str = ICI_AXIS,
                              dcn_axis: str = DCN_AXIS, op=op_mod.SUM,
-                             deterministic: Optional[str] = None):
+                             deterministic: Optional[str] = None,
+                             wire: Optional[str] = None):
     """Split-level reduce_scatter with MPI rank-major placement.
 
     :func:`reduce_scatter` above is ici-major (rank (s,j) holds block
@@ -330,6 +402,11 @@ def reduce_scatter_rankmajor(x, ici_axis: str = ICI_AXIS,
     permute, body block j*n_dcn+s is original block s*n_ici+j, phase 1
     hands ICI-rank j the blocks {*, j}, phase 2 hands DCN-rank s its
     one block. Bulk bytes stay on ICI; DCN moves 1/n_ici of the input.
+
+    ``wire`` compresses the DCN phase: the phase-2 scatter becomes a
+    :func:`dcn_wire_allreduce` of the ICI shard plus a static slice of
+    this rank's DCN block — identical placement, the slow wire carries
+    the shard in the wire dtype instead of the accumulate dtype.
     """
     n_ici = C.axis_size(ici_axis)
     n_dcn = C.axis_size(dcn_axis)
@@ -340,5 +417,10 @@ def reduce_scatter_rankmajor(x, ici_axis: str = ICI_AXIS,
     body = body.reshape((n * k,) + rest)
     part = C.reduce_scatter(body, ici_axis, op, scatter_dim=0,
                             tiled=True, deterministic=deterministic)
-    return C.reduce_scatter(part, dcn_axis, op, scatter_dim=0,
-                            tiled=True, deterministic=deterministic)
+    if wire is None:
+        return C.reduce_scatter(part, dcn_axis, op, scatter_dim=0,
+                                tiled=True,
+                                deterministic=deterministic)
+    full = dcn_wire_allreduce(part, wire, dcn_axis)
+    s = C.axis_index(dcn_axis)
+    return lax.dynamic_slice_in_dim(full, s * k, k, axis=0)

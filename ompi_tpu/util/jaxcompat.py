@@ -61,6 +61,93 @@ def pallas_tpu():
     return pltpu
 
 
+#: wire-format name -> (ml_dtypes attribute, bytes/element) for the
+#: compressed-DCN transports. bf16 ships with every ml_dtypes (a jax
+#: hard dep); the fp8 pair additionally needs this jax to cast through
+#: it — probed once below, so call sites never version-check inline.
+_WIRE_SPECS = (
+    ("bf16", "bfloat16", 2),
+    ("fp8_e4m3", "float8_e4m3fn", 1),
+    ("fp8_e5m2", "float8_e5m2", 1),
+)
+
+_wire_cache: dict = {}
+
+
+def _fp8_cast_ok(dt) -> bool:
+    """Can this jax round-trip f32 -> dt -> f32? False on old releases
+    whose XLA lacks the fp8 convert lowering — the degrade signal."""
+    try:
+        import jax.numpy as jnp
+
+        x = jnp.asarray([1.0], jnp.float32).astype(dt)
+        return bool(x.astype(jnp.float32)[0] == 1.0)
+    except Exception:  # noqa: BLE001 — any failure means "unsupported"
+        return False
+
+
+def _wire_table() -> dict:
+    """name -> numpy dtype of every wire format THIS stack supports,
+    built once (ml_dtypes lookup + the jax cast probe)."""
+    table = _wire_cache.get("table")
+    if table is None:
+        import ml_dtypes
+        import numpy as np
+
+        table = {}
+        for name, attr, _isz in _WIRE_SPECS:
+            dt = getattr(ml_dtypes, attr, None)
+            if dt is None:
+                continue
+            if name.startswith("fp8") and not _fp8_cast_ok(dt):
+                continue
+            table[name] = np.dtype(dt)
+        _wire_cache["table"] = table
+    return table
+
+
+def wire_dtype(name: str):
+    """numpy dtype for a compressed-DCN wire-format name ('bf16',
+    'fp8_e4m3', 'fp8_e5m2'), or None when this jax/ml_dtypes stack
+    cannot represent it."""
+    return _wire_table().get(name)
+
+
+def wire_itemsize(name: str) -> int:
+    """Bytes per element of a wire format (0 for unknown names) —
+    static, no capability probe, safe for pure byte accounting."""
+    for n, _attr, isz in _WIRE_SPECS:
+        if n == name:
+            return isz
+    return 0
+
+
+def wire_finfo_max(name: str) -> float:
+    """Largest finite value of a wire format (the fp8 scale-factor
+    denominator). ``ml_dtypes.finfo``, not ``np.finfo`` — numpy's
+    rejects the extended dtypes it did not define."""
+    import ml_dtypes
+
+    return float(ml_dtypes.finfo(_wire_table()[name]).max)
+
+
+def wire_degrade(name: str) -> str:
+    """The requested wire format when this stack supports it, else
+    'bf16' — old jax without fp8 lowerings degrades instead of raising
+    at the call site (the ROADMAP no-inline-version-checks rule)."""
+    return name if name in _wire_table() else "bf16"
+
+
+def np_dtype(name: str):
+    """``np.dtype`` over the ml_dtypes-extended namespace: 'bfloat16'
+    and the float8 spellings resolve like builtins (importing
+    ml_dtypes registers them with numpy)."""
+    import ml_dtypes  # noqa: F401 — import registers extended dtypes
+    import numpy as np
+
+    return np.dtype(name)
+
+
 def pallas_remote_dma_ok() -> bool:
     """Whether this jax build can *execute* ``make_async_remote_copy``
     kernels on the current default backend. True only on real TPU —

@@ -85,6 +85,14 @@ class ZeroOptimizer:
       back to the unfused sequence below, the same staged-fallthrough
       shape the device collectives use. Bit-identical to unfused
       under ``deterministic='linear'``.
+    - ``error_feedback`` (optional wire-format name: ``'bf16'``,
+      ``'fp8_e4m3'``, ``'fp8_e5m2'``): quantize each step's gradients
+      to the wire format at the source with a carried per-bucket
+      residual (:class:`~ompi_tpu.zero.layout.ErrorFeedback` — the
+      1-bit-SGD/DGC compensation scheme), the training-side companion
+      of ``coll_hier_dcn_dtype``. Mutually exclusive with ``fused``
+      (the fused kernel consumes raw gradients in-register; there is
+      no host point to carry the residual at).
     - ``frozen`` (optional pytree of bools matching ``params``): True
       marks a non-trainable leaf. Buckets whose members are ALL
       frozen are excluded from the shard update (their
@@ -102,6 +110,7 @@ class ZeroOptimizer:
                  overlap: bool = False,
                  grad_average: bool = True,
                  fused: bool = False,
+                 error_feedback: Optional[str] = None,
                  frozen=None) -> None:
         if stage not in (1, 2):
             raise errors.MPIError(
@@ -123,6 +132,13 @@ class ZeroOptimizer:
                 "gradient in-kernel — stage 2 only, and mutually "
                 "exclusive with overlap (the partitioned request "
                 "already owns the reduce_scatter)")
+        if fused and error_feedback is not None:
+            raise errors.MPIError(
+                errors.ERR_ARG,
+                "ZeroOptimizer: error_feedback quantizes gradients "
+                "before the collective and carries the residual on "
+                "the host — the fused in-kernel path has no such "
+                "point; pick one")
         if fused and frozen is not None:
             raise errors.MPIError(
                 errors.ERR_ARG,
@@ -137,6 +153,11 @@ class ZeroOptimizer:
         self._det = deterministic
         self._avg = bool(grad_average)
         self._fused = bool(fused)
+        # ctor-time validation (MPIError(ERR_ARG) on unknown names),
+        # step-time application: EF state binds lazily to the grads'
+        # own ZeroPlan at the first step
+        self._ef = _layout.ErrorFeedback(error_feedback) \
+            if error_feedback is not None else None
         # every rank holds the full initial params: the shard is a
         # local slice, no collective
         self._pshards = _layout.ShardedState.from_full(comm, params)
@@ -215,7 +236,14 @@ class ZeroOptimizer:
         # constants cast to the shard dtype: a bare python float would
         # upcast numpy f32 shards to f64 (dtype drift across the
         # host/device paths would break the bit-identity contract)
-        g = self._grad_shards(self._mask_frozen(grads))
+        g = self._mask_frozen(grads)
+        if self._ef is not None:
+            # quantize-at-source AFTER the frozen mask (a frozen
+            # leaf's zeros quantize to zeros, residual stays zero) and
+            # BEFORE the collective, so any transport reduces exactly
+            # what the residual accounts for
+            g = self._ef.apply(g, self._comm.size)
+        g = self._grad_shards(g)
         if self._avg:
             inv = 1.0 / self._comm.size
             g = g.map(lambda s: s * np.asarray(inv, s.dtype))

@@ -150,6 +150,11 @@ class Zero3Optimizer:
       trivial requests re-init, same cost).
     - :meth:`matmul` is the fused gather→use fast path (coll_pallas
       ``zero3_gather_matmul_dev``), falling through to fetch + dot.
+    - ``error_feedback`` (optional ``'bf16'``/``'fp8_e4m3'``/
+      ``'fp8_e5m2'``): quantize each layer's gradients at the source
+      with a per-layer carried residual
+      (:class:`~ompi_tpu.zero.layout.ErrorFeedback`) before the
+      reduce_scatter — the stage-3 shape of the stage-1/2 option.
 
     Host (numpy) parameters run the same cycle over the stacked host
     collectives — prefetch degrades to eager blocking gathers (every
@@ -160,6 +165,7 @@ class Zero3Optimizer:
                  momentum: float = 0.0,
                  deterministic: Optional[str] = None,
                  grad_average: bool = True,
+                 error_feedback: Optional[str] = None,
                  prefetch_depth: int = 1) -> None:
         import jax
 
@@ -169,6 +175,13 @@ class Zero3Optimizer:
         self._det = deterministic
         self._avg = bool(grad_average)
         self.plan = Zero3Plan(params, comm.size)
+        # one residual carry per LAYER: stage-3 reduces gradients a
+        # layer at a time, and each layer's leaves pack their own
+        # ZeroPlan — the per-bucket residual layout follows it
+        self._efs: Optional[List[_layout.ErrorFeedback]] = (
+            [_layout.ErrorFeedback(error_feedback)
+             for _ in range(self.plan.n_layers)]
+            if error_feedback is not None else None)
         leaves = jax.tree.leaves(params)
         from ompi_tpu import accelerator
 
@@ -373,8 +386,12 @@ class Zero3Optimizer:
                 f"{self.plan.n_leaves}-leaf template")
         for g in reversed(range(self.plan.n_layers)):
             idxs = self.plan.groups[g][1]
+            layer_grads = [glaves[i] for i in idxs]
+            if self._efs is not None:
+                layer_grads = self._efs[g].apply(layer_grads,
+                                                 self._comm.size)
             gs = self._comm.Reduce_scatter_multi(
-                [glaves[i] for i in idxs], op_mod.SUM,
+                layer_grads, op_mod.SUM,
                 deterministic=self._det)
             if self._avg:
                 inv = 1.0 / self._comm.size

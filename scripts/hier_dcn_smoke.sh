@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# coll/hier compressed-DCN smoke lane: 4-rank CPU run of
+# examples/hier_dcn_compress.py on the faked 2x2 grid. The example
+# asserts the contracts itself — 'off' bitwise-stable across
+# compression toggles, bf16 wire <= 1/2 and fp8 <= 1/4 of the exact
+# launch's nominal hier_dcn_bytes, 'linear' forced exact, EF SGD loss
+# parity — so the lane runs it, checks the success line, re-asserts
+# the byte bounds from the JSON summary, and keeps it as an artifact.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+outdir="${1:-hier_dcn_smoke_out}"
+mkdir -p "$outdir"
+
+out=$(JAX_PLATFORMS=cpu \
+  OMPI_TPU_HIER_DCN_ARTIFACT="$outdir/hier_dcn_summary.json" \
+  python -m ompi_tpu.runtime.launcher -n 4 \
+  --timeout 120 \
+  --mca device_plane on \
+  --mca coll_hier on \
+  --mca coll_hier_split 2x2 \
+  examples/hier_dcn_compress.py)
+echo "$out"
+echo "$out" | grep -q "off bitwise-stable across toggles" \
+  || { echo "hier dcn smoke: missing bitwise-toggle line" >&2; exit 1; }
+echo "$out" | grep -q "EF loss parity" \
+  || { echo "hier dcn smoke: missing EF parity line" >&2; exit 1; }
+[ -s "$outdir/hier_dcn_summary.json" ] \
+  || { echo "hier dcn smoke: summary artifact missing" >&2; exit 1; }
+python - "$outdir/hier_dcn_summary.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+assert d["provider"] == "hier", d
+assert d["exact_wire_eq"] and d["toggle_bitwise"], d
+assert d["linear_exact"], d
+r = d["wire_ratios"]
+assert r["bf16"] <= 0.5, r
+for w in ("fp8_e4m3", "fp8_e5m2"):
+    if w in r:  # absent only when old jax degraded fp8 to bf16
+        assert r[w] <= 0.25, r
+assert all(d["wire_allclose"].values()), d
+assert d["ef_loss_parity"], d
+EOF
+echo "hier dcn smoke OK"

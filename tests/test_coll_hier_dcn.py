@@ -1,0 +1,381 @@
+"""Compressed DCN wire formats (coll/hier fp8/bf16 cast-compress) +
+error feedback (zero/layout.ErrorFeedback).
+
+The acceptance bars: ``coll_hier_dcn_dtype=off`` (the default) is
+BITWISE identical to the uncompressed plane — including after
+toggling compression on and back off, with ZERO recompiles (the wire
+format lives in the compiled-program cache key, so both executables
+coexist); bf16 transmits <= 1/2 and fp8 <= 1/4 of the exact launch's
+nominal DCN bytes (``hier_dcn_wire_bytes`` vs ``hier_dcn_bytes``);
+'linear' determinism and non-float dtypes always run exact; an
+unknown cvar value raises MPIError(ERR_ARG) at every collective
+(uncached — the bad-split contract); and the error-feedback carry
+keeps an accumulated quantized-gradient sum within one quantization
+step of exact where the carry-free quantizer drifts linearly.
+"""
+
+import numpy as np
+import pytest
+
+from tests.harness import run_ranks
+
+
+def _mca(split="2x2"):
+    return {"device_plane": "on", "coll_hier": "on",
+            "coll_hier_split": split}
+
+
+def test_off_by_default_bitwise_across_toggles():
+    """'off' == the uncompressed plane bitwise, and STAYS bitwise
+    after a compressed launch in between — plus the wire-byte bounds
+    per dtype (bf16 <= 1/2, fp8 <= 1/4 of nominal) and wire-precision
+    agreement of the compressed results."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import cvar, pvar
+    from ompi_tpu.util import jaxcompat as jc
+    rng = np.random.default_rng(29)
+    h = ((rng.random(2048).astype(np.float32) + 0.1)
+         * (10.0 ** rng.integers(-2, 3, 2048))).astype(np.float32)
+    x = jnp.asarray(np.roll(h, rank * 7))
+
+    def launch(wire):
+        cvar.set("coll_hier_dcn_dtype", wire)
+        try:
+            s = pvar.session()
+            out = np.asarray(comm.coll.allreduce_dev(comm, x))
+            return out, s.read("hier_dcn_bytes"), \\
+                s.read("hier_dcn_wire_bytes")
+        finally:
+            cvar.set("coll_hier_dcn_dtype", "off")
+
+    a1, nom, w_off = launch("off")
+    assert nom > 0 and w_off == nom, (nom, w_off)
+    for wire, bound, rtol in (("bf16", 0.5, 0.02),
+                              ("fp8_e4m3", 0.25, 0.35),
+                              ("fp8_e5m2", 0.25, 0.35)):
+        if jc.wire_dtype(wire) is None:
+            continue
+        out, nom_c, w = launch(wire)
+        assert 0 < w <= nom_c * bound, (wire, w, nom_c)
+        assert np.allclose(out, a1, rtol=rtol, atol=0.1), wire
+    a3, _, _ = launch("off")
+    assert (a1.view(np.uint32) == a3.view(np.uint32)).all(), \\
+        "off-after-toggle lost bit identity"
+    """, 4, mca=_mca())
+
+
+def test_toggle_zero_recompiles():
+    """Exact and compressed programs live under distinct cache keys:
+    after one warm launch of each, toggling back and forth compiles
+    NOTHING new (coll_xla_cache_misses == 0 across four launches)."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import cvar, pvar
+    x = jnp.arange(512, dtype=jnp.float32) + rank
+    try:
+        comm.coll.allreduce_dev(comm, x)            # warm exact
+        cvar.set("coll_hier_dcn_dtype", "bf16")
+        comm.coll.allreduce_dev(comm, x)            # warm compressed
+        s = pvar.session()
+        for wire in ("off", "bf16", "off", "bf16"):
+            cvar.set("coll_hier_dcn_dtype", wire)
+            comm.coll.allreduce_dev(comm, x)
+        assert s.read("coll_xla_cache_misses") == 0
+        assert s.read("hier_launches") == 4
+    finally:
+        cvar.set("coll_hier_dcn_dtype", "off")
+    """, 4, mca=_mca())
+
+
+def test_reduce_scatter_block_compressed():
+    """The rank-major reduce_scatter_block rides the same transport:
+    compressed result allclose to exact, wire <= 1/2 nominal under
+    bf16 (the RS family transmits dcn * f, f = 2/4)."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import cvar, pvar
+    x = (jnp.arange(size * 64, dtype=jnp.float32) * 0.25 + 1.0
+         + rank).reshape(size, 64)
+    exact = np.asarray(comm.coll.reduce_scatter_block_dev(comm, x))
+    try:
+        cvar.set("coll_hier_dcn_dtype", "bf16")
+        s = pvar.session()
+        out = np.asarray(comm.coll.reduce_scatter_block_dev(comm, x))
+        nom = s.read("hier_dcn_bytes")
+        w = s.read("hier_dcn_wire_bytes")
+        assert 0 < w <= nom * 0.5, (w, nom)
+        assert np.allclose(out, exact, rtol=0.02, atol=1e-3)
+    finally:
+        cvar.set("coll_hier_dcn_dtype", "off")
+    """, 4, mca=_mca())
+
+
+def test_per_op_override():
+    """coll_hier_dcn_dtype_<op> overrides the global both ways: a
+    per-op wire compresses only that op, and a per-op 'off' exempts
+    it from a global wire."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import cvar, pvar
+    x = jnp.arange(size * 32, dtype=jnp.float32).reshape(size, 32) \\
+        + rank
+
+    def wire_ratio(fn):
+        s = pvar.session()
+        fn()
+        return s.read("hier_dcn_wire_bytes"), s.read("hier_dcn_bytes")
+
+    try:
+        cvar.set("coll_hier_dcn_dtype_allreduce", "bf16")
+        w, nom = wire_ratio(
+            lambda: comm.coll.allreduce_dev(comm, x))
+        assert w < nom                       # override compresses
+        w, nom = wire_ratio(
+            lambda: comm.coll.reduce_scatter_block_dev(comm, x))
+        assert w == nom                      # other ops stay exact
+        cvar.set("coll_hier_dcn_dtype_allreduce", "off")
+        cvar.set("coll_hier_dcn_dtype", "bf16")
+        w, nom = wire_ratio(
+            lambda: comm.coll.allreduce_dev(comm, x))
+        assert w == nom                      # per-op off wins
+        w, nom = wire_ratio(
+            lambda: comm.coll.reduce_scatter_block_dev(comm, x))
+        assert w < nom                       # global still applies
+    finally:
+        cvar.set("coll_hier_dcn_dtype", "off")
+        cvar.set("coll_hier_dcn_dtype_allreduce", "")
+    """, 4, mca=_mca())
+
+
+def test_linear_and_int_forced_exact():
+    """Bit-stability beats bandwidth: 'linear' launches and integer
+    payloads run exact under a global wire setting — bitwise equal to
+    the uncompressed result, wire bytes == nominal."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import cvar, pvar
+    from ompi_tpu.coll import xla as cx
+    rng = np.random.default_rng(31)
+    h = (rng.standard_normal(1024)
+         * (10.0 ** rng.integers(-3, 4, 1024))).astype(np.float32)
+    x = jnp.asarray(np.roll(h, rank * 3))
+    xi = jnp.arange(777, dtype=jnp.int32) + rank
+    try:
+        cvar.set("coll_hier_dcn_dtype", "fp8_e4m3")
+        s = pvar.session()
+        p = np.asarray(comm.coll.allreduce_dev(
+            comm, x, deterministic="linear"))
+        r = np.asarray(cx.allreduce_dev(
+            comm, x, deterministic="linear"))
+        assert (p.view(np.uint32) == r.view(np.uint32)).all()
+        assert s.read("hier_dcn_wire_bytes") == \\
+            s.read("hier_dcn_bytes")
+        s = pvar.session()
+        pi = np.asarray(comm.coll.allreduce_dev(comm, xi))
+        np.testing.assert_array_equal(
+            pi, np.asarray(cx.allreduce_dev(comm, xi)))
+        assert s.read("hier_dcn_wire_bytes") == \\
+            s.read("hier_dcn_bytes")
+    finally:
+        cvar.set("coll_hier_dcn_dtype", "off")
+    """, 4, mca=_mca())
+
+
+def test_unknown_wire_raises_every_call():
+    """An unknown coll_hier_dcn_dtype surfaces as MPIError(ERR_ARG)
+    at the first collective and EVERY one after (uncached — the
+    bad-split contract), with nothing launched or counted."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu import errors
+    from ompi_tpu.core import cvar, pvar
+    x = jnp.ones(64, jnp.float32)
+    try:
+        cvar.set("coll_hier_dcn_dtype", "fp16")
+        s = pvar.session()
+        for attempt in range(2):
+            try:
+                comm.coll.allreduce_dev(comm, x)
+            except errors.MPIError as e:
+                assert e.error_class == errors.ERR_ARG, e
+                assert "fp16" in str(e) and "bf16" in str(e), e
+            else:
+                raise AssertionError("unknown wire did not raise")
+        assert s.read("hier_launches") == 0
+        assert s.read("hier_dcn_wire_bytes") == 0
+    finally:
+        cvar.set("coll_hier_dcn_dtype", "off")
+    """, 4, mca=_mca())
+
+
+def test_fused_multi_mixed_dtypes():
+    """The fused bucketed form compresses per BUCKET: float buckets
+    ride the wire dtype while an int sibling in the same multi launch
+    stays exact — wire bytes strictly between zero and nominal."""
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import cvar, pvar
+    from ompi_tpu.coll import xla as cx
+    rng = np.random.default_rng(rank)
+    bufs = {"w": jnp.asarray(
+                rng.random((64, 8)).astype(np.float32) + 0.5),
+            "b": jnp.asarray(
+                rng.random((33,)).astype(np.float32) + 0.5),
+            "i": jnp.arange(50, dtype=jnp.int32) + rank}
+    ref = cx.allreduce_multi_dev(comm, bufs)
+    try:
+        cvar.set("coll_hier_dcn_dtype", "bf16")
+        s = pvar.session()
+        out = comm.coll.allreduce_multi_dev(comm, bufs)
+        nom = s.read("hier_dcn_bytes")
+        w = s.read("hier_dcn_wire_bytes")
+        assert 0 < w < nom, (w, nom)   # floats compressed, int exact
+        np.testing.assert_array_equal(np.asarray(out["i"]),
+                                      np.asarray(ref["i"]))
+        for k in ("w", "b"):
+            assert np.allclose(np.asarray(out[k]), np.asarray(ref[k]),
+                               rtol=0.02, atol=1e-3), k
+    finally:
+        cvar.set("coll_hier_dcn_dtype", "off")
+    """, 4, mca=_mca())
+
+
+# ---------------------------------------------------------------------------
+# error feedback — local math, no launcher needed
+
+
+def test_ef_unknown_wire_raises():
+    from ompi_tpu import errors
+    from ompi_tpu.zero import layout as zl
+
+    with pytest.raises(errors.MPIError) as ei:
+        zl.ErrorFeedback("fp16")
+    assert ei.value.error_class == errors.ERR_ARG
+
+
+def test_ef_bounded_drift_vs_carry_free():
+    """The EF contract (Seide 2014): an accumulated EF-quantized
+    gradient sum stays within one quantization step of the exact sum,
+    while the carry-free quantizer's bias grows linearly — on the
+    classic big-next-to-small gradient whose small component fp8
+    cannot represent exactly under the bucket's shared scale."""
+    from ompi_tpu.parallel import hierarchical as H
+    from ompi_tpu.util import jaxcompat as jc
+    from ompi_tpu.zero import layout as zl
+
+    wire = "fp8_e4m3" if jc.wire_dtype("fp8_e4m3") is not None \
+        else "bf16"
+    g = np.array([1000.0, 0.1], np.float32)
+    steps = 40
+    ef = zl.ErrorFeedback(wire)
+    acc = np.zeros(2, np.float32)
+    for _ in range(steps):
+        acc = acc + ef.apply([g], 2)[0]
+    err_ef = np.abs(acc - steps * g)
+    err_no = steps * np.abs(H.wire_quantize(g, wire) - g)
+    assert err_ef[1] < 0.01, err_ef           # bounded by one step
+    if wire == "fp8_e4m3":
+        assert err_no[1] > 0.1, err_no        # linear drift
+        assert err_no[1] > 10 * max(err_ef[1], 1e-9)
+
+
+def test_ef_layout_rebind_resets_residual():
+    """A changed leaf set repacks the buckets — the old residuals
+    index a different layout and must be dropped, not misapplied."""
+    from ompi_tpu.zero import layout as zl
+
+    ef = zl.ErrorFeedback("bf16")
+    ef.apply([np.ones(8, np.float32)], 2)
+    assert ef.residuals and ef.residuals[0] is not None
+    ef.apply([np.ones(8, np.float32), np.ones(3, np.float32)], 2)
+    assert len(ef.residuals) == len(ef.plan.buckets)
+
+
+def test_ef_skips_int_and_wide_enough_buckets():
+    """Non-float leaves and leaves no wider than the wire format pass
+    through untouched (identity, no residual)."""
+    from ompi_tpu.zero import layout as zl
+
+    ef = zl.ErrorFeedback("bf16")
+    ints = np.arange(6, dtype=np.int32)
+    halfs = np.ones(4, np.float16)
+    out = ef.apply([ints, halfs], 2)
+    np.testing.assert_array_equal(out[0], ints)
+    np.testing.assert_array_equal(out[1], halfs)
+    assert all(r is None for r in ef.residuals)
+
+
+# ---------------------------------------------------------------------------
+# optimizer wiring — the training-side surface
+
+
+def test_zero_optimizer_ef_fused_mutually_exclusive():
+    run_ranks("""
+    from ompi_tpu import errors
+    from ompi_tpu.zero.optimizer import ZeroOptimizer
+    params = {"w": np.ones(8, np.float32)}
+    try:
+        ZeroOptimizer(comm, params, fused=True, error_feedback="bf16")
+    except errors.MPIError as e:
+        assert e.error_class == errors.ERR_ARG, e
+    else:
+        raise AssertionError("fused + error_feedback did not raise")
+    """, 2, mca={})
+
+
+def test_zero_optimizer_ef_loss_parity_and_pvars():
+    """A short SGD run with fp8 EF gradients tracks the exact run
+    (host path), and every step records the zero_ef_* pvars."""
+    run_ranks("""
+    from ompi_tpu.core import pvar
+    from ompi_tpu.util import jaxcompat as jc
+    from ompi_tpu.zero.optimizer import ZeroOptimizer
+    wire = "fp8_e4m3" if jc.wire_dtype("fp8_e4m3") is not None \\
+        else "bf16"
+    tgt = np.array([3.0, -2.0, 0.5, 8.0, -0.25, 4.0], np.float32)
+    params = {"w": np.zeros(6, np.float32)}
+    exact = ZeroOptimizer(comm, params, lr=0.2)
+    efopt = ZeroOptimizer(comm, params, lr=0.2, error_feedback=wire)
+    s = pvar.session()
+    steps = 30
+    for _ in range(steps):
+        ge = {"w": exact.params()["w"] - tgt}
+        gq = {"w": efopt.params()["w"] - tgt}
+        pe = exact.step(ge)
+        pq = efopt.step(gq)
+    assert s.read("zero_ef_steps") == steps
+    assert s.read("zero_ef_bytes") > 0
+    np.testing.assert_allclose(pq["w"], pe["w"], rtol=0.05,
+                               atol=0.05)
+    """, 2, mca={})
+
+
+def test_zero3_ef_smoke():
+    """Stage 3 carries one residual per layer: a step with
+    error_feedback quantizes each layer's gradients (zero_ef_steps
+    counts layers) and the bf16 trajectory stays close to exact."""
+    run_ranks("""
+    from ompi_tpu.core import pvar
+    from ompi_tpu.zero.zero3 import Zero3Optimizer
+    params = {"embed": np.ones((4, 6), np.float32),
+              "layers": [{"w": np.ones((6, 6), np.float32)},
+                         {"w": np.ones((6, 6), np.float32)}]}
+    exact = Zero3Optimizer(comm, params, lr=0.1)
+    efopt = Zero3Optimizer(comm, params, lr=0.1,
+                           error_feedback="bf16")
+    grads = {"embed": np.full((4, 6), 0.5, np.float32),
+             "layers": [{"w": np.full((6, 6), 0.25, np.float32)},
+                        {"w": np.full((6, 6), -0.125, np.float32)}]}
+    s = pvar.session()
+    for _ in range(2):
+        exact.step(grads)
+        efopt.step(grads)
+    assert s.read("zero_ef_steps") == 2 * exact.plan.n_layers
+    import jax
+    for a, b in zip(jax.tree.leaves(exact.gathered_params()),
+                    jax.tree.leaves(efopt.gathered_params())):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=0.01, atol=1e-3)
+    exact.free(); efopt.free()
+    """, 2, mca={})
