@@ -34,14 +34,15 @@ import time
 
 _T0 = time.time()
 
-if ("--pallas" in sys.argv or "--hier" in sys.argv) \
+if ("--pallas" in sys.argv or "--hier" in sys.argv
+        or "--serve" in sys.argv) \
         and "xla_force_host_platform_device_count" \
         not in os.environ.get("XLA_FLAGS", ""):
     # the pallas switchpoint card races algorithms across >= 2
-    # devices and the hier card needs a 2x2 grid; on a CPU host fork
-    # 4 virtual devices BEFORE jax first initializes (the TPU path
-    # brings its own device count and the flag only affects the host
-    # platform)
+    # devices, the hier card needs a 2x2 grid and the serve card a
+    # 4-way EP mesh; on a CPU host fork 4 virtual devices BEFORE jax
+    # first initializes (the TPU path brings its own device count and
+    # the flag only affects the host platform)
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=4")
@@ -1102,6 +1103,125 @@ def _bench_hier():
 
 #: microbench extras compared across rounds once a TPU round records
 #: them in bench_baseline.json: (section, key, higher_is_better)
+def _bench_serve():
+    """MoE serving card (``--serve``): decode-shaped Zipf skew sweep
+    over the capacity-factor dispatch policies on a 4-way in-process
+    EP mesh. Per (hotness, policy): per-request wall timing with the
+    result forced — the tail (p50/p99) reported NEXT TO throughput,
+    plus the drop/reroute token rates the policies exist to trade
+    off. On CPU the latencies are dispatch-cost numbers; the policy
+    *rates* (drop vs reroute vs capacity) are platform-independent
+    and are what the cross-round keys track. Also re-proves the
+    serving bar inline: policy='drop' bitwise equal to the training
+    moe_ffn program on the same mesh."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ompi_tpu.ops import moe
+    from ompi_tpu.serve import dispatch as sdisp
+    from ompi_tpu.serve.traffic import ZipfTraffic
+    from ompi_tpu.util import jaxcompat as jc
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        raise RuntimeError(
+            "serve bench needs >= 4 devices for the EP mesh "
+            "(bench.py forces 4 host devices when --serve is passed "
+            "before jax initializes)")
+    n = 4
+    devs = devs[:n]
+    mesh = Mesh(np.array(devs), ("rk",))
+    interp = devs[0].platform != "tpu"
+    e_local, d, f = 2, 64, 128
+    e_total = e_local * n
+    t_local = 32                       # decode-shaped: small batches
+    t_global = n * t_local
+    n_requests = 16 if interp else 64
+    rng = np.random.default_rng(42)
+    shard = NamedSharding(mesh, P("rk"))
+    repl = NamedSharding(mesh, P())
+    w1 = jax.device_put(rng.standard_normal(
+        (e_total, d, f)).astype(np.float32), shard)
+    w2 = jax.device_put(rng.standard_normal(
+        (e_total, f, d)).astype(np.float32), shard)
+
+    def compiled(policy):
+        def body(xb, wgb, w1b, w2b):
+            return sdisp.routed_ffn(xb, wgb, w1b, w2b, "rk", 1.25,
+                                    policy)
+        return jax.jit(jc.shard_map(
+            body, mesh=mesh,
+            in_specs=(P("rk"), P(), P("rk"), P("rk")),
+            out_specs=(P("rk"), P("rk")), check_vma=False))
+
+    ref_fn = jax.jit(jc.shard_map(
+        lambda xb, wgb, w1b, w2b: moe.moe_ffn(xb, wgb, w1b, w2b,
+                                              "rk"),
+        mesh=mesh, in_specs=(P("rk"), P(), P("rk"), P("rk")),
+        out_specs=P("rk"), check_vma=False))
+
+    rows = []
+    summary = {}
+    bit_ok = None
+    for hotness in (0.0, 1.1, 2.0):
+        tr = ZipfTraffic(e_total, d, hotness=hotness, seed=17)
+        wg = jax.device_put(tr.wg, repl)
+        for policy in ("drop", "reroute"):
+            fn = compiled(policy)
+            agg = np.zeros(4, np.int64)
+            lat = []
+            for i in range(n_requests + 1):
+                _ids, x = tr.request(t_global)
+                t0 = time.perf_counter_ns()
+                xg = jax.device_put(x, shard)
+                out, stats = fn(xg, wg, w1, w2)
+                jax.block_until_ready(out)
+                dt = time.perf_counter_ns() - t0
+                if i == 0:  # warmup (compile)
+                    if bit_ok is None and policy == "drop":
+                        ref = ref_fn(xg, wg, w1, w2)
+                        bit_ok = bool(
+                            (np.asarray(out).view(np.uint32)
+                             == np.asarray(ref).view(np.uint32)
+                             ).all())
+                    continue
+                lat.append(dt)
+                agg += np.asarray(stats).reshape(n, -1)[:, :4] \
+                    .sum(0).astype(np.int64)
+            lat_ms = np.asarray(lat, np.float64) / 1e6
+            toks = n_requests * t_global
+            row = {
+                "hotness": hotness, "policy": policy,
+                "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                "p95_ms": round(float(np.percentile(lat_ms, 95)), 3),
+                "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+                "tokens_per_s": round(
+                    toks / max(float(lat_ms.sum()) / 1e3, 1e-9), 1),
+                "drop_rate": round(int(agg[2]) / toks, 4),
+                "reroute_rate": round(int(agg[1]) / toks, 4),
+            }
+            rows.append(row)
+    hot = {r["policy"]: r for r in rows if r["hotness"] == 2.0}
+    summary = {
+        "sweep": rows,
+        "drop_bit_identical": bit_ok,
+        "drop_p50_ms": hot["drop"]["p50_ms"],
+        "drop_p99_ms": hot["drop"]["p99_ms"],
+        "reroute_p50_ms": hot["reroute"]["p50_ms"],
+        "reroute_p99_ms": hot["reroute"]["p99_ms"],
+        "decode_tokens_per_s": hot["drop"]["tokens_per_s"],
+        "hot_drop_rate": hot["drop"]["drop_rate"],
+        # tokens the reroute policy saves from the drop floor at the
+        # hottest skew — the reason the policy exists
+        "reroute_kept_gain": round(
+            (1.0 - hot["reroute"]["drop_rate"])
+            / max(1.0 - hot["drop"]["drop_rate"], 1e-9), 4),
+    }
+    return summary
+
+
 _EXTRA_BASELINE_KEYS = (
     ("dispatch", "allreduce_4k_launches_per_s", True),
     ("dispatch", "fused_64x256k_ms", False),
@@ -1123,6 +1243,10 @@ _EXTRA_BASELINE_KEYS = (
     ("pallas", "best_speedup_vs_xla", True),
     ("hier", "hier_speedup_vs_flat", True),
     ("hier", "hier_dcn_compression", True),
+    ("serve", "decode_tokens_per_s", True),
+    ("serve", "drop_p99_ms", False),
+    ("serve", "reroute_p99_ms", False),
+    ("serve", "reroute_kept_gain", True),
 )
 
 
@@ -1278,6 +1402,13 @@ def main() -> None:
             _phase("hier microbench done")
         except Exception as e:
             _phase(f"hier microbench skipped: {e!r}")
+    serve = None
+    if "--serve" in sys.argv:
+        try:
+            serve = _bench_serve()
+            _phase("serve microbench done")
+        except Exception as e:
+            _phase(f"serve microbench skipped: {e!r}")
     if trace_path is not None:
         from ompi_tpu.trace import export as trace_export
         from ompi_tpu.trace import recorder as trace_rec
@@ -1319,7 +1450,8 @@ def main() -> None:
                                    "ingest": ingest,
                                    "ckpt": ckpt,
                                    "pallas": pallas,
-                                   "hier": hier})
+                                   "hier": hier,
+                                   "serve": serve})
         except Exception:
             pass
 
@@ -1367,6 +1499,7 @@ def main() -> None:
             "ckpt": ckpt,
             "pallas": pallas,
             "hier": hier,
+            "serve": serve,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),
             # wall attribution from the prof-plane phase ledger

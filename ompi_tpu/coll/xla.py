@@ -1030,14 +1030,21 @@ def gatherv_dev(comm, sendbuf, counts, root: int = 0):
     return out if comm.rank == root else None
 
 
-def alltoallv_dev(comm, sendbuf, scounts, rcounts, max_count=None):
+def alltoallv_dev(comm, sendbuf, scounts, rcounts, max_count=None, *,
+                  _expert_tokens: bool = True):
     """Ragged all-to-all on device: segments pad to a uniform cell
     size M, one compiled all_to_all, static slices repack. M must be
     the GLOBAL max cell (a rank's own rows/columns don't bound cells
     between other peers), so it costs one tiny host max-allreduce per
     call — unless the caller passes ``max_count`` (e.g. a fixed MoE
     expert capacity, the common TPU dispatch pattern), which makes the
-    path entirely host-free and is the recommended usage."""
+    path entirely host-free and is the recommended usage.
+
+    ``_expert_tokens=False`` keeps the call out of the per-expert
+    routed-token stats: scounts here index RANKS, and only the EP
+    dispatch pattern (destination shard == expert) may feed the
+    expert-imbalance view — the serve plane's DCN overflow legs
+    exchange by rank and must not skew it."""
     scounts = tuple(int(c) for c in scounts)
     rcounts = tuple(int(c) for c in rcounts)
     if comm.size == 1:
@@ -1096,7 +1103,8 @@ def alltoallv_dev(comm, sendbuf, scounts, rcounts, max_count=None):
         tm.coll("alltoallv", comm, getattr(sendbuf, "nbytes", 0),
                 dtype=str(getattr(sendbuf, "dtype", "")),
                 counts=scounts, row_bytes=rowb)
-        tm.expert_tokens(scounts)
+        if _expert_tokens:
+            tm.expert_tokens(scounts)
     rest = sendbuf.shape[1:]
     rows = []
     off = 0

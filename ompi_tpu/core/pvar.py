@@ -164,6 +164,17 @@ WELL_KNOWN = (
     "ckpt_fallback_sync", "ckpt_incremental_skipped",
     "ckpt_restores", "ckpt_restore_fallbacks",
     "ckpt_digest_mismatches", "ckpt_injected_failures",
+    # serve/ plane (production-skew MoE serving): decode requests +
+    # tokens dispatched, capacity-overflow outcomes per policy
+    # (dropped / rerouted in-slice / shipped to a remote-slice replica
+    # over DCN with the byte meter the budget cvar bounds); latency
+    # histograms ride the trace plane's dynamic
+    # trace_hist_serve_decode_* families. serve_dropped_tokens is also
+    # fed by ops/moe.top1_routing's eager-mode metering, so
+    # capacity-factor tuning has drop data outside the serve loop
+    "serve_requests", "serve_tokens", "serve_dropped_tokens",
+    "serve_rerouted_tokens", "serve_dcn_overflow_tokens",
+    "serve_dcn_overflow_bytes",
     # fcoll aggregator writes retried after a short/partial result
     # (exhaustion raises MPIError(ERR_FILE) — satellites of the same
     # hardening pass)

@@ -87,6 +87,12 @@ class TrafficMatrix:
         # nominal (accumulate-dtype) model, dcn_wire_b what the wire
         # actually carried (equal unless the DCN phase is compressed)
         self.hier_levels: Dict[str, List[float]] = {}
+        # serve/ plane per-policy accounting: policy -> counters +
+        # log2(ns) latency histogram ({bucket: requests}) — the
+        # [serve] report section's feed. Doc state only: the serve
+        # plane records its pvars at the dispatch/loop sites, so this
+        # table never double-counts.
+        self.serve: Dict[str, Dict[str, object]] = {}
         self.link_bytes: Dict[Link, float] = {}
         self.expert: Dict[int, int] = {}
         self.series: List[Tuple[int, str, float]] = []
@@ -171,6 +177,34 @@ class TrafficMatrix:
             rec[1] += float(ici_bytes)
             rec[2] += float(dcn_bytes)
             rec[3] += float(dcn_wire_bytes)
+
+    def serve_event(self, policy: str, *, requests: int = 0,
+                    tokens: int = 0, kept: int = 0, rerouted: int = 0,
+                    dropped: int = 0, dcn_tokens: int = 0,
+                    dcn_bytes: int = 0, lat_ns: int = 0) -> None:
+        """Accumulate one serve-plane event under its dispatch
+        policy: the Dispatcher reports token accounting per dispatch,
+        the decode loop reports request count + wall latency (log2-ns
+        histogram bucket). Both call sites, one table — the report's
+        ``[serve]`` section reads it whole."""
+        with self.lock:
+            rec = self.serve.get(policy)
+            if rec is None:
+                rec = self.serve[policy] = {
+                    "requests": 0, "tokens": 0, "kept": 0,
+                    "rerouted": 0, "dropped": 0, "dcn_tokens": 0,
+                    "dcn_bytes": 0, "lat_ns": {}}
+            rec["requests"] += int(requests)
+            rec["tokens"] += int(tokens)
+            rec["kept"] += int(kept)
+            rec["rerouted"] += int(rerouted)
+            rec["dropped"] += int(dropped)
+            rec["dcn_tokens"] += int(dcn_tokens)
+            rec["dcn_bytes"] += int(dcn_bytes)
+            if lat_ns > 0:
+                b = int(lat_ns).bit_length()
+                hist = rec["lat_ns"]
+                hist[b] = hist.get(b, 0) + 1
 
     @staticmethod
     def _mesh_shape(comm) -> Tuple[int, ...]:

@@ -41,6 +41,11 @@ def snapshot_doc(tm) -> Dict[str, object]:
         expert = {str(e): c for e, c in tm.expert.items()}
         hier = {op: list(rec)
                 for op, rec in sorted(tm.hier_levels.items())}
+        serve = {
+            pol: {**{k: v for k, v in rec.items() if k != "lat_ns"},
+                  "lat_ns": {str(b): c
+                             for b, c in sorted(rec["lat_ns"].items())}}
+            for pol, rec in sorted(tm.serve.items())}
     return {
         "schema": SCHEMA,
         "rank": tm.rank,
@@ -51,6 +56,7 @@ def snapshot_doc(tm) -> Dict[str, object]:
         "link_bytes": link_bytes,
         "expert_tokens": expert,
         "hier_levels": hier,
+        "serve": serve,
     }
 
 
@@ -149,6 +155,20 @@ def merge(docs: List[Dict[str, object]]) -> Dict[str, object]:
             # IS the nominal one (every launch was exact)
             got[3] += rec[3] if len(rec) > 3 else rec[2]
 
+    serve: Dict[str, Dict[str, object]] = {}
+    for doc in docs:
+        for pol, rec in doc.get("serve", {}).items():
+            got = serve.setdefault(pol, {
+                "requests": 0, "tokens": 0, "kept": 0, "rerouted": 0,
+                "dropped": 0, "dcn_tokens": 0, "dcn_bytes": 0,
+                "lat_ns": {}})
+            for k in ("requests", "tokens", "kept", "rerouted",
+                      "dropped", "dcn_tokens", "dcn_bytes"):
+                got[k] += int(rec.get(k, 0))
+            for b, c in rec.get("lat_ns", {}).items():
+                got["lat_ns"][int(b)] = (got["lat_ns"].get(int(b), 0)
+                                         + int(c))
+
     return {
         "schema": SCHEMA + "+merged",
         "nranks": nranks,
@@ -168,6 +188,8 @@ def merge(docs: List[Dict[str, object]]) -> Dict[str, object]:
         "expert_tokens": expert,
         "hier_levels": {op: list(rec)
                         for op, rec in sorted(hier_levels.items())},
+        "serve": {pol: dict(rec)
+                  for pol, rec in sorted(serve.items())},
     }
 
 

@@ -89,6 +89,69 @@ def hotspot_lines(merged: Dict[str, object], top: int) -> List[str]:
     return out
 
 
+def _hist_percentile(hist: Dict[int, int], q: float) -> float:
+    """Approximate percentile in ms from a log2(ns)-bucket histogram
+    (bucket upper bound — the same conservative read the trace
+    plane's exporter uses)."""
+    if not hist:
+        return 0.0
+    items = sorted((int(b), int(c)) for b, c in hist.items())
+    total = sum(c for _, c in items)
+    target = q / 100.0 * total
+    run = 0
+    for b, c in items:
+        run += c
+        if run >= target:
+            return float(2 ** b) / 1e6
+    return float(2 ** items[-1][0]) / 1e6
+
+
+def serve_lines(serve: Dict[str, Dict[str, object]],
+                experts: Dict[object, int], top: int) -> List[str]:
+    """The serving-plane section: per-policy token accounting + tail
+    latency, the per-expert load heatmap, and the hot-expert verdict
+    (expert NAMED with its load share — the smoke lane greps for
+    it)."""
+    out: List[str] = []
+    for pol, rec in sorted(serve.items()):
+        toks = max(int(rec.get("tokens", 0)), 1)
+        out.append(
+            f"[serve] policy {pol}: {rec.get('requests', 0)} requests,"
+            f" {rec.get('tokens', 0)} tokens; "
+            f"kept {rec.get('kept', 0)} "
+            f"({100.0 * int(rec.get('kept', 0)) / toks:.1f}%), "
+            f"dropped {rec.get('dropped', 0)} "
+            f"({100.0 * int(rec.get('dropped', 0)) / toks:.1f}%), "
+            f"rerouted {rec.get('rerouted', 0)}, "
+            f"DCN {rec.get('dcn_tokens', 0)} tokens / "
+            f"{_fmt_bytes(float(rec.get('dcn_bytes', 0)))}")
+        hist = rec.get("lat_ns", {})
+        if hist:
+            out.append(
+                f"  latency ~p50 {_hist_percentile(hist, 50):.2f}ms"
+                f"  ~p95 {_hist_percentile(hist, 95):.2f}ms"
+                f"  ~p99 {_hist_percentile(hist, 99):.2f}ms"
+                " (log2-bin upper bounds)")
+    if serve and experts:
+        counts = {int(e): int(c) for e, c in experts.items()}
+        peak = max(counts.values())
+        total = sum(counts.values()) or 1
+        out.append(f"  expert load ({len(counts)} experts, "
+                   f"{total} routed tokens):")
+        for e in sorted(counts):
+            c = counts[e]
+            bar = "#" * max(1, int(c / peak * 40)) if c else ""
+            out.append(f"    e{e:<3d} {c:>8d} {bar}")
+        hot_e, hot_c = max(counts.items(), key=lambda kv: kv[1])
+        share = hot_c / total
+        fair = 1.0 / max(len(counts), 1)
+        verdict = "HOT" if share >= 2.0 * fair else "balanced"
+        out.append(f"  hot expert: e{hot_e} — {100.0 * share:.1f}% "
+                   f"of routed tokens ({share / fair:.1f}x fair "
+                   f"share, {verdict})")
+    return out
+
+
 def render(merged: Dict[str, object], top: int = 5) -> str:
     nranks = int(merged["nranks"])
     out: List[str] = [
@@ -154,6 +217,9 @@ def render(merged: Dict[str, object], top: int = 5) -> str:
                          f"{_fmt_bytes(float(rec[2]))})")
             out.append(line)
     experts = merged.get("expert_tokens", {})
+    serve = merged.get("serve", {})
+    if serve:
+        out.extend(serve_lines(serve, experts, top))
     if experts:
         total = sum(experts.values()) or 1
         hot = max(experts.items(), key=lambda kv: kv[1])

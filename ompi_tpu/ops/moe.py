@@ -9,8 +9,12 @@ on dense [E_local, n*C, D] blocks, and combine is the inverse all_to_all
 weighted by the gates.
 
 Capacity-based top-1 (Switch-Transformer style) routing: static shapes
-(XLA requirement — no dynamic token counts), overflow tokens dropped,
-which is the standard TPU trade.
+(XLA requirement — no dynamic token counts), overflow tokens dropped.
+The drop is METERED: :class:`MoEDispatch` carries the drop count and
+the per-expert routed histogram, and an eager (non-traced) routing
+call records ``serve_dropped_tokens`` so capacity-factor tuning has
+data even outside the serve loop (``ompi_tpu.serve`` adds the
+overflow-handling policies on top of this router).
 """
 
 from __future__ import annotations
@@ -20,12 +24,32 @@ from typing import NamedTuple
 import jax.numpy as jnp
 from jax import lax
 
+from ompi_tpu.core import pvar
 from ompi_tpu.util import jaxcompat
 
 
 class MoEDispatch(NamedTuple):
     combine: jnp.ndarray   # [T, E, C] combine weights (gate at slot)
     dispatch: jnp.ndarray  # [T, E, C] 0/1 dispatch assignment
+    counts: jnp.ndarray    # [E] routed tokens per expert (pre-capacity)
+    dropped: jnp.ndarray   # [] tokens past capacity (drop-metered)
+
+
+def record_dispatch_stats(route: MoEDispatch) -> None:
+    """Meter one routing decision on the pvar plane — a no-op under a
+    jit trace (abstract values cannot be read back; the serve loop
+    meters its compiled dispatches from the program's stats outputs
+    instead)."""
+    try:
+        dropped = int(route.dropped)
+        counts = [int(c) for c in route.counts]
+    except Exception:  # noqa: BLE001 — traced values: caller meters
+        return
+    if dropped:
+        pvar.record("serve_dropped_tokens", dropped)
+    from ompi_tpu import monitoring as _monitoring
+
+    _monitoring.expert_load(counts)
 
 
 def top1_routing(logits, capacity: int) -> MoEDispatch:
@@ -45,23 +69,26 @@ def top1_routing(logits, capacity: int) -> MoEDispatch:
     dispatch = posmask * keep[..., None]                  # [T,E,C]
     gate1 = (gates * onehot).sum(-1)                      # [T]
     combine = dispatch * gate1[:, None, None]
-    return MoEDispatch(combine=combine, dispatch=dispatch)
+    counts = onehot.sum(0).astype(jnp.int32)              # [E]
+    dropped = (t - dispatch.sum()).astype(jnp.int32)      # []
+    route = MoEDispatch(combine=combine, dispatch=dispatch,
+                        counts=counts, dropped=dropped)
+    record_dispatch_stats(route)
+    return route
 
 
-def moe_ffn(x, wg, w1, w2, axis: str, capacity_factor: float = 1.25):
-    """Expert-parallel MoE FFN layer inside ``shard_map``.
-
-    x: local tokens [T, D]; wg: router [D, E_total] (replicated);
-    w1/w2: this device's experts [E_local, D, F], [E_local, F, D].
-    E_total = E_local * axis_size(axis). Returns [T, D].
-    """
+def ep_apply(route: MoEDispatch, x, w1, w2, axis: str):
+    """The EP dispatch→FFN→combine leg on an already-decided routing:
+    pack tokens into per-expert slots, all_to_all over the expert
+    axis, run the local experts, inverse-exchange and combine. Split
+    from :func:`moe_ffn` so the serve plane's overflow policies can
+    swap the routing while keeping this op sequence bit-identical to
+    the training path."""
     n = jaxcompat.axis_size(axis)
     t, d = x.shape
     e_local = w1.shape[0]
+    cap = route.dispatch.shape[-1]
     e_total = e_local * n
-    cap = max(int(capacity_factor * t / e_total), 1)
-
-    route = top1_routing(x @ wg, cap)
     # pack tokens into per-expert slots: [E_total, C, D] (one-hot matmul
     # -> MXU; also what makes dispatch differentiable w.r.t. x)
     slots = jnp.einsum("tec,td->ecd", route.dispatch, x)
@@ -79,3 +106,20 @@ def moe_ffn(x, wg, w1, w2, axis: str, capacity_factor: float = 1.25):
     # [n_expert_group, E_local, C, D] == [E_total, C, D] for this device
     out = out.reshape(e_total, cap, d)
     return jnp.einsum("tec,ecd->td", route.combine, out).astype(x.dtype)
+
+
+def moe_ffn(x, wg, w1, w2, axis: str, capacity_factor: float = 1.25):
+    """Expert-parallel MoE FFN layer inside ``shard_map``.
+
+    x: local tokens [T, D]; wg: router [D, E_total] (replicated);
+    w1/w2: this device's experts [E_local, D, F], [E_local, F, D].
+    E_total = E_local * axis_size(axis). Returns [T, D].
+    """
+    n = jaxcompat.axis_size(axis)
+    t, d = x.shape
+    e_local = w1.shape[0]
+    e_total = e_local * n
+    cap = max(int(capacity_factor * t / e_total), 1)
+
+    route = top1_routing(x @ wg, cap)
+    return ep_apply(route, x, w1, w2, axis)

@@ -1,0 +1,39 @@
+"""serve/ — the production-skew MoE serving plane.
+
+The training side of the framework is deep (ZeRO 1-3, pallas DMA
+kernels, hierarchical ICI x DCN collectives, async checkpoints); this
+package opens the **inference workload class**: latency-shaped decode
+traffic where a Zipf-skewed token->expert distribution makes hot
+experts overflow their capacity — the GShard / Switch-Transformer
+capacity-factor dispatch problem, run over the EP alltoall path the
+framework already lowers (:mod:`ompi_tpu.ops.moe`,
+``coll/xla.alltoallv_dev``).
+
+Three cooperating pieces:
+
+- :mod:`dispatch` — capacity-factor dispatch policies as ONE compiled
+  program per (policy, mesh, capacity), riding coll/xla's per-comm
+  ``_Ctx`` caches: ``drop`` (the training default, bit-identical to
+  ``moe_ffn`` — but metered), ``reroute`` (overflow re-dispatched to
+  the least-loaded expert in the same slice, token-conserving), and
+  ``dcn_overflow`` (topology-aware: overflow shipped to a
+  remote-slice replica over the hier plane's DCN level via
+  ``alltoallv_dev``, byte-metered and budget-bounded so the drop
+  decision knows the link cost).
+- :mod:`traffic` — a seeded Zipf token->expert generator with a
+  hotness dial, producing decode-shaped request batches whose router
+  argmax is the drawn expert.
+- :mod:`loop` — the decode latency harness: many small iterations
+  with per-request wall timing, p50/p95/p99 reported NEXT TO
+  throughput (the serving metric no training bench measures), fed
+  into ``serve_*`` pvars, the trace plane's latency histograms, and
+  the monitoring report's ``[serve]`` section (per-expert load
+  heatmap + hot-expert verdict).
+"""
+
+from ompi_tpu.serve.dispatch import POLICIES, Dispatcher, routed_ffn
+from ompi_tpu.serve.loop import run_decode
+from ompi_tpu.serve.traffic import ZipfTraffic
+
+__all__ = ["POLICIES", "Dispatcher", "ZipfTraffic", "routed_ffn",
+           "run_decode"]
