@@ -621,6 +621,70 @@ def _bench_monitoring():
     }
 
 
+def _bench_tune():
+    """Cost card for the collective performance observatory: the
+    level-0 guard (``OBSERVER is None`` — what every coll dispatch
+    site pays when observation is off), the level-1 per-launch sample
+    fold, and the guard cost relative to the 256KiB per-message floor
+    (the monitoring guard bench's shape) — acceptance bound: level-0
+    overhead < 1% of that floor."""
+    import numpy as np
+
+    from ompi_tpu.tune import observe as _tobs
+
+    iters = 200000
+
+    def launcher():
+        return None
+
+    def guarded():
+        obs = _tobs.OBSERVER
+        if obs is not None:
+            return obs.timed("xla", "allreduce", "auto", None, 4096,
+                             "float32", launcher)()
+        return launcher()
+
+    prev, _tobs.OBSERVER = _tobs.OBSERVER, None  # force level-0 view
+    try:
+        guarded()  # warm
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            guarded()
+        call_ns = (time.perf_counter_ns() - t0) / iters
+        # the real sites are inline: subtract the closure-call floor
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            launcher()
+        guard_ns = max(call_ns
+                       - (time.perf_counter_ns() - t0) / iters, 0.0)
+    finally:
+        _tobs.OBSERVER = prev
+
+    # per-message host-work floor: one 256KiB payload materialization
+    t0 = time.perf_counter_ns()
+    for _ in range(iters // 10):
+        np.zeros(262144, np.uint8)
+    msg_ns = (time.perf_counter_ns() - t0) / (iters // 10)
+
+    fresh = _tobs.OBSERVER is None  # don't clobber a live plane
+    if fresh:
+        _tobs.enable(rank=0)
+    try:
+        t0 = time.perf_counter_ns()
+        for _ in range(20000):
+            guarded()
+        sample_ns = (time.perf_counter_ns() - t0) / 20000
+    finally:
+        if fresh:
+            _tobs.disable()
+    return {
+        "level0_guard_ns": round(guard_ns, 1),
+        "level1_sample_ns": round(sample_ns, 1),
+        "level0_overhead_pct": round(
+            guard_ns / max(msg_ns, 1.0) * 100.0, 3),
+    }
+
+
 def _bench_ingest():
     """Streamed vs serial cold start (BENCH_r05: 471s of 488s wall
     was serial upload-then-compile). Serial arm: to_device every
@@ -1247,6 +1311,8 @@ _EXTRA_BASELINE_KEYS = (
     ("serve", "drop_p99_ms", False),
     ("serve", "reroute_p99_ms", False),
     ("serve", "reroute_kept_gain", True),
+    ("tune", "level0_guard_ns", False),
+    ("tune", "level1_sample_ns", False),
 )
 
 
@@ -1409,6 +1475,13 @@ def main() -> None:
             _phase("serve microbench done")
         except Exception as e:
             _phase(f"serve microbench skipped: {e!r}")
+    tune = None
+    if "--tune" in sys.argv:
+        try:
+            tune = _bench_tune()
+            _phase("tune microbench done")
+        except Exception as e:
+            _phase(f"tune microbench skipped: {e!r}")
     if trace_path is not None:
         from ompi_tpu.trace import export as trace_export
         from ompi_tpu.trace import recorder as trace_rec
@@ -1451,7 +1524,8 @@ def main() -> None:
                                    "ckpt": ckpt,
                                    "pallas": pallas,
                                    "hier": hier,
-                                   "serve": serve})
+                                   "serve": serve,
+                                   "tune": tune})
         except Exception:
             pass
 
@@ -1500,6 +1574,7 @@ def main() -> None:
             "pallas": pallas,
             "hier": hier,
             "serve": serve,
+            "tune": tune,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),
             # wall attribution from the prof-plane phase ledger

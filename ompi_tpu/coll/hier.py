@@ -66,6 +66,7 @@ from ompi_tpu.monitoring import matrix as _mon
 from ompi_tpu.parallel import hierarchical as H
 from ompi_tpu.telemetry import flight as _flight
 from ompi_tpu.trace import recorder as _trace
+from ompi_tpu.tune import observe as _tobs
 
 _out = output.stream("coll_hier")
 
@@ -286,8 +287,9 @@ def _switchpoint(kind: str, nbytes: int, dtype: str,
             with open(path, encoding="utf-8") as f:
                 entries = json.load(f)
         except (OSError, ValueError) as exc:
-            _out.verbose(1, "coll_hier_switchpoints %s unreadable: "
-                            "%s", path, exc)
+            # tune satellite: a fat-fingered table path is a silent
+            # perf cliff — warn once per path, count every attempt
+            _tobs.table_error("coll_hier_switchpoints", path, exc)
             entries = []
         table = {}
         for e in entries if isinstance(entries, list) else []:
@@ -390,9 +392,17 @@ def _smap(ctx, plan: _Plan, body, out_varying: bool):
                     spec=ctx.P((H.DCN_AXIS, H.ICI_AXIS)))
 
 
-def _launch(launcher, op: str, plan: _Plan):
+def _launch(launcher, op: str, plan: _Plan, comm=None, nbytes=0,
+            dtype: str = ""):
     """Dispatch, with a coll_hier trace span naming the grid (the xla
-    launch funnel inside adds its own span)."""
+    launch funnel inside adds its own span) and a tune-plane sample
+    under provider 'hier', mesh (n_dcn, n_ici), when the observatory
+    is up."""
+    obs = _tobs.OBSERVER
+    if obs is not None:
+        launcher = obs.timed("hier", op, "hier", comm, nbytes, dtype,
+                             launcher,
+                             mesh=(plan.n_dcn, plan.n_ici))
     rec = _trace.RECORDER
     if rec is None:
         return launcher()
@@ -561,11 +571,13 @@ def allreduce_dev(comm, sendbuf, op=op_mod.SUM,
     launcher = _allreduce_prep(comm, sendbuf, opn, det, plan, wire)
     fl = _flight.FLIGHT
     if fl is None:
-        return _launch(launcher, "allreduce", plan)
+        return _launch(launcher, "allreduce", plan, comm,
+                       int(sendbuf.nbytes), str(sendbuf.dtype))
     tok = fl.enter("allreduce_dev", getattr(comm, "cid", -1),
                    getattr(sendbuf, "nbytes", 0))
     try:
-        return _launch(launcher, "allreduce", plan)
+        return _launch(launcher, "allreduce", plan, comm,
+                       int(sendbuf.nbytes), str(sendbuf.dtype))
     finally:
         fl.exit(tok)
 
@@ -597,11 +609,13 @@ def bcast_dev(comm, buf, root: int = 0):
     launcher = _bcast_prep(comm, buf, root, plan)
     fl = _flight.FLIGHT
     if fl is None:
-        return _launch(launcher, "bcast", plan)
+        return _launch(launcher, "bcast", plan, comm,
+                       int(buf.nbytes), str(buf.dtype))
     tok = fl.enter("bcast_dev", getattr(comm, "cid", -1),
                    getattr(buf, "nbytes", 0))
     try:
-        return _launch(launcher, "bcast", plan)
+        return _launch(launcher, "bcast", plan, comm,
+                       int(buf.nbytes), str(buf.dtype))
     finally:
         fl.exit(tok)
 
@@ -631,11 +645,13 @@ def allgather_dev(comm, sendbuf):
     launcher = _allgather_prep(comm, sendbuf, plan)
     fl = _flight.FLIGHT
     if fl is None:
-        return _launch(launcher, "allgather", plan)
+        return _launch(launcher, "allgather", plan, comm,
+                       int(sendbuf.nbytes), str(sendbuf.dtype))
     tok = fl.enter("allgather_dev", getattr(comm, "cid", -1),
                    getattr(sendbuf, "nbytes", 0))
     try:
-        return _launch(launcher, "allgather", plan)
+        return _launch(launcher, "allgather", plan, comm,
+                       int(sendbuf.nbytes), str(sendbuf.dtype))
     finally:
         fl.exit(tok)
 
@@ -670,11 +686,13 @@ def alltoall_dev(comm, sendbuf):
     launcher = _alltoall_prep(comm, sendbuf, plan)
     fl = _flight.FLIGHT
     if fl is None:
-        return _launch(launcher, "alltoall", plan)
+        return _launch(launcher, "alltoall", plan, comm,
+                       int(sendbuf.nbytes), str(sendbuf.dtype))
     tok = fl.enter("alltoall_dev", getattr(comm, "cid", -1),
                    getattr(sendbuf, "nbytes", 0))
     try:
-        return _launch(launcher, "alltoall", plan)
+        return _launch(launcher, "alltoall", plan, comm,
+                       int(sendbuf.nbytes), str(sendbuf.dtype))
     finally:
         fl.exit(tok)
 
@@ -722,12 +740,14 @@ def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
                                           plan, wire)
     fl = _flight.FLIGHT
     if fl is None:
-        return _launch(launcher, "reduce_scatter_block", plan)
+        return _launch(launcher, "reduce_scatter_block", plan, comm,
+                       int(sendbuf.nbytes), str(sendbuf.dtype))
     tok = fl.enter("reduce_scatter_block_dev",
                    getattr(comm, "cid", -1),
                    getattr(sendbuf, "nbytes", 0))
     try:
-        return _launch(launcher, "reduce_scatter_block", plan)
+        return _launch(launcher, "reduce_scatter_block", plan, comm,
+                       int(sendbuf.nbytes), str(sendbuf.dtype))
     finally:
         fl.exit(tok)
 
@@ -861,11 +881,11 @@ def allreduce_multi_dev(comm, bufs, op=op_mod.SUM,
     launcher = _hier_fuse_prep(comm, leaves, treedef, opn, det, plan)
     fl = _flight.FLIGHT
     if fl is None:
-        return _launch(launcher, "allreduce_multi", plan)
+        return _launch(launcher, "allreduce_multi", plan, comm, nb, dt)
     tok = fl.enter("allreduce_multi_dev", getattr(comm, "cid", -1),
                    nb)
     try:
-        return _launch(launcher, "allreduce_multi", plan)
+        return _launch(launcher, "allreduce_multi", plan, comm, nb, dt)
     finally:
         fl.exit(tok)
 

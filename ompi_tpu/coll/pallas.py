@@ -47,6 +47,7 @@ from ompi_tpu.monitoring import algo as _algo
 from ompi_tpu.monitoring import matrix as _mon
 from ompi_tpu.telemetry import flight as _flight
 from ompi_tpu.trace import recorder as _trace
+from ompi_tpu.tune import observe as _tobs
 from ompi_tpu.util import jaxcompat
 
 _out = output.stream("coll_pallas")
@@ -180,8 +181,9 @@ def _switchpoint(kind: str, nbytes: int, dtype: str,
             with open(path, encoding="utf-8") as f:
                 entries = json.load(f)
         except (OSError, ValueError) as exc:
-            _out.verbose(1, "coll_pallas_switchpoints %s unreadable: "
-                            "%s", path, exc)
+            # tune satellite: a fat-fingered table path is a silent
+            # perf cliff — warn once per path, count every attempt
+            _tobs.table_error("coll_pallas_switchpoints", path, exc)
             entries = []
         table = {}
         for e in entries if isinstance(entries, list) else []:
@@ -245,9 +247,19 @@ def _select(kind: str, comm, sendbuf, det: Optional[str],
     return "ring"
 
 
-def _launch(launcher, op: str, algo: str):
+def _launch(launcher, op: str, algo: str, comm=None, buf=None,
+            nbytes=None):
     """Dispatch, with a coll_pallas trace span naming the chosen
-    algorithm (the xla launch funnel inside adds its own span)."""
+    algorithm (the xla launch funnel inside adds its own span) and a
+    tune-plane sample under provider 'pallas' when the observatory
+    is up (`nbytes` overrides `buf.nbytes` for multi-buffer ops)."""
+    obs = _tobs.OBSERVER
+    if obs is not None:
+        launcher = obs.timed(
+            "pallas", op, algo, comm,
+            int(getattr(buf, "nbytes", 0) if nbytes is None
+                else nbytes),
+            str(getattr(buf, "dtype", "")), launcher)
     rec = _trace.RECORDER
     if rec is None:
         return launcher()
@@ -315,11 +327,11 @@ def allreduce_dev(comm, sendbuf, op=op_mod.SUM,
     launcher = _allreduce_prep(comm, sendbuf, opn, algo)
     fl = _flight.FLIGHT
     if fl is None:
-        return _launch(launcher, "allreduce", algo)
+        return _launch(launcher, "allreduce", algo, comm, sendbuf)
     tok = fl.enter("allreduce_dev", getattr(comm, "cid", -1),
                    getattr(sendbuf, "nbytes", 0))
     try:
-        return _launch(launcher, "allreduce", algo)
+        return _launch(launcher, "allreduce", algo, comm, sendbuf)
     finally:
         fl.exit(tok)
 
@@ -360,11 +372,11 @@ def allgather_dev(comm, sendbuf):
     launcher = _allgather_prep(comm, sendbuf, algo)
     fl = _flight.FLIGHT
     if fl is None:
-        return _launch(launcher, "allgather", algo)
+        return _launch(launcher, "allgather", algo, comm, sendbuf)
     tok = fl.enter("allgather_dev", getattr(comm, "cid", -1),
                    getattr(sendbuf, "nbytes", 0))
     try:
-        return _launch(launcher, "allgather", algo)
+        return _launch(launcher, "allgather", algo, comm, sendbuf)
     finally:
         fl.exit(tok)
 
@@ -418,12 +430,14 @@ def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
     launcher = _reduce_scatter_prep(comm, sendbuf, opn, algo)
     fl = _flight.FLIGHT
     if fl is None:
-        return _launch(launcher, "reduce_scatter_block", algo)
+        return _launch(launcher, "reduce_scatter_block", algo, comm,
+                       sendbuf)
     tok = fl.enter("reduce_scatter_block_dev",
                    getattr(comm, "cid", -1),
                    getattr(sendbuf, "nbytes", 0))
     try:
-        return _launch(launcher, "reduce_scatter_block", algo)
+        return _launch(launcher, "reduce_scatter_block", algo, comm,
+                       sendbuf)
     finally:
         fl.exit(tok)
 
@@ -583,7 +597,8 @@ def fused_rs_update_dev(comm, grads, pshards, mshards, *,
             if with_mom else None
         return ps, ms
 
-    return _launch(run, "fused_rs_update", det or "ring")
+    return _launch(run, "fused_rs_update", det or "ring", comm,
+                   leaves[0], nbytes=plan.nbytes)
 
 
 def _allgather_matmul_prep(comm, x, w):
@@ -630,11 +645,11 @@ def allgather_matmul_dev(comm, x, w):
     launcher = _allgather_matmul_prep(comm, x, w)
     fl = _flight.FLIGHT
     if fl is None:
-        return _launch(launcher, "allgather_matmul", "ring")
+        return _launch(launcher, "allgather_matmul", "ring", comm, x)
     tok = fl.enter("allgather_matmul_dev", getattr(comm, "cid", -1),
                    getattr(x, "nbytes", 0))
     try:
-        return _launch(launcher, "allgather_matmul", "ring")
+        return _launch(launcher, "allgather_matmul", "ring", comm, x)
     finally:
         fl.exit(tok)
 

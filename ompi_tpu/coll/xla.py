@@ -40,6 +40,7 @@ from ompi_tpu.monitoring import matrix as _mon
 from ompi_tpu.prof import ledger as _prof
 from ompi_tpu.telemetry import flight as _flight
 from ompi_tpu.trace import recorder as _trace
+from ompi_tpu.tune import observe as _tobs
 
 _out = output.stream("coll_xla")
 
@@ -150,6 +151,19 @@ def _det(deterministic: Optional[str]) -> Optional[str]:
     if deterministic is not None:
         return deterministic or None
     return _default_det.get() or None
+
+
+def _observed(launcher, op: str, comm, nbytes, dtype: str,
+              deterministic: Optional[str] = None):
+    """tune-plane hook on the slot's prepared launcher: when the
+    observatory is up, time this dispatch under provider 'xla' — the
+    backend that actually served after hier/pallas fallthrough. One
+    attribute load + one branch when off."""
+    obs = _tobs.OBSERVER
+    if obs is None:
+        return launcher
+    return obs.timed("xla", op, _det(deterministic) or "auto", comm,
+                     int(nbytes), dtype, launcher)
 
 
 class _Ctx:
@@ -440,13 +454,17 @@ def allreduce_dev(comm, sendbuf, op=op_mod.SUM,
     if tm is not None:
         tm.coll("allreduce", comm, getattr(sendbuf, "nbytes", 0),
                 dtype=str(getattr(sendbuf, "dtype", "")))
+    launcher = _observed(
+        _allreduce_prep(comm, sendbuf, op, deterministic),
+        "allreduce", comm, getattr(sendbuf, "nbytes", 0),
+        str(getattr(sendbuf, "dtype", "")), deterministic)
     fl = _flight.FLIGHT
     if fl is None:
-        return _allreduce_prep(comm, sendbuf, op, deterministic)()
+        return launcher()
     tok = fl.enter("allreduce_dev", getattr(comm, "cid", -1),
                    getattr(sendbuf, "nbytes", 0))
     try:
-        return _allreduce_prep(comm, sendbuf, op, deterministic)()
+        return launcher()
     finally:
         fl.exit(tok)
 
@@ -642,13 +660,16 @@ def bcast_dev(comm, buf, root: int = 0):
     if tm is not None:
         tm.coll("bcast", comm, getattr(buf, "nbytes", 0), root=root,
                 dtype=str(getattr(buf, "dtype", "")))
+    launcher = _observed(_bcast_prep(comm, buf, root), "bcast", comm,
+                         getattr(buf, "nbytes", 0),
+                         str(getattr(buf, "dtype", "")))
     fl = _flight.FLIGHT
     if fl is None:
-        return _bcast_prep(comm, buf, root)()
+        return launcher()
     tok = fl.enter("bcast_dev", getattr(comm, "cid", -1),
                    getattr(buf, "nbytes", 0))
     try:
-        return _bcast_prep(comm, buf, root)()
+        return launcher()
     finally:
         fl.exit(tok)
 
@@ -681,13 +702,16 @@ def allgather_dev(comm, sendbuf):
     if tm is not None:
         tm.coll("allgather", comm, getattr(sendbuf, "nbytes", 0),
                 dtype=str(getattr(sendbuf, "dtype", "")))
+    launcher = _observed(_allgather_prep(comm, sendbuf), "allgather",
+                         comm, getattr(sendbuf, "nbytes", 0),
+                         str(getattr(sendbuf, "dtype", "")))
     fl = _flight.FLIGHT
     if fl is None:
-        return _allgather_prep(comm, sendbuf)()
+        return launcher()
     tok = fl.enter("allgather_dev", getattr(comm, "cid", -1),
                    getattr(sendbuf, "nbytes", 0))
     try:
-        return _allgather_prep(comm, sendbuf)()
+        return launcher()
     finally:
         fl.exit(tok)
 
@@ -743,13 +767,16 @@ def alltoall_dev(comm, sendbuf):
     if tm is not None:
         tm.coll("alltoall", comm, getattr(sendbuf, "nbytes", 0),
                 dtype=str(getattr(sendbuf, "dtype", "")))
+    launcher = _observed(_alltoall_prep(comm, sendbuf), "alltoall",
+                         comm, getattr(sendbuf, "nbytes", 0),
+                         str(getattr(sendbuf, "dtype", "")))
     fl = _flight.FLIGHT
     if fl is None:
-        return _alltoall_prep(comm, sendbuf)()
+        return launcher()
     tok = fl.enter("alltoall_dev", getattr(comm, "cid", -1),
                    getattr(sendbuf, "nbytes", 0))
     try:
-        return _alltoall_prep(comm, sendbuf)()
+        return launcher()
     finally:
         fl.exit(tok)
 
@@ -790,15 +817,17 @@ def reduce_scatter_block_dev(comm, sendbuf, op=op_mod.SUM,
         tm.coll("reduce_scatter_block", comm,
                 getattr(sendbuf, "nbytes", 0),
                 dtype=str(getattr(sendbuf, "dtype", "")))
+    launcher = _observed(
+        _reduce_scatter_block_prep(comm, sendbuf, op, deterministic),
+        "reduce_scatter_block", comm, getattr(sendbuf, "nbytes", 0),
+        str(getattr(sendbuf, "dtype", "")), deterministic)
     fl = _flight.FLIGHT
     if fl is None:
-        return _reduce_scatter_block_prep(comm, sendbuf, op,
-                                          deterministic)()
+        return launcher()
     tok = fl.enter("reduce_scatter_block_dev", getattr(comm, "cid", -1),
                    getattr(sendbuf, "nbytes", 0))
     try:
-        return _reduce_scatter_block_prep(comm, sendbuf, op,
-                                          deterministic)()
+        return launcher()
     finally:
         fl.exit(tok)
 
@@ -1359,14 +1388,19 @@ def allreduce_multi_dev(comm, bufs, op=op_mod.SUM,
         tm.coll("allreduce_multi", comm,
                 sum(getattr(b, "nbytes", 0) for b in leaves),
                 dtype=str(getattr(leaves[0], "dtype", "")))
+    leaves = jax.tree.leaves(bufs)
+    nb = sum(getattr(b, "nbytes", 0) for b in leaves)
+    launcher = _observed(
+        _allreduce_multi_prep(comm, bufs, op, deterministic),
+        "allreduce_multi", comm, nb,
+        str(getattr(leaves[0], "dtype", "")), deterministic)
     fl = _flight.FLIGHT
     if fl is None:
-        return _allreduce_multi_prep(comm, bufs, op, deterministic)()
+        return launcher()
     tok = fl.enter("allreduce_multi_dev", getattr(comm, "cid", -1),
-                   sum(getattr(b, "nbytes", 0)
-                       for b in jax.tree.leaves(bufs)))
+                   nb)
     try:
-        return _allreduce_multi_prep(comm, bufs, op, deterministic)()
+        return launcher()
     finally:
         fl.exit(tok)
 

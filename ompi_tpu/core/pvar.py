@@ -217,6 +217,9 @@ WELL_KNOWN = (
     "smsc_bytes", "smsc_single_copies",
     "spawned_procs", "sync_injected_barriers",
     "telemetry_inflight",
+    "tune_samples", "tune_dropped", "tune_table_errors",
+    "tune_regressions", "tune_db_loads", "tune_db_saves",
+    "tune_db_errors",
     "vprotocol_logged_sends", "vprotocol_resends",
 )
 

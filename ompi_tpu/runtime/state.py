@@ -114,6 +114,16 @@ def init_instance() -> None:
                 _monitoring.start(rank=rte.rank, nranks=rte.size)
             except Exception as exc:  # monitoring must never sink init
                 _out.verbose(0, "monitoring enable failed: %r", exc)
+        # collective performance observatory (cvar tune_observe /
+        # OMPI_TPU_TUNE): load the PerfDB baseline and raise the
+        # OBSERVER guard before any collective dispatches
+        from ompi_tpu import tune as _tune
+
+        if _tune.requested():
+            try:
+                _tune.start(rank=rte.rank, nranks=rte.size)
+            except Exception as exc:  # observing must never sink init
+                _out.verbose(0, "tune enable failed: %r", exc)
         # debugger hook: SIGUSR1 match-queue dump (MPIR analog)
         from ompi_tpu.tools import msgq as _msgq
 
@@ -194,6 +204,16 @@ def _release() -> None:
 
             try:
                 _telemetry.stop()
+            except Exception:
+                pass
+            # the observatory persists its PerfDB while the kvstore
+            # is still up (cross-rank merge + rank-0 fold) — after
+            # telemetry (the watchdog may still want regression
+            # context until its last sweep), before the pml dies
+            from ompi_tpu import tune as _tune
+
+            try:
+                _tune.stop()
             except Exception:
                 pass
             # traffic matrices dump at Finalize (the common/monitoring

@@ -67,15 +67,22 @@ _MON_TX_RE = re.compile(
 _MON_LINK_RE = re.compile(
     r"^monitoring_link_bytes_d(\d+)_r(\d+)_r(\d+)(_hwm)?$")
 _MON_EXPERT_RE = re.compile(r"^monitoring_expert_tokens_e(\d+)$")
+_TUNE_OBS_RE = re.compile(r"^tune_obs_(.+)_(xla|pallas|hier)$")
 
 
 def _mon_split(name: str
                ) -> Optional[Tuple[str, Dict[str, str], bool]]:
-    """Monitoring-plane per-cell pvar -> (family, labels, is_gauge):
+    """Dynamically-named per-cell pvar -> (family, labels, is_gauge):
     the matrix cells (``monitoring_tx_*_s<i>_d<j>_<ctx>``), per-link
     loads (``monitoring_link_bytes_d<d>_r<a>_r<b>``, hwm-backed so a
-    gauge) and per-expert token counts fold into labelled families
-    instead of one flat metric per cell."""
+    gauge), per-expert token counts, and the tune plane's per-(op,
+    provider) observation counters (``tune_obs_<op>_<provider>`` ->
+    ``tune_observed{op=...,provider=...}``) fold into labelled
+    families instead of one flat metric per cell."""
+    m = _TUNE_OBS_RE.match(name)
+    if m:
+        return ("tune_observed",
+                {"op": m.group(1), "provider": m.group(2)}, False)
     m = _MON_TX_RE.match(name)
     if m:
         return ("monitoring_tx_" + m.group(1),

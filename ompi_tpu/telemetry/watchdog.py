@@ -301,6 +301,15 @@ class Watchdog:
             hot = tm.hotspot()
             if hot:
                 doc["traffic_hotspot"] = hot
+        # a hang that follows a 10x collective slowdown is likelier a
+        # congested/degraded link than a lost peer — the observatory's
+        # run-over-run regression verdicts name the slow keys
+        # (optional key, tune plane)
+        from ompi_tpu import tune as _tune
+
+        regs = _tune.regression_info()
+        if regs is not None:
+            doc["tune_regressions"] = regs
         from ompi_tpu.trace import recorder as _trace
 
         rec = _trace.RECORDER

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# tune/ smoke lane: 2-rank CPU run of examples/tune_observe.py with the
+# collective performance observatory on. The example asserts provider
+# attribution itself (allreduce sampled under BOTH pallas and xla —
+# direct slot + staged fallthrough — bcast under xla only); the lane
+# then proves the offline half: per-rank dumps + the persistent PerfDB
+# exist, `python -m ompi_tpu.tune report` names the measured
+# pallas-vs-xla allreduce crossover, the emitted candidate switchpoint
+# table is accepted verbatim by the real coll/pallas reader, and a
+# seeded slowdown (a doctored 16x-faster baseline DB) produces a named
+# regression verdict. Artifacts are kept for upload.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+outdir="${1:-tune_smoke_out}"
+mkdir -p "$outdir/db"
+
+out=$(JAX_PLATFORMS=cpu \
+  OMPI_TPU_TUNE_ARTIFACT="$outdir/tune_summary.json" \
+  python -m ompi_tpu.runtime.launcher -n 2 \
+  --timeout 120 \
+  --mca device_plane on \
+  --mca coll_pallas on \
+  --mca tune_observe 1 \
+  --mca tune_dump "$outdir/tune_r{rank}.json" \
+  --mca tune_db_dir "$outdir/db" \
+  examples/tune_observe.py)
+echo "$out"
+echo "$out" | grep -q "allreduce attributed pallas=3 xla=4" \
+  || { echo "tune smoke: provider attribution line missing" >&2; exit 1; }
+[ -s "$outdir/tune_r0.json" ] && [ -s "$outdir/tune_r1.json" ] \
+  || { echo "tune smoke: per-rank dumps missing" >&2; exit 1; }
+db=$(ls "$outdir"/db/tune_perfdb_*_n2.json 2>/dev/null | head -1)
+[ -n "$db" ] && [ -s "$db" ] \
+  || { echo "tune smoke: persistent PerfDB missing" >&2; exit 1; }
+
+report=$(JAX_PLATFORMS=cpu python -m ompi_tpu.tune report \
+  "$outdir/tune_r0.json" "$outdir/tune_r1.json" \
+  --tables "$outdir/cand" --json "$outdir/merged.json")
+echo "$report"
+echo "$report" | grep -q "\[pallas-vs-xla\] allreduce float32" \
+  || { echo "tune smoke: crossover not named" >&2; exit 1; }
+
+JAX_PLATFORMS=cpu python - "$outdir/cand_pallas.json" <<'EOF'
+import sys
+
+from ompi_tpu.coll import pallas
+from ompi_tpu.core import cvar, pvar
+
+s = pvar.session()
+cvar.set("coll_pallas_switchpoints", sys.argv[1])
+pallas._sw_cache.clear()
+algo = pallas._switchpoint("allreduce", 8192, "float32", (2,))
+assert algo in ("ring", "bidir", "linear", "xla"), algo
+assert s.read("tune_table_errors") == 0, "reader rejected the table"
+print(f"candidate table accepted by coll/pallas reader: {algo}")
+EOF
+
+# seeded slowdown: doctor a 16x-faster copy of the PerfDB as the
+# baseline -- every live key must regress against it, by name
+JAX_PLATFORMS=cpu python - "$db" "$outdir/baseline_fast.json" <<'EOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+for e in doc["entries"]:
+    for k in ("sum_ns", "min_ns", "max_ns"):
+        e[k] = max(1, int(e[k]) // 16)
+    hist = {}
+    for b, n in e["hist"].items():
+        nb = str(max(1, int(b) - 4))
+        hist[nb] = hist.get(nb, 0) + int(n)
+    e["hist"] = hist
+json.dump(doc, open(sys.argv[2], "w"), indent=1)
+EOF
+reg=$(JAX_PLATFORMS=cpu python -m ompi_tpu.tune report \
+  "$outdir/tune_r0.json" "$outdir/tune_r1.json" \
+  --db "$outdir/baseline_fast.json")
+echo "$reg" | grep "REGRESSION:" || true
+echo "$reg" | grep -q "REGRESSION: allreduce float32 .* slower than PerfDB baseline" \
+  || { echo "tune smoke: seeded regression not named" >&2; exit 1; }
+echo "tune smoke OK"

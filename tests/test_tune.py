@@ -1,0 +1,369 @@
+"""tune/ — the in-band collective performance observatory.
+
+Reference analog: coll/tuned's measured dynamic-rules files. Covers
+the PerfDB persistence/merge contracts (associative, corrupt-proof),
+the OBSERVER guard's level-0 off state, candidate-table acceptance
+by the real ``_switchpoint`` readers, regression verdicts, the
+table-error satellite, the CLI, the OpenMetrics family, and
+end-to-end 2-rank (pallas + xla) / 4-rank (hier) observation.
+"""
+
+import json
+
+import pytest
+
+from tests.harness import run_ranks
+
+
+def _stats(samples):
+    """Build an observer stats table from (key, durations) pairs."""
+    from ompi_tpu.tune import observe
+    obs = observe.Observer(rank=0)
+    for (op, dt, lg, mesh, prov, algo), durs in samples:
+        for d in durs:
+            obs.sample(op, dt, lg, mesh, prov, algo, d)
+    return obs.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# PerfDB persistence + merge
+
+
+def test_perfdb_roundtrip_and_associative_merge(tmp_path):
+    """persist -> reload is lossless, and the cross-run/cross-rank
+    merge is associative: (a+b)+c == a+(b+c) in every component,
+    counts and histogram sketches included."""
+    from ompi_tpu.tune import perfdb
+    key = ("allreduce", "float32", 20, (2,), "pallas", "ring")
+    a = _stats([(key, [100, 200, 300])])
+    b = _stats([(key, [400]),
+                (("bcast", "int32", 10, (4,), "xla", "auto"), [50])])
+    c = _stats([(key, [800, 900])])
+
+    path = str(tmp_path / "db.json")
+    assert perfdb.save(path, perfdb.doc_of(a, "cpu", 2))
+    doc = perfdb.load(path)
+    assert doc["schema"] == perfdb.SCHEMA
+    assert perfdb.stats_of(doc["entries"]) == a
+
+    docs = [perfdb.doc_of(s, "cpu", 2) for s in (a, b, c)]
+    left = perfdb.merge([perfdb.merge(docs[:2]), docs[2]])
+    right = perfdb.merge([docs[0], perfdb.merge(docs[1:])])
+    assert perfdb.stats_of(left["entries"]) == \
+        perfdb.stats_of(right["entries"])
+    rec = perfdb.stats_of(left["entries"])[key]
+    assert rec[0] == 6 and rec[1] == 2700
+    assert rec[2] == 100 and rec[3] == 900
+    assert sum(rec[4].values()) == 6
+    assert left["runs"] == 3  # run provenance accumulates
+
+
+def test_perfdb_corrupt_degrades_to_empty(tmp_path):
+    """A corrupt/alien DB file NEVER raises at load — it degrades to
+    an empty DB with tune_db_errors bumped (init must survive a
+    stale cache dir)."""
+    from ompi_tpu.core import pvar
+    from ompi_tpu.tune import perfdb
+    s = pvar.session()
+    missing = perfdb.load(str(tmp_path / "nope.json"))
+    assert missing["entries"] == []
+    assert s.read("tune_db_errors") == 0  # absent is not an error
+
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("{not json")
+    doc = perfdb.load(str(garbage))
+    assert doc["entries"] == [] and doc["runs"] == 0
+    assert s.read("tune_db_errors") == 1
+
+    alien = tmp_path / "alien.json"
+    alien.write_text(json.dumps({"schema": "other/1", "entries": []}))
+    assert perfdb.load(str(alien))["entries"] == []
+    assert s.read("tune_db_errors") == 2
+
+    # entry-shape damage (valid JSON, wrong fields) degrades too
+    broken = tmp_path / "broken.json"
+    broken.write_text(json.dumps(
+        {"schema": perfdb.SCHEMA, "entries": [{"op": "x"}]}))
+    assert perfdb.load(str(broken))["entries"] == []
+    assert s.read("tune_db_errors") == 3
+
+
+# ---------------------------------------------------------------------------
+# level-0 off state
+
+
+def test_observe_level_zero_plane_is_off():
+    """Default sessions pay one branch: no observer, requested() is
+    False, and the public plane calls are no-ops."""
+    import ompi_tpu.tune as tune
+    from ompi_tpu.tune import observe
+    assert observe.OBSERVER is None
+    assert not tune.requested()
+    assert tune.regression_info() is None  # guard-only, no work
+    tune.stop()  # idempotent no-op with the guard down
+
+
+# ---------------------------------------------------------------------------
+# crossovers + candidate tables + regressions (pure report layer)
+
+
+def _crossover_stats():
+    key_p = ("allreduce", "float32", 20, (2,), "pallas", "ring")
+    key_x = ("allreduce", "float32", 20, (2,), "xla", "auto")
+    key_h = ("allreduce", "float32", 24, (2, 2), "hier", "hier")
+    key_f = ("allreduce", "float32", 24, (4,), "xla", "auto")
+    return _stats([
+        (key_p, [1000] * 8), (key_x, [5000] * 8),   # pallas wins
+        (key_h, [9000] * 8), (key_f, [3000] * 8),   # flat wins
+    ])
+
+
+def test_crossovers_and_candidate_tables_accepted_by_readers(
+        tmp_path):
+    """The acceptance contract: emitted candidate tables parse
+    through the REAL coll/pallas and coll/hier ``_switchpoint``
+    readers verbatim and select the measured winner."""
+    from ompi_tpu.core import cvar
+    from ompi_tpu.coll import hier as chier
+    from ompi_tpu.coll import pallas as cpallas
+    from ompi_tpu.tune import report
+
+    stats = _crossover_stats()
+    rows = report.crossovers(stats)
+    pairs = {r["pair"]: r for r in rows}
+    assert pairs["pallas-vs-xla"]["winner"] == "pallas"
+    # p50s come from log2-bin midpoints, so the ratio is quantized —
+    # the measured 5x gap lands in the 8x bin pair
+    assert pairs["pallas-vs-xla"]["speedup"] > 2.0
+    assert pairs["hier-vs-flat"]["winner"] == "xla"
+
+    tables = report.candidate_tables(stats)
+    ppath = tmp_path / "cand_pallas.json"
+    hpath = tmp_path / "cand_hier.json"
+    ppath.write_text(json.dumps(tables["pallas"]))
+    hpath.write_text(json.dumps(tables["hier"]))
+
+    try:
+        cvar.set("coll_pallas_switchpoints", str(ppath))
+        cpallas._sw_cache.clear()
+        assert cpallas._switchpoint(
+            "allreduce", 1 << 20, "float32", (2,)) == "ring"
+        cvar.set("coll_hier_switchpoints", str(hpath))
+        chier._sw_cache.clear()
+        assert chier._switchpoint(
+            "allreduce", 1 << 24, "float32", (2, 2)) == "flat"
+    finally:
+        cvar.set("coll_pallas_switchpoints", "")
+        cvar.set("coll_hier_switchpoints", "")
+        cpallas._sw_cache.clear()
+        chier._sw_cache.clear()
+
+
+def test_regression_verdicts_named(tmp_path):
+    """A seeded slowdown vs the baseline produces a named verdict
+    ('op dtype 2^lg on mesh [provider/algo]: p50 Nx slower...')."""
+    from ompi_tpu.tune import report
+    key = ("allreduce", "float32", 24, (2, 2), "hier", "hier")
+    base = _stats([(key, [4096] * 10)])
+    cur = _stats([(key, [4096 * 8] * 10)])
+    regs = report.regressions(cur, base, threshold=1.5)
+    assert len(regs) == 1
+    v = regs[0]["verdict"]
+    assert "allreduce float32 2^24 on 2x2 [hier/hier]" in v
+    assert "slower than PerfDB baseline" in v
+    assert regs[0]["ratio"] == pytest.approx(8.0)
+    # under the bar: no verdict
+    assert report.regressions(base, base, threshold=1.5) == []
+    text = report.render(cur, baseline=base)
+    assert "REGRESSION: allreduce float32 2^24" in text
+
+
+# ---------------------------------------------------------------------------
+# satellite: switchpoint-table failures are loud
+
+
+def test_switchpoint_table_errors_are_counted(tmp_path):
+    """A malformed table file surfaces as tune_table_errors + a
+    once-per-path warning (not the old verbose(1) whisper) and the
+    reader still degrades to built-in thresholds."""
+    from ompi_tpu.core import cvar, pvar
+    from ompi_tpu.coll import hier as chier
+    from ompi_tpu.coll import pallas as cpallas
+    bad = tmp_path / "bad_table.json"
+    bad.write_text("{not json")
+    s = pvar.session()
+    try:
+        cvar.set("coll_pallas_switchpoints", str(bad))
+        cpallas._sw_cache.clear()
+        assert cpallas._switchpoint(
+            "allreduce", 1 << 20, "float32", (2,)) == ""
+        assert s.read("tune_table_errors") == 1
+        cvar.set("coll_hier_switchpoints", str(bad))
+        chier._sw_cache.clear()
+        assert chier._switchpoint(
+            "allreduce", 1 << 20, "float32", (2, 2)) == ""
+        assert s.read("tune_table_errors") == 2
+    finally:
+        cvar.set("coll_pallas_switchpoints", "")
+        cvar.set("coll_hier_switchpoints", "")
+        cpallas._sw_cache.clear()
+        chier._sw_cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_tune_cli_report(tmp_path):
+    """The report CLI merges per-rank dumps, writes candidate tables
+    + merged JSON, names regressions vs --db, and follows the
+    monitoring CLI error contract (stderr + exit 1)."""
+    from ompi_tpu.tune import perfdb
+    from ompi_tpu.tune.__main__ import main
+    stats = _crossover_stats()
+    key = ("allreduce", "float32", 20, (2,), "pallas", "ring")
+    fast = _stats([(key, [100] * 10)])
+
+    r0 = tmp_path / "tune_r0.json"
+    r1 = tmp_path / "tune_r1.json"
+    r0.write_text(json.dumps(perfdb.doc_of(stats, "cpu", 2)))
+    r1.write_text(json.dumps(perfdb.doc_of(stats, "cpu", 2)))
+    db = tmp_path / "baseline.json"
+    db.write_text(json.dumps(perfdb.doc_of(fast, "cpu", 2)))
+
+    out = tmp_path / "merged.json"
+    assert main(["report", str(r0), str(r1), "--db", str(db),
+                 "--json", str(out),
+                 "--tables", str(tmp_path / "cand")]) == 0
+    merged = json.loads(out.read_text())
+    assert perfdb.stats_of(merged["entries"])[key][0] == 16
+    cand = json.loads((tmp_path / "cand_pallas.json").read_text())
+    assert cand and cand[0]["algorithm"] == "ring"
+    assert (tmp_path / "cand_hier.json").exists()
+
+    assert main(["report", str(tmp_path / "missing.json")]) == 1
+    bad = tmp_path / "bad.json"
+    bad.write_text("garbage")
+    assert main(["report", str(bad)]) == 1
+    assert main(["report", str(r0), "--db", str(bad)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics family
+
+
+def test_openmetrics_tune_family():
+    """Dynamic tune_obs_<op>_<provider> pvars render as ONE labelled
+    tune_observed family; flat tune_* counters stay plain."""
+    from ompi_tpu.telemetry import openmetrics as om
+    snap = {
+        "tune_obs_allreduce_pallas": 7,
+        "tune_obs_allreduce_xla": 3,
+        "tune_samples": 10,
+    }
+    text = om.render(snap, labels={"rank": "0"})
+    assert ('ompi_tpu_tune_observed_total'
+            '{op="allreduce",provider="pallas",rank="0"} 7') in text
+    assert ('ompi_tpu_tune_observed_total'
+            '{op="allreduce",provider="xla",rank="0"} 3') in text
+    assert 'ompi_tpu_tune_samples_total{rank="0"} 10' in text
+    assert text.count("# TYPE ompi_tpu_tune_observed counter") == 1
+    parsed = om.parse(text)
+    assert parsed["tune_observed"][
+        '{op="allreduce",provider="pallas",rank="0"}'] == 7
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: observation across providers + persistence
+
+
+def test_observatory_two_ranks_mixed_providers(tmp_path):
+    """tune_observe=1 over mixed pallas + xla collectives: samples
+    attribute to the provider that ACTUALLY served, the Finalize
+    path dumps per-rank docs, the kvstore exchange merges them, and
+    rank 0 persists the DB — whose candidate tables the readers
+    accept."""
+    mca = {"device_plane": "on", "coll_pallas": "on",
+           "tune_observe": "1",
+           "tune_dump": str(tmp_path / "tune_r{rank}.json"),
+           "tune_db_dir": str(tmp_path)}
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    from ompi_tpu.tune import observe
+    assert observe.OBSERVER is not None
+    s = pvar.session()
+    x = jnp.arange(2048, dtype=jnp.float32) + rank
+    small = jnp.arange(64, dtype=jnp.int8)  # unsupported -> xla
+    for _ in range(3):
+        comm.coll.allreduce_dev(comm, x)        # pallas serves
+        comm.coll.bcast_dev(comm, x, 0)         # pallas has no bcast
+    assert s.read("tune_samples") >= 6
+    assert s.read("tune_obs_allreduce_pallas") == 3
+    assert s.read("tune_obs_bcast_xla") == 3
+    stats = observe.OBSERVER.snapshot()
+    provs = {k[4] for k in stats}
+    assert provs == {"pallas", "xla"}, provs
+    # the Finalize path: dump + kvstore merge + rank-0 DB fold
+    import ompi_tpu.tune as tune
+    tune.stop()
+    assert observe.OBSERVER is None
+    """, 2, mca=mca, timeout=240)
+    # per-rank dumps landed
+    from ompi_tpu.tune import perfdb, report
+    for r in range(2):
+        doc = json.loads((tmp_path / f"tune_r{r}.json").read_text())
+        assert doc["schema"] == perfdb.SCHEMA
+    # rank 0 folded the merged run into the on-disk DB
+    import ompi_tpu.tune as tune
+    dbfile = tmp_path / ("tune_perfdb_%s_n2.json"
+                         % tune.device_kind().replace(" ", "_"))
+    db = json.loads(dbfile.read_text())
+    stats = perfdb.stats_of(db["entries"])
+    # both ranks' samples merged: 2 ranks x 3 launches
+    key = next(k for k in stats
+               if k[0] == "allreduce" and k[4] == "pallas")
+    assert stats[key][0] == 6, stats[key]
+    assert any(k[4] == "xla" for k in stats)
+    # the emitted candidates parse through the real readers
+    from ompi_tpu.core import cvar
+    from ompi_tpu.coll import pallas as cpallas
+    tables = report.candidate_tables(stats)
+    if tables["pallas"]:
+        p = tmp_path / "cand_pallas.json"
+        p.write_text(json.dumps(tables["pallas"]))
+        try:
+            cvar.set("coll_pallas_switchpoints", str(p))
+            cpallas._sw_cache.clear()
+            e = tables["pallas"][0]
+            got = cpallas._switchpoint(
+                e["op"], 1 << e["log2"], e["dtype"],
+                tuple(e["mesh"]))
+            assert got == e["algorithm"]
+        finally:
+            cvar.set("coll_pallas_switchpoints", "")
+            cpallas._sw_cache.clear()
+
+
+def test_observatory_hier_four_ranks():
+    """The hier provider attributes on its (n_dcn, n_ici) grid —
+    the key shape coll_hier_switchpoints selects on."""
+    mca = {"device_plane": "on", "coll_hier": "on",
+           "coll_hier_split": "2x2", "tune_observe": "1"}
+    run_ranks("""
+    import jax.numpy as jnp
+    from ompi_tpu.core import pvar
+    from ompi_tpu.tune import observe
+    assert observe.OBSERVER is not None
+    s = pvar.session()
+    x = jnp.arange(2048, dtype=jnp.float32) + rank
+    comm.coll.allreduce_dev(comm, x)
+    assert s.read("tune_obs_allreduce_hier") == 1
+    stats = observe.OBSERVER.snapshot()
+    key = next(k for k in stats if k[4] == "hier")
+    op, dt, lg, mesh, prov, algo = key
+    assert (op, dt, mesh, algo) == \\
+        ("allreduce", "float32", (2, 2), "hier"), key
+    import ompi_tpu.tune as tune
+    tune.stop()
+    """, 4, mca=mca, timeout=240)
