@@ -685,6 +685,70 @@ def _bench_tune():
     }
 
 
+def _bench_skew():
+    """Cost card for the skew attribution plane: the level-0 guard
+    (``SKEW is None`` — what every flight-recorder exit pays when
+    attribution is off), the level-1 per-completion ring record, and
+    the guard cost relative to the 256KiB per-message floor (the
+    monitoring guard bench's shape) — acceptance bound: level-0
+    overhead < 1% of that floor."""
+    import numpy as np
+
+    from ompi_tpu.skew import record as _skew_rec
+
+    iters = 200000
+    seq = [0]
+
+    def guarded():
+        sk = _skew_rec.SKEW
+        if sk is not None:
+            seq[0] += 1
+            sk.complete(seq[0], "allreduce", 1, 4096, 1.0, 2.0)
+
+    def bare():
+        pass
+
+    prev, _skew_rec.SKEW = _skew_rec.SKEW, None  # force level-0 view
+    try:
+        guarded()  # warm
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            guarded()
+        call_ns = (time.perf_counter_ns() - t0) / iters
+        # the real site is inline: subtract the closure-call floor
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            bare()
+        guard_ns = max(call_ns
+                       - (time.perf_counter_ns() - t0) / iters, 0.0)
+    finally:
+        _skew_rec.SKEW = prev
+
+    # per-message host-work floor: one 256KiB payload materialization
+    t0 = time.perf_counter_ns()
+    for _ in range(iters // 10):
+        np.zeros(262144, np.uint8)
+    msg_ns = (time.perf_counter_ns() - t0) / (iters // 10)
+
+    fresh = _skew_rec.SKEW is None  # don't clobber a live plane
+    if fresh:
+        _skew_rec.enable(rank=0, nranks=1, level=1, capacity=4096)
+    try:
+        t0 = time.perf_counter_ns()
+        for _ in range(20000):
+            guarded()
+        record_ns = (time.perf_counter_ns() - t0) / 20000
+    finally:
+        if fresh:
+            _skew_rec.disable()
+    return {
+        "level0_guard_ns": round(guard_ns, 1),
+        "level1_record_ns": round(record_ns, 1),
+        "level0_overhead_pct": round(
+            guard_ns / max(msg_ns, 1.0) * 100.0, 3),
+    }
+
+
 def _bench_ingest():
     """Streamed vs serial cold start (BENCH_r05: 471s of 488s wall
     was serial upload-then-compile). Serial arm: to_device every
@@ -1313,6 +1377,8 @@ _EXTRA_BASELINE_KEYS = (
     ("serve", "reroute_kept_gain", True),
     ("tune", "level0_guard_ns", False),
     ("tune", "level1_sample_ns", False),
+    ("skew", "level0_guard_ns", False),
+    ("skew", "level1_record_ns", False),
 )
 
 
@@ -1482,6 +1548,13 @@ def main() -> None:
             _phase("tune microbench done")
         except Exception as e:
             _phase(f"tune microbench skipped: {e!r}")
+    skew = None
+    if "--skew" in sys.argv:
+        try:
+            skew = _bench_skew()
+            _phase("skew microbench done")
+        except Exception as e:
+            _phase(f"skew microbench skipped: {e!r}")
     if trace_path is not None:
         from ompi_tpu.trace import export as trace_export
         from ompi_tpu.trace import recorder as trace_rec
@@ -1525,7 +1598,8 @@ def main() -> None:
                                    "pallas": pallas,
                                    "hier": hier,
                                    "serve": serve,
-                                   "tune": tune})
+                                   "tune": tune,
+                                   "skew": skew})
         except Exception:
             pass
 
@@ -1575,6 +1649,7 @@ def main() -> None:
             "hier": hier,
             "serve": serve,
             "tune": tune,
+            "skew": skew,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),
             # wall attribution from the prof-plane phase ledger

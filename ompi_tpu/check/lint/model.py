@@ -135,7 +135,7 @@ FREE_NAMES = frozenset(("free", "Free", "close", "Close",
 
 #: module globals carrying the one-branch disabled guard convention
 GUARD_GLOBALS = frozenset(("FLIGHT", "RECORDER", "SANITIZER",
-                           "TRAFFIC", "INGEST", "OBSERVER"))
+                           "TRAFFIC", "INGEST", "OBSERVER", "SKEW"))
 
 #: path components marking the MPI-convention public API surface for
 #: bare-public-raise (coll/, osc/, shmem/, part/, ingest/, elastic/,

@@ -152,6 +152,19 @@ WELL_KNOWN = (
     "elastic_shrinks", "elastic_hot_joins", "elastic_reshard_bytes",
     "elastic_recovery_ns", "elastic_fallback_restores",
     "elastic_checkpoints", "elastic_injected_kills",
+    "elastic_injected_delays",
+    # skew/ plane (cross-rank straggler attribution): completed
+    # collectives recorded in the per-rank ring (+ overflow drops and
+    # the ring's depth watermark), this rank's total exposed wait
+    # (time spent blocked on later-arriving peers, folded in at
+    # Finalize from the merged decomposition; per-op splits ride the
+    # dynamic skew_op_wait_ns_<op> family), the worst single-
+    # collective arrival skew seen, persistent stragglers named by
+    # the verdict, and — at level 2 — the worst live lag the
+    # watchdog's heartbeat sampling observed
+    "skew_records", "skew_dropped", "skew_ring_depth",
+    "skew_exposed_wait_ns", "skew_arrival_skew_ns",
+    "skew_stragglers", "skew_live_lag_ns",
     # io/async_ckpt (crash-consistent overlapped checkpoints):
     # snapshots begun / epochs committed, chunk counts + shard bytes
     # + d2h/write walls, collective-write retries and the per-rank

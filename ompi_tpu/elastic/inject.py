@@ -7,6 +7,11 @@ reproduces exactly that at a configured (step, world rank), so the
 whole detect -> revoke -> shrink -> re-shard -> resume chain is
 exercised in tier-1 and CI instead of only on real hardware.
 
+:func:`maybe_delay` is the non-fatal sibling: a deterministic
+per-step sleep on one configured rank — a reproducible *straggler*
+(late into every collective, never dead) for the skew plane's
+attribution smoke and tests.
+
 :class:`ChaosClient` is the store-RPC side of the harness: a kvstore
 client that adds deterministic latency and/or drops the first N RPCs
 (raising the same ``OSError`` a reset connection would), used by the
@@ -32,6 +37,20 @@ _kill_rank_var = cvar.register(
     help="World rank that SIGKILLs itself at "
          "elastic_inject_kill_step — no shutdown path runs, exactly "
          "like a real crash.", level=9)
+_delay_rank_var = cvar.register(
+    "elastic_inject_delay_rank", -1, int,
+    help="World rank that sleeps elastic_inject_delay_s at the top "
+         "of each step from elastic_inject_delay_step on (-1 "
+         "disables) — a deterministic straggler for the skew plane's "
+         "attribution tests.", level=9)
+_delay_s_var = cvar.register(
+    "elastic_inject_delay_s", 0.0, float,
+    help="Injected per-step compute delay in seconds (see "
+         "elastic_inject_delay_rank).", level=9)
+_delay_step_var = cvar.register(
+    "elastic_inject_delay_step", -1, int,
+    help="First step at which the injected delay fires; every step "
+         ">= this sleeps. -1 disables.", level=9)
 
 
 def armed(step: int) -> bool:
@@ -51,6 +70,21 @@ def maybe_kill(step: int) -> None:
         return
     pvar.record("elastic_injected_kills")
     os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_delay(step: int) -> None:
+    """Sleep the configured injected delay if it is armed for (step,
+    this rank) — a deterministic STRAGGLER rather than a death: the
+    rank arrives late into every collective of every step >=
+    elastic_inject_delay_step, which is exactly the compute-side
+    lateness the skew plane must attribute and name."""
+    ds = _delay_step_var.get()
+    delay = _delay_s_var.get()
+    if (ds < 0 or step < ds or delay <= 0
+            or rte.rank != _delay_rank_var.get()):
+        return
+    pvar.record("elastic_injected_delays")
+    time.sleep(delay)
 
 
 class ChaosClient(kvstore.Client):

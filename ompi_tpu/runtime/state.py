@@ -150,6 +150,17 @@ def init_instance() -> None:
                 _telemetry.start(rank=rte.rank)
             except Exception as exc:  # telemetry must never sink init
                 _out.verbose(0, "telemetry enable failed: %r", exc)
+        # skew plane (cvar skew_level / OMPI_TPU_SKEW): completed-
+        # collective ring + store clock sync — rides the flight
+        # recorder's entry/exit instrumentation, so after telemetry
+        # (start() enables FLIGHT itself when telemetry is off)
+        from ompi_tpu import skew as _skew
+
+        if _skew.requested():
+            try:
+                _skew.start(rank=rte.rank, nranks=rte.size)
+            except Exception as exc:  # observing must never sink init
+                _out.verbose(0, "skew enable failed: %r", exc)
         # correctness plane (cvar check_level / OMPI_TPU_CHECK): the
         # runtime sanitizer interposes on the API dispatch table, so
         # it comes up last — after every plane that wraps methods —
@@ -204,6 +215,15 @@ def _release() -> None:
 
             try:
                 _telemetry.stop()
+            except Exception:
+                pass
+            # skew rings merge while the kvstore is still up — after
+            # telemetry.stop (FLIGHT is down, the ring stops being
+            # fed) so the Finalize exchange sees a settled ring
+            from ompi_tpu import skew as _skew
+
+            try:
+                _skew.stop()
             except Exception:
                 pass
             # the observatory persists its PerfDB while the kvstore

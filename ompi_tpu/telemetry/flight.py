@@ -27,6 +27,8 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ompi_tpu.core import pvar
+from ompi_tpu.skew import record as _skew_record
+from ompi_tpu.telemetry import clock as _clock
 
 #: THE disabled guard. Instrumented sites do
 #: ``fl = flight.FLIGHT`` / ``if fl is None: <fast path>`` — module
@@ -60,6 +62,12 @@ class FlightRecorder:
         self._inflight: Dict[int, Tuple[int, str, int, int, float]] = {}
         self.last_entered = 0
         self.last_completed = 0
+        # wall-ns stamp of the latest collective ARRIVAL — rides the
+        # heartbeat payload ("arr") so the watchdog can tell "never
+        # entered" from "entered 40 s late" and the skew plane can
+        # sample live lag; clock bracket from telemetry/clock.py
+        self.clock_offset_ns, self.clock_err_ns = _clock.sample_offset()
+        self.last_arrival_ns = 0
         # pml-level progress inside a collective context: ctx -> seq
         # (dump-only detail — shows the wire was still moving)
         self._pml: Dict[int, int] = {}
@@ -67,12 +75,13 @@ class FlightRecorder:
     # -- hot path (enabled only) ------------------------------------------
     def enter(self, op: str, comm_cid: int = -1, nbytes: int = 0) -> int:
         """Register a collective entry; returns the token for exit()."""
+        t0 = time.monotonic()
         with self._lock:
             self._seq += 1
             seq = self._seq
-            self._inflight[seq] = (seq, op, comm_cid, int(nbytes),
-                                   time.monotonic())
+            self._inflight[seq] = (seq, op, comm_cid, int(nbytes), t0)
             self.last_entered = seq
+            self.last_arrival_ns = int(t0 * 1e9) + self.clock_offset_ns
             depth = len(self._inflight)
         pvar.record("telemetry_flight_ops")
         pvar.record_hwm("telemetry_inflight", depth)
@@ -80,9 +89,18 @@ class FlightRecorder:
 
     def exit(self, token: int) -> None:
         with self._lock:
-            self._inflight.pop(token, None)
+            entry = self._inflight.pop(token, None)
             if token > self.last_completed:
                 self.last_completed = token
+        if entry is not None:
+            # exit side of the skew plane: one attribute load + one
+            # branch while skew is off — the completed collective's
+            # (seq, op, cid, nbytes, t_enter, t_exit) feeds the
+            # bounded per-rank ring only when SKEW is up
+            sk = _skew_record.SKEW
+            if sk is not None:
+                sk.complete(entry[0], entry[1], entry[2], entry[3],
+                            entry[4], time.monotonic())
 
     def mark_pml(self, ctx: int, seq: int) -> None:
         """Latest pml seq seen on a collective context (ob1 traffic)."""
@@ -110,11 +128,15 @@ class FlightRecorder:
         return out
 
     def hb_dict(self) -> Dict[str, int]:
-        """The heartbeat payload: latest entered/completed seq."""
+        """The heartbeat payload: latest entered/completed seq plus
+        the wall-ns stamp of the latest arrival (0 before the first
+        collective) — what lets a peer tell "rank 3 never entered"
+        from "rank 3 entered 40 s late"."""
         with self._lock:
             return {"seq": self.last_entered,
                     "done": self.last_completed,
-                    "inflight": len(self._inflight)}
+                    "inflight": len(self._inflight),
+                    "arr": self.last_arrival_ns}
 
 
 def hb_payload() -> Optional[Dict[str, int]]:

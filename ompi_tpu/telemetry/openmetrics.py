@@ -68,6 +68,7 @@ _MON_LINK_RE = re.compile(
     r"^monitoring_link_bytes_d(\d+)_r(\d+)_r(\d+)(_hwm)?$")
 _MON_EXPERT_RE = re.compile(r"^monitoring_expert_tokens_e(\d+)$")
 _TUNE_OBS_RE = re.compile(r"^tune_obs_(.+)_(xla|pallas|hier)$")
+_SKEW_OP_RE = re.compile(r"^skew_op_wait_ns_(.+)$")
 
 
 def _mon_split(name: str
@@ -75,10 +76,15 @@ def _mon_split(name: str
     """Dynamically-named per-cell pvar -> (family, labels, is_gauge):
     the matrix cells (``monitoring_tx_*_s<i>_d<j>_<ctx>``), per-link
     loads (``monitoring_link_bytes_d<d>_r<a>_r<b>``, hwm-backed so a
-    gauge), per-expert token counts, and the tune plane's per-(op,
+    gauge), per-expert token counts, the tune plane's per-(op,
     provider) observation counters (``tune_obs_<op>_<provider>`` ->
-    ``tune_observed{op=...,provider=...}``) fold into labelled
-    families instead of one flat metric per cell."""
+    ``tune_observed{op=...,provider=...}``), and the skew plane's
+    per-op exposed-wait counters (``skew_op_wait_ns_<op>`` ->
+    ``skew_op_wait_ns{op=...}``) fold into labelled families
+    instead of one flat metric per cell."""
+    m = _SKEW_OP_RE.match(name)
+    if m:
+        return ("skew_op_wait_ns", {"op": m.group(1)}, False)
     m = _TUNE_OBS_RE.match(name)
     if m:
         return ("tune_observed",

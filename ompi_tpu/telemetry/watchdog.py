@@ -35,6 +35,7 @@ import time
 from typing import Any, Dict, Optional
 
 from ompi_tpu.core import cvar, events, output, pvar
+from ompi_tpu.skew import record as _skew_record
 from ompi_tpu.telemetry import flight
 
 _out = output.stream("telemetry")
@@ -157,6 +158,18 @@ class Watchdog:
             return None
         if self._client is not None:
             self._client.heartbeat(self.rank, fl.hb_dict())
+        sk = _skew_record.SKEW
+        if sk is not None and sk.level >= 2 \
+                and self._client is not None:
+            # level-2 live skew: the heartbeat payloads' last-arrival
+            # stamps name the SLOW rank while the job is still making
+            # progress — before (or instead of) a hang verdict
+            try:
+                sk.observe_live(self._client.telemetry(), self.rank,
+                                fl.last_arrival_ns, fl.last_entered)
+            except Exception:  # noqa: BLE001 — diagnosis must never
+                # become the failure
+                pass
         oldest = fl.oldest()
         if oldest is None:
             self.verdict = None  # everything completed: healthy
@@ -208,10 +221,37 @@ class Watchdog:
             # the diagnosis, a hang verdict would just duplicate it
             self.verdict = None
             return None
+        # per-rank last-arrival lateness (the heartbeat "arr" wall-ns
+        # stamps), relative to the FIRST arrival into the stuck
+        # collective: a rank that entered it shows how late it
+        # entered ("rank 3 entered 40 s late"); a rank still missing
+        # shows how late it already is — now minus the first arrival,
+        # growing every sweep (everyone's stamps froze when the job
+        # blocked, so a freshest-stamp comparison would hide the
+        # stall); a rank with no stamp at all never entered anything
+        # (late_s None)
+        arrs = {r: int(p.get("arr", 0)) for r, p in peers.items()
+                if isinstance(p, dict)}
+        arrs[self.rank] = fl.last_arrival_ns
+        first_in = min((a for r, a in arrs.items()
+                        if a and entered.get(r, 0) >= seq),
+                       default=0)
+        now_ns = time.time_ns()
+        arrivals = {}
+        for r in (self._world or entered):
+            a = arrs.get(r, 0)
+            if not a or not first_in:
+                late = None
+            elif entered.get(r, 0) >= seq:
+                late = round(max(0, a - first_in) / 1e9, 3)
+            else:
+                late = round(max(0, now_ns - first_in) / 1e9, 3)
+            arrivals[r] = {"seq": entered.get(r, 0), "late_s": late}
         self.verdict = {
             "op": op, "seq": seq, "comm_cid": cid, "nbytes": nbytes,
             "waited_s": round(waited, 3), "stragglers": stragglers,
             "peer_seqs": entered, "dead": dict(dead),
+            "arrivals": arrivals,
         }
         if (seq, "hang") not in self._dumped:
             self._dumped[(seq, "hang")] = self._dump(fl)
@@ -310,6 +350,14 @@ class Watchdog:
         regs = _tune.regression_info()
         if regs is not None:
             doc["tune_regressions"] = regs
+        # a hang on a rank the live skew view already saw falling
+        # behind should say so next to the verdict (optional key,
+        # skew plane level 2)
+        from ompi_tpu import skew as _skew
+
+        sk_info = _skew.skew_info()
+        if sk_info is not None:
+            doc["skew"] = sk_info
         from ompi_tpu.trace import recorder as _trace
 
         rec = _trace.RECORDER

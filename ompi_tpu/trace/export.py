@@ -31,7 +31,7 @@ from ompi_tpu.trace import recorder as _rec
 #: gets the next free id at export time. "prof" (phase ledger) and
 #: "xfer" (host<->device copies) are the attribution-profiler tracks.
 _TIDS = {"api": 1, "coll_xla": 2, "part": 3, "pml": 4, "btl": 5,
-         "prof": 6, "xfer": 7}
+         "prof": 6, "xfer": 7, "skew": 8}
 
 
 def _xfer_counters(spans: Sequence, rank: int,
@@ -88,6 +88,42 @@ def _link_counters(rank: int, shift_ns: int) -> List[Dict[str, Any]]:
     return rows
 
 
+def _skew_rows(rank: int, shift_ns: int) -> List[Dict[str, Any]]:
+    """The "skew" lane from the skew plane's completed-collective
+    ring: one span per collective, split into "<op> wait"
+    [entry, last peer's arrival] + "<op> xfer" [arrival, exit] when
+    the Finalize merge resolved the group's last arrival — the
+    straggler tax rendered next to the span lanes."""
+    from ompi_tpu.skew import record as _skew_rec
+
+    sk = _skew_rec.SKEW
+    if sk is None:
+        return []
+    rows: List[Dict[str, Any]] = []
+    tid = _TIDS["skew"]
+    sk_shift = sk.shift_ns()
+    for seq, op, cid, nbytes, t0, t1 in sk.records():
+        arr = sk.arrivals.get((cid, seq))
+        args = {"seq": seq, "cid": cid, "nbytes": nbytes}
+        if arr is not None:
+            # merged arrival is in the SHARED timebase; back to local
+            arr_local = min(max(int(arr) - sk_shift, t0), t1)
+            rows.append({"ph": "X", "name": f"{op} wait",
+                         "cat": "skew", "pid": rank, "tid": tid,
+                         "ts": (t0 + shift_ns) / 1e3,
+                         "dur": (arr_local - t0) / 1e3, "args": args})
+            rows.append({"ph": "X", "name": f"{op} xfer",
+                         "cat": "skew", "pid": rank, "tid": tid,
+                         "ts": (arr_local + shift_ns) / 1e3,
+                         "dur": (t1 - arr_local) / 1e3, "args": args})
+        else:
+            rows.append({"ph": "X", "name": op, "cat": "skew",
+                         "pid": rank, "tid": tid,
+                         "ts": (t0 + shift_ns) / 1e3,
+                         "dur": max(t1 - t0, 0) / 1e3, "args": args})
+    return rows
+
+
 def to_chrome(rec: Optional["_rec.Recorder"] = None,
               spans: Optional[Sequence] = None) -> Dict[str, Any]:
     """Recorder (default: the live one) -> Chrome trace dict."""
@@ -122,6 +158,11 @@ def to_chrome(rec: Optional["_rec.Recorder"] = None,
         rows.append(row)
     rows.extend(_xfer_counters(spans, rank, shift_ns))
     rows.extend(_link_counters(rank, shift_ns))
+    sk_rows = _skew_rows(rank, shift_ns)
+    if sk_rows and "skew" not in named:
+        evs.append({"ph": "M", "name": "thread_name", "pid": rank,
+                    "tid": _TIDS["skew"], "args": {"name": "skew"}})
+    rows.extend(sk_rows)
     rows.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
     snap = pvar.snapshot()
     return {

@@ -19,6 +19,8 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Sequence, Union
 
+from ompi_tpu.telemetry import clock as _clock
+
 Traceish = Union[str, Dict[str, Any]]
 
 
@@ -45,9 +47,9 @@ def merge(traces: Sequence[Traceish]) -> Dict[str, Any]:
         base = md.get("clock_base_ns")
         if base0 is None:
             base0 = base
-        shift_us = 0.0
-        if base is not None and base0 is not None and base != base0:
-            shift_us = (base - base0) / 1e3
+        # rebase onto the first doc's timebase (0 when either side
+        # never synced — telemetry/clock semantics)
+        shift_us = _clock.shift_ns(base, base0) / 1e3
         pid = int(md.get("rank", i))
         while pid in used_pids:
             pid += 1

@@ -30,6 +30,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ompi_tpu.core import cvar, events, pvar
+from ompi_tpu.telemetry import clock as _clock
 
 _enable_var = cvar.register(
     "trace_enable", False, bool,
@@ -89,10 +90,12 @@ class Recorder:
         self._n = 0
         self._lock = threading.Lock()
         self.rank = rank
-        # wall-minus-monotonic at enable; sync_clock rebases exports
-        # onto rank 0's offset
-        self.clock_offset_ns = time.time_ns() - time.monotonic_ns()
+        # bracketed wall-minus-monotonic at enable (telemetry/clock);
+        # sync_clock rebases exports onto rank 0's offset
+        self.clock_offset_ns, self.clock_err_ns = \
+            _clock.sample_offset()
         self.clock_base_ns = self.clock_offset_ns
+        self.clock_base_err_ns = self.clock_err_ns
 
     def record(self, name: str, subsys: str, t0: int, t1: int,
                args: Optional[Dict[str, Any]] = None) -> Span:
@@ -238,15 +241,14 @@ def sync_clock() -> None:
     """Exchange wall-vs-monotonic offsets through the runtime store
     so every rank exports in rank 0's monotonic timebase. All ranks
     must have tracing enabled (the env/cvar knobs are job-uniform by
-    construction) — the modex read blocks until rank 0 publishes."""
+    construction) — the modex read blocks until rank 0 publishes.
+    The exchange itself is telemetry/clock.py's (shared with the
+    skew plane's "skew_clock" sync)."""
     rec = RECORDER
     if rec is None:
         return
     from ompi_tpu.runtime import rte
 
     rec.rank = rte.rank
-    rte.modex_send("trace_clock", rec.clock_offset_ns)
-    base_rank = rte.world_ranks()[0]
-    if rte.rank != base_rank:
-        rec.clock_base_ns = int(
-            rte.modex_recv("trace_clock", base_rank))
+    rec.clock_base_ns, rec.clock_base_err_ns = _clock.sync_via_store(
+        "trace_clock", rec.clock_offset_ns, rec.clock_err_ns)

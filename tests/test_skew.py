@@ -1,0 +1,444 @@
+"""skew/ plane tests: the shared clock helper (bracketed offset +
+rebase arithmetic), the bounded completed-collective ring and its
+drop accounting, the level-0 one-branch guard on the flight exit
+path, the wait/transfer decomposition oracle (including clock
+rebasing through the merge), critical-path and persistent-straggler
+verdict semantics, the level-2 live lag view, OpenMetrics labelled-
+family folding, report rendering, the watchdog hang-dump skew
+context round-trip, and a pooled 2-rank end-to-end exchange over the
+live kvstore."""
+
+import json
+import time
+
+import pytest
+
+from ompi_tpu.core import pvar
+from ompi_tpu.skew import decompose, merge, record, report
+from ompi_tpu.telemetry import clock, flight, openmetrics
+from tests.harness import run_ranks
+from tests.test_telemetry import _stuck_watchdog
+
+
+@pytest.fixture
+def no_skew():
+    """Both guards down before and after — SKEW rides FLIGHT's exit
+    path, so leaked state would perturb either plane's tests."""
+    record.disable()
+    flight.disable()
+    yield
+    record.disable()
+    flight.disable()
+
+
+# -- telemetry/clock.py (the shared timebase helper) ---------------------
+
+def test_clock_bracketed_offset_with_error_bound():
+    off, err = clock.sample_offset()
+    naive = time.time_ns() - time.monotonic_ns()
+    # same machine, same instant: the bracketed estimate must agree
+    # with the naive unpaired read to well under a second
+    assert abs(off - naive) < 1_000_000_000
+    assert 0 <= err < 1_000_000_000
+
+
+def test_clock_shift_and_pair_err_arithmetic():
+    assert clock.shift_ns(None, 5) == 0  # unsynced: stay local
+    assert clock.shift_ns(5, None) == 0
+    assert clock.shift_ns(10, 4) == 6
+    assert clock.shift_ns(4, 10) == -6
+    assert clock.pair_err_ns(3, 4) == 7  # brackets stack
+    assert clock.pair_err_ns(-3, 4) == 4  # negatives clamp
+
+
+# -- ring bounds + drop accounting ---------------------------------------
+
+def test_ring_overwrites_oldest_and_counts_drops(no_skew):
+    sk = record.SkewRecorder(rank=0, nranks=1, capacity=4)
+    s = pvar.session()
+    for seq in range(1, 7):
+        sk.complete(seq, "allreduce_dev", 3, 64, 1.0 + seq, 2.0 + seq)
+    recs = sk.records()
+    assert [r[0] for r in recs] == [3, 4, 5, 6]  # chronological
+    assert recs[0][1] == "allreduce_dev" and recs[0][2] == 3
+    assert recs[0][3] == 64 and recs[0][5] > recs[0][4]
+    assert s.read("skew_records") == 6
+    assert s.read("skew_dropped") == 2
+    assert pvar.read("skew_ring_depth") >= 4  # watermark at capacity
+
+
+def test_ring_capacity_floor_and_enable_idempotent(no_skew):
+    assert record.SkewRecorder(capacity=0).capacity == 1
+    sk = record.enable(rank=1, nranks=4, level=1, capacity=8)
+    again = record.enable(rank=1, nranks=4, level=2)
+    assert again is sk  # idempotent, level only ever rises
+    assert sk.level == 2
+    assert record.disable() is sk and record.SKEW is None
+
+
+# -- level-0: flight exit pays only the guard ----------------------------
+
+def test_level0_flight_exit_skips_skew(monkeypatch, no_skew):
+    """While SKEW is down (the default), the flight exit path must
+    not construct or touch a skew recorder — the one-branch guard
+    contract (same shape as the FLIGHT/RECORDER guard tests)."""
+    assert record.SKEW is None
+
+    def boom(*a, **k):
+        raise AssertionError("skew recorder touched while disabled")
+
+    monkeypatch.setattr(record.SkewRecorder, "complete", boom)
+    fl = flight.FlightRecorder()
+    fl.exit(fl.enter("allreduce_dev", comm_cid=3, nbytes=256))
+    assert fl.last_completed == 1  # the path really ran
+
+
+def test_flight_exit_feeds_ring_when_enabled(no_skew):
+    sk = record.enable(rank=0, nranks=1, level=1, capacity=16)
+    fl = flight.FlightRecorder()
+    fl.exit(fl.enter("allreduce_dev", comm_cid=7, nbytes=1024))
+    fl.exit(fl.enter("bcast_dev", comm_cid=7))
+    recs = sk.records()
+    assert [(r[0], r[1], r[2]) for r in recs] == \
+        [(1, "allreduce_dev", 7), (2, "bcast_dev", 7)]
+    assert recs[0][3] == 1024
+    assert recs[0][5] >= recs[0][4] > 0  # exit after enter, both ns
+
+
+# -- decomposition oracle ------------------------------------------------
+
+def _oracle_per_rank():
+    """Two ranks, two allreduces, shared timebase, hand-checkable:
+    rank 1 arrives 2000 ns late into seq 1; rank 0 arrives 1000 ns
+    late into seq 2 after sitting outside collectives since t=5000
+    (so its lateness is compute-side)."""
+    def rec(seq, t0, t1):
+        return {"seq": seq, "op": "allreduce_dev", "cid": 1,
+                "nbytes": 64, "t0": t0, "t1": t1}
+
+    return {
+        0: [rec(1, 1000, 5000), rec(2, 9000, 12000)],
+        1: [rec(1, 3000, 5500), rec(2, 8000, 12500)],
+    }
+
+
+def test_decompose_oracle_wait_plus_transfer_is_wall():
+    groups = decompose.groups_of(_oracle_per_rank())
+    assert len(groups) == 2
+    g1, g2 = groups
+
+    assert (g1["last_rank"], g1["last_arrival_ns"]) == (1, 3000)
+    assert g1["arrival_skew_ns"] == 2000
+    assert g1["cause"] == "unknown"  # no previous exit to compare
+    assert g1["ranks"][0] == {"wall_ns": 4000, "wait_ns": 2000,
+                              "transfer_ns": 2000}
+    assert g1["ranks"][1] == {"wall_ns": 2500, "wait_ns": 0,
+                              "transfer_ns": 2500}
+
+    assert (g2["last_rank"], g2["arrival_skew_ns"]) == (0, 1000)
+    # rank 0 left seq 1 at 5000 and showed up at 9000: a 4000 ns gap
+    # outside collectives >= its 1000 ns lateness -> compute
+    assert g2["cause"] == "compute"
+    assert g2["ranks"][0] == {"wall_ns": 3000, "wait_ns": 0,
+                              "transfer_ns": 3000}
+    assert g2["ranks"][1] == {"wall_ns": 4500, "wait_ns": 1000,
+                              "transfer_ns": 3500}
+
+    for g in groups:  # the identity every report figure rests on
+        for cell in g["ranks"].values():
+            assert cell["wall_ns"] == \
+                cell["wait_ns"] + cell["transfer_ns"]
+    assert decompose.exposed_wait(groups) == {0: 2000, 1: 1000}
+
+
+def test_decompose_comm_cause_when_dragged_upstream():
+    """A straggler that left its previous collective just before
+    arriving late was dragged by communication, not compute."""
+    def rec(seq, t0, t1):
+        return {"seq": seq, "op": "allreduce_dev", "cid": 1,
+                "nbytes": 64, "t0": t0, "t1": t1}
+
+    per_rank = {0: [rec(1, 0, 100), rec(2, 150, 400)],
+                1: [rec(1, 0, 280), rec(2, 300, 400)]}
+    g2 = decompose.groups_of(per_rank)[1]
+    assert (g2["last_rank"], g2["arrival_skew_ns"]) == (1, 150)
+    # rank 1 exited seq 1 at 280 and arrived at 300: only 20 ns of
+    # its own time vs 150 ns of lateness -> comm
+    assert g2["cause"] == "comm"
+
+
+def test_decompose_skips_singleton_groups():
+    per_rank = {0: [{"seq": 1, "op": "bcast_dev", "cid": 9,
+                     "nbytes": 8, "t0": 0, "t1": 10}],
+                1: []}
+    assert decompose.groups_of(per_rank) == []
+
+
+def test_analyze_doc_shape_and_per_op_table():
+    ana = decompose.analyze(_oracle_per_rank(), clock_err_ns=35)
+    assert ana["schema"] == "ompi_tpu.skew/1+analysis"
+    assert (ana["nranks"], ana["collectives"]) == (2, 2)
+    assert ana["clock_err_ns"] == 35
+    assert ana["exposed_wait_ns"] == {"0": 2000, "1": 1000}
+    (row,) = ana["per_op"]
+    assert row["op"] == "allreduce_dev" and row["n"] == 2
+    assert row["mean_skew_ns"] == 1500 and row["max_skew_ns"] == 2000
+    assert row["wait_ns"] == 3000
+    assert [h["rank"] for h in ana["critical_path"]] == [1, 0]
+    # each rank last once = 50% -> both clear the default 50% bar
+    assert {v["rank"] for v in ana["stragglers"]} == {0, 1}
+
+
+# -- merge: timebase rebase + schema gate --------------------------------
+
+def test_merge_rebases_rings_into_one_timebase():
+    """Two docs in different local clocks must decompose identically
+    to the pre-rebased oracle once merged."""
+    oracle = _oracle_per_rank()
+    shift1 = 4000  # rank 1's monotonic clock started 4000 ns later
+
+    def doc(rank, offset, base, err, base_err, recs):
+        return {"schema": merge.SCHEMA, "rank": rank, "nranks": 2,
+                "level": 1, "clock_offset_ns": offset,
+                "clock_err_ns": err, "clock_base_ns": base,
+                "clock_base_err_ns": base_err, "records": recs}
+
+    d0 = doc(0, 1000, 1000, 10, 0, oracle[0])  # base rank: shift 0
+    d1 = doc(1, 1000 + shift1, 1000, 20, 5,
+             [dict(r, t0=r["t0"] - shift1, t1=r["t1"] - shift1)
+              for r in oracle[1]])
+    merged = merge.merge([d0, d1])
+    assert merged["schema"] == merge.SCHEMA + "+merged"
+    assert merged["nranks"] == 2 and merged["level"] == 1
+    assert merged["clock_err_ns"] == 35  # (20+5) + 10, worst pair
+    assert merged["records"][1] == oracle[1]  # rebased back exactly
+    ana = decompose.analyze(merged["records"],
+                            clock_err_ns=merged["clock_err_ns"])
+    assert ana["exposed_wait_ns"] == {"0": 2000, "1": 1000}
+
+
+def test_merge_rejects_wrong_schema():
+    with pytest.raises(ValueError, match="not a skew ring dump"):
+        merge.merge([{"schema": "ompi_tpu.trace/1", "rank": 0}])
+
+
+def test_snapshot_doc_json_roundtrip(no_skew):
+    sk = record.enable(rank=2, nranks=4, level=1, capacity=8)
+    sk.clock_offset_ns, sk.clock_err_ns = 500, 7
+    sk.clock_base_ns, sk.clock_base_err_ns = 100, 3
+    sk.complete(1, "barrier", 0, 0, 1.0, 1.5)
+    doc = json.loads(json.dumps(merge.snapshot_doc(sk)))
+    assert doc["schema"] == merge.SCHEMA and doc["rank"] == 2
+    merged = merge.merge([doc])
+    (rec,) = merged["records"][2]
+    assert rec["t0"] == 1_000_000_000 + 400  # + shift(500, 100)
+    assert merged["clock_err_ns"] == 10  # single doc: its own stack
+
+
+# -- critical path + verdict ---------------------------------------------
+
+def test_critical_path_three_ranks_names_the_rotor():
+    """Rank 2 always shows up last: the critical path runs through
+    it on every hop and the verdict names it at 100% share."""
+    per_rank = {}
+    for r in range(3):
+        recs = []
+        for seq in (1, 2, 3):
+            t0 = 1000 * seq + (500 if r == 2 else r * 10)
+            recs.append({"seq": seq, "op": "allreduce_dev", "cid": 1,
+                         "nbytes": 32, "t0": t0, "t1": t0 + 100})
+        per_rank[r] = recs
+    groups = decompose.groups_of(per_rank)
+    path = decompose.critical_path(groups)
+    assert [h["rank"] for h in path] == [2, 2, 2]
+    assert [h["seq"] for h in path] == [1, 2, 3]
+    # seq 1 has no previous exit; later hops: rank 2 sat outside
+    # collectives for ~900 ns vs ~500 ns lateness -> compute
+    assert [h["cause"] for h in path] == \
+        ["unknown", "compute", "compute"]
+    (v,) = decompose.verdict(groups)
+    assert (v["rank"], v["share_pct"], v["of"]) == (2, 100.0, 3)
+    assert v["cause"] == "compute"
+    assert v["arrival_skew_ns"] == sum(g["arrival_skew_ns"]
+                                       for g in groups)
+
+
+def _synthetic_groups():
+    """5 groups: rank 2 last into 3 (60%), rank 0 into the final 2."""
+    out = []
+    for seq, (last, cause, skew) in enumerate(
+            [(2, "compute", 100), (2, "comm", 50), (2, "compute", 80),
+             (0, "compute", 10), (0, "compute", 20)], start=1):
+        out.append({"cid": 1, "seq": seq, "op": "allreduce_dev",
+                    "nbytes": 0, "last_rank": last,
+                    "last_arrival_ns": 0, "arrival_skew_ns": skew,
+                    "cause": cause, "ranks": {}})
+    return out
+
+
+def test_verdict_threshold_edges_and_window():
+    groups = _synthetic_groups()
+    (v,) = decompose.verdict(groups)  # default bar: 50%
+    assert (v["rank"], v["last"], v["of"]) == (2, 3, 5)
+    assert v["share_pct"] == 60.0
+    assert v["cause"] == "compute"  # majority of its 3 causes
+    assert v["arrival_skew_ns"] == 230
+    # the bar is inclusive: exactly 60% still names; just above: no
+    assert decompose.verdict(groups, pct=60.0)[0]["rank"] == 2
+    assert decompose.verdict(groups, pct=60.1) == []
+    # lower bar: both ranks named, worst (most-often-last) first
+    assert [v["rank"] for v in decompose.verdict(groups, pct=40)] \
+        == [2, 0]
+    # window trims to the most recent N groups (rank 0's run)
+    (w,) = decompose.verdict(groups, win=2)
+    assert (w["rank"], w["share_pct"], w["of"]) == (0, 100.0, 2)
+    assert decompose.verdict([], pct=1) == []
+
+
+# -- pvar fold-in + OpenMetrics labelled family --------------------------
+
+def test_record_pvars_folds_own_rank_view(no_skew):
+    ana = decompose.analyze(_oracle_per_rank(), clock_err_ns=35)
+    s = pvar.session()
+    decompose.record_pvars(ana, rank=0)
+    assert s.read("skew_exposed_wait_ns") == 2000
+    assert s.read("skew_op_wait_ns_allreduce_dev") == 3000
+    assert pvar.read("skew_arrival_skew_ns") >= 2000  # hwm
+    assert s.read("skew_stragglers") == 2
+
+
+def test_openmetrics_skew_op_family(no_skew):
+    text = openmetrics.render(
+        {"skew_op_wait_ns_allreduce_dev": 123,
+         "skew_exposed_wait_ns": 5}, {"rank": "0"})
+    assert ('ompi_tpu_skew_op_wait_ns_total'
+            '{op="allreduce_dev",rank="0"} 123') in text
+    assert 'ompi_tpu_skew_exposed_wait_ns_total{rank="0"} 5' in text
+    parsed = openmetrics.parse(text)
+    assert sum(parsed["skew_op_wait_ns"].values()) == 123
+
+
+# -- level-2 live lag view -----------------------------------------------
+
+def test_observe_live_names_the_laggard(no_skew):
+    sk = record.SkewRecorder(rank=0, nranks=3, level=2)
+    now = time.time_ns()
+    worst = sk.observe_live(
+        {1: {"seq": 5, "arr": now - 2_000_000_000},
+         2: {"seq": 9, "arr": now},
+         3: "not-a-dict"},  # pre-telemetry peers are 2-tuples
+        my_rank=0, my_arr_ns=now - 500_000_000, my_seq=7)
+    assert worst == {"rank": 1, "seq": 5, "behind_s": 2.0}
+    assert sk.live_worst == worst
+    assert pvar.read("skew_live_lag_ns") >= 2_000_000_000  # hwm
+
+
+def test_observe_live_needs_two_arrivals(no_skew):
+    sk = record.SkewRecorder(rank=0, nranks=2, level=2)
+    assert sk.observe_live({}, my_rank=0, my_arr_ns=0, my_seq=0) \
+        is None
+    assert sk.observe_live({1: {"seq": 1, "arr": 0}}, 0, 5, 1) is None
+    assert sk.live_worst is None
+
+
+def test_skew_info_for_hang_dumps(no_skew):
+    from ompi_tpu import skew
+
+    assert skew.skew_info() is None  # plane down: dump stays lean
+    sk = record.enable(rank=0, nranks=2, level=2, capacity=8)
+    sk.complete(1, "allreduce_dev", 1, 64, 1.0, 2.0)
+    sk.live_worst = {"rank": 1, "seq": 4, "behind_s": 3.1}
+    info = skew.skew_info()
+    assert info["level"] == 2 and info["records"] >= 1
+    assert info["live_worst"]["rank"] == 1
+
+
+# -- report rendering ----------------------------------------------------
+
+def test_report_verdict_line_format():
+    line = report.verdict_line(
+        {"rank": 3, "last": 5, "of": 6, "share_pct": 83.3,
+         "cause": "compute", "arrival_skew_ns": 3_600_000_000})
+    assert line == ("PERSISTENT STRAGGLER: rank 3 last into 83% of "
+                    "6 collectives (compute, +3600.000 ms skew)")
+
+
+def test_report_render_sections():
+    ana = decompose.analyze(_oracle_per_rank(), clock_err_ns=35)
+    text = report.render(ana)
+    assert "2 collectives across 2 ranks" in text
+    assert "timestamp error bar" in text
+    assert "exposed wait by rank" in text
+    assert "critical path" in text
+    assert "PERSISTENT STRAGGLER" in text
+    quiet = decompose.analyze(_oracle_per_rank(), pct=99.0)
+    assert "no persistent straggler" in report.render(quiet)
+
+
+# -- watchdog hang-dump skew context round-trip --------------------------
+
+def test_watchdog_dump_carries_skew_context(tmp_path, no_skew):
+    """At level 2 a hang dump must say what the live view knew: the
+    plane's level/ring counts plus the rank already seen falling
+    behind — round-tripped through the JSON file."""
+    sk = record.enable(rank=0, nranks=2, level=2, capacity=8)
+    wd, fl, client = _stuck_watchdog(tmp_path, peers={}, dead={})
+    client.peers[1] = {"seq": 1, "done": 1, "inflight": 0,
+                       "arr": fl.last_arrival_ns - 3_000_000_000}
+    wd.sweep()
+    assert sk.live_worst is not None and sk.live_worst["rank"] == 1
+    assert 2.9 <= sk.live_worst["behind_s"] <= 3.1
+    dumps = sorted(tmp_path.glob("ompi_tpu_hang_rank*.json"))
+    assert dumps, "stuck sweep must dump"
+    doc = json.loads(dumps[0].read_text())
+    assert doc["skew"]["level"] == 2
+    assert doc["skew"]["live_worst"]["rank"] == 1
+    # rank 1 is missing from the stuck collective: named, with a
+    # (just-started) growing lateness next to the live-lag context
+    assert doc["verdict"]["arrivals"]["1"]["late_s"] >= 0.0
+
+
+# -- end to end: pooled 2-rank exchange over the live kvstore ------------
+
+def test_two_rank_exchange_and_decomposition():
+    """skew_level=1 raises the plane at init; real collectives fill
+    both rings; the kvstore exchange merges them and rank 0's
+    decomposition satisfies the wall = wait + transfer identity
+    within the stated error bar."""
+    run_ranks("""
+        from ompi_tpu.runtime import rte
+        from ompi_tpu.skew import decompose, merge, record
+        sk = record.SKEW
+        assert sk is not None and sk.level >= 1, "plane not raised"
+        start_n = len(sk.records())
+        buf = np.ones(1024, np.float32)
+        out = np.empty_like(buf)
+        for _ in range(4):
+            comm.Allreduce(buf, out)
+            comm.Barrier()
+        assert out[0] == size
+        assert len(sk.records()) >= start_n + 8
+        merged = merge.exchange(sk, rte.client(),
+                                "skewtest-" + rte.jobid, size,
+                                timeout=30)
+        if rank != 0:
+            assert merged is None
+        else:
+            assert merged["schema"] == merge.SCHEMA + "+merged"
+            assert merged["nranks"] == 2
+            ana = decompose.analyze(
+                merged["records"],
+                clock_err_ns=merged["clock_err_ns"])
+            assert ana["collectives"] >= 6, ana["collectives"]
+            slack = int(merged["clock_err_ns"]) + 5_000_000
+            for g in ana["groups"]:
+                assert set(g["ranks"]) == {0, 1}
+                for cell in g["ranks"].values():
+                    assert cell["wall_ns"] >= 0
+                    assert cell["wait_ns"] >= 0
+                    gap = abs(cell["wall_ns"] - (cell["wait_ns"]
+                              + cell["transfer_ns"]))
+                    assert gap <= slack, (cell, slack)
+            assert len(ana["critical_path"]) == ana["collectives"]
+        comm.Barrier()
+    """, 2, mca={"skew_level": "1"}, timeout=180)

@@ -41,7 +41,9 @@ def test_flight_enter_exit_seq_semantics(no_flight):
     assert oldest[2] == 7 and oldest[3] == 1024
     snap = fl.snapshot()
     assert [e["seq"] for e in snap] == [1, 2]
-    assert fl.hb_dict() == {"seq": 2, "done": 0, "inflight": 2}
+    hb = fl.hb_dict()
+    assert (hb["seq"], hb["done"], hb["inflight"]) == (2, 0, 2)
+    assert hb["arr"] > 0  # wall-ns stamp of the latest arrival
     fl.exit(t2)
     fl.exit(t1)  # out-of-order completion keeps the high-water done
     assert fl.last_completed == 2
@@ -84,7 +86,9 @@ def test_hb_payload_none_while_disabled(no_flight):
     telemetry is off — hb_payload is the gate."""
     assert flight.hb_payload() is None
     flight.enable(rank=1, api_hook=False)
-    assert flight.hb_payload() == {"seq": 0, "done": 0, "inflight": 0}
+    hb = flight.hb_payload()
+    assert (hb["seq"], hb["done"], hb["inflight"]) == (0, 0, 0)
+    assert hb["arr"] == 0  # no collective arrived yet
 
 
 def test_disabled_guard_constructs_nothing(monkeypatch, no_flight):
@@ -359,7 +363,8 @@ def test_watchdog_names_straggler_and_dumps(tmp_path, no_flight):
     assert v["op"] == "allreduce_dev" and v["seq"] == 2
     assert v["peer_seqs"] == {0: 2, 1: 1}
     # every sweep publishes this rank's seq on the heartbeat plane
-    assert client.beats == [(0, {"seq": 2, "done": 1, "inflight": 1})]
+    assert [(r, p["seq"], p["done"], p["inflight"])
+            for r, p in client.beats] == [(0, 2, 1, 1)]
     path = wd._dumped[(2, "hang")]
     doc = json.load(open(path))
     assert doc["schema"] == watchdog.DUMP_SCHEMA
@@ -372,6 +377,35 @@ def test_watchdog_names_straggler_and_dumps(tmp_path, no_flight):
     # the op completing clears the verdict
     fl.exit(2)
     assert wd.sweep() is None and wd.verdict is None
+
+
+def test_watchdog_verdict_arrival_lateness(tmp_path, no_flight):
+    """Satellite contract: the hang verdict carries per-rank
+    last-arrival lateness from the heartbeat "arr" stamps, relative
+    to the first arrival into the stuck collective — distinguishing
+    "entered 40 s late", "still missing and counting", and "never
+    entered anything" (late_s None)."""
+    wd, fl, client = _stuck_watchdog(tmp_path, peers={}, dead={},
+                                     world=range(4))
+    fl.last_arrival_ns -= 40_000_000_000  # rank 0 entered 40 s ago
+    client.peers[1] = {"seq": 2, "done": 1, "inflight": 1,
+                       "arr": fl.last_arrival_ns + 40_000_000_000}
+    client.peers[3] = {"seq": 1, "done": 1, "inflight": 0,
+                       "arr": fl.last_arrival_ns + 1_000_000_000}
+    v = wd.sweep()
+    assert sorted(v["stragglers"]) == [2, 3]
+    arr = v["arrivals"]
+    assert arr[0]["seq"] == 2 and arr[0]["late_s"] == 0.0
+    assert arr[1]["seq"] == 2  # entered the stuck seq 40 s late
+    assert 39.0 <= arr[1]["late_s"] <= 41.0
+    assert arr[2]["seq"] == 0 and arr[2]["late_s"] is None
+    assert arr[3]["seq"] == 1  # missing from seq 2, lateness grows
+    assert arr[3]["late_s"] >= 39.0
+    doc = json.load(open(wd._dumped[(2, "hang")]))
+    dumped = doc["verdict"]["arrivals"]
+    assert dumped["1"]["late_s"] >= 39.0
+    assert dumped["2"]["late_s"] is None
+    assert dumped["3"]["late_s"] >= 39.0
 
 
 def test_watchdog_healthy_below_timeout(tmp_path, no_flight):
