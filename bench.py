@@ -35,7 +35,7 @@ import time
 _T0 = time.time()
 
 if ("--pallas" in sys.argv or "--hier" in sys.argv
-        or "--serve" in sys.argv) \
+        or "--serve" in sys.argv or "--osc" in sys.argv) \
         and "xla_force_host_platform_device_count" \
         not in os.environ.get("XLA_FLAGS", ""):
     # the pallas switchpoint card races algorithms across >= 2
@@ -1027,6 +1027,97 @@ def _bench_pallas():
     }
 
 
+def _bench_osc():
+    """osc/pallas RMA card (``--osc``): the one-sided window's two
+    cost centers measured separately — the target-side apply kernels
+    (contiguous put, accumulate folds, element-strided halo columns)
+    per payload size, and one colored fence round (payload hop +
+    target apply) over a 4-way mesh, the unit the halo-exchange step
+    is built from. On a CPU host the kernels run interpret-mode and
+    the hop is a ppermute — schedule/dispatch cost, not ICI DMA
+    bandwidth; the remote-DMA numbers need a real TPU round (the
+    ROADMAP debt this card exists to collect)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ompi_tpu.osc import pallas_kernels as OK
+    from ompi_tpu.util import jaxcompat as jc
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        raise RuntimeError(
+            "osc bench needs >= 4 devices (bench.py forces 4 host "
+            "devices when --osc is passed before jax initializes)")
+    devs = devs[:4]
+    n = len(devs)
+    interp = devs[0].platform != "tpu"
+    reps = 5 if interp else 50
+
+    def timed(fn, *a):
+        out = fn(*a)
+        jax.block_until_ready(out)  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*a)
+        jax.block_until_ready(out)
+        return out, (time.perf_counter() - t0) / reps
+
+    rows = []
+    apply_64k_us = acc_GBs = None
+    for nbytes in (1 << 12, 1 << 16, 1 << 20):
+        size = nbytes // 4
+        k = max(size // 4, 1)
+        win = jnp.arange(size, dtype=jnp.float32)
+        pay = jnp.ones(k, jnp.float32)
+        row = {"window_bytes": nbytes, "payload_bytes": k * 4}
+        _, t = timed(lambda w, p: OK.apply(w, p, k, "put",
+                                           interpret=interp), win, pay)
+        row["put_us"] = round(t * 1e6, 2)
+        _, t = timed(lambda w, p: OK.apply(w, p, k, "sum",
+                                           interpret=interp), win, pay)
+        row["acc_us"] = round(t * 1e6, 2)
+        row["acc_GBs"] = round(k * 4 / max(t, 1e-12) / 1e9, 3)
+        _, t = timed(lambda w, p: OK.apply(w, p, 1, "sum", stride=4,
+                                           interpret=interp), win, pay)
+        row["strided_us"] = round(t * 1e6, 2)
+        _, t = timed(lambda w: OK.read(w, 0, k, interpret=interp), win)
+        row["read_us"] = round(t * 1e6, 2)
+        rows.append(row)
+        if nbytes == 1 << 16:
+            apply_64k_us = row["acc_us"]
+            acc_GBs = row["acc_GBs"]
+
+    # one colored fence round over the mesh: every rank passes its
+    # halo payload one hop and folds the received one into its window
+    mesh = Mesh(np.array(devs), ("rk",))
+    halo = 1 << 12  # elements per halo column
+    perm = [(r, (r + 1) % n) for r in range(n)]
+
+    def round_fn(w, p):
+        from jax import lax
+        recvd = lax.ppermute(p[0], "rk", perm=perm)
+        return OK.apply(w[0], recvd, 0, "sum", interpret=interp)
+
+    fn = jax.jit(jc.shard_map(round_fn, mesh=mesh,
+                              in_specs=(P("rk"), P("rk")),
+                              out_specs=P("rk"), check_vma=False))
+    wins = jax.device_put(
+        np.zeros((n, halo * 2), np.float32), NamedSharding(mesh, P("rk")))
+    pays = jax.device_put(
+        np.ones((n, halo), np.float32), NamedSharding(mesh, P("rk")))
+    _, t = timed(fn, wins, pays)
+    return {
+        "mesh": [n],
+        "interpret": interp,
+        "table": rows,
+        "apply_64k_us": apply_64k_us,
+        "acc_bandwidth_GBs": acc_GBs,
+        "halo_round_ms": round(t * 1e3, 3),
+    }
+
+
 def _bench_hier():
     """coll/hier switchpoint card (``--hier``): the two-level ICI x
     DCN allreduce raced against the flat lowering per payload size on
@@ -1379,6 +1470,9 @@ _EXTRA_BASELINE_KEYS = (
     ("tune", "level1_sample_ns", False),
     ("skew", "level0_guard_ns", False),
     ("skew", "level1_record_ns", False),
+    ("osc", "apply_64k_us", False),
+    ("osc", "acc_bandwidth_GBs", True),
+    ("osc", "halo_round_ms", False),
 )
 
 
@@ -1555,6 +1649,13 @@ def main() -> None:
             _phase("skew microbench done")
         except Exception as e:
             _phase(f"skew microbench skipped: {e!r}")
+    osc = None
+    if "--osc" in sys.argv:
+        try:
+            osc = _bench_osc()
+            _phase("osc microbench done")
+        except Exception as e:
+            _phase(f"osc microbench skipped: {e!r}")
     if trace_path is not None:
         from ompi_tpu.trace import export as trace_export
         from ompi_tpu.trace import recorder as trace_rec
@@ -1599,7 +1700,8 @@ def main() -> None:
                                    "hier": hier,
                                    "serve": serve,
                                    "tune": tune,
-                                   "skew": skew})
+                                   "skew": skew,
+                                   "osc": osc})
         except Exception:
             pass
 
@@ -1650,6 +1752,7 @@ def main() -> None:
             "serve": serve,
             "tune": tune,
             "skew": skew,
+            "osc": osc,
             "device": f"{dev.platform}:{kind}",
             "wall_s": round(time.time() - t_start, 1),
             # wall attribution from the prof-plane phase ledger

@@ -41,6 +41,7 @@ from ompi_tpu.check.lint.rules.conventions import (
     rule_bare_public_raise, rule_unguarded_observability,
     rule_unregistered_pvar,
 )
+from ompi_tpu.check.lint.rules.osc import rule_osc_unclosed_epoch
 from ompi_tpu.check.lint.rules.requests import (
     rule_buffer_reuse_before_wait, rule_handle_leak,
     rule_pready_outside_start, rule_unwaited_request,
@@ -86,6 +87,12 @@ CATALOG: Dict[str, str] = {
         "pvar.WELL_KNOWN — tools/info and the OpenMetrics sampler "
         "will not export it at 0 (dynamic f-string families are "
         "exempt)",
+    "osc-unclosed-epoch":
+        "an RMA epoch opener (Lock/Lock_all/Start/Post) on a window "
+        "created in the same scope with no matching closer "
+        "(Unlock/Unlock_all/Complete/Wait) on that window later in "
+        "the scope — the epoch never ends, so peers hang in the sync "
+        "handshake and the window cannot Free",
     "unguarded-observability":
         "direct call through an observability guard global (FLIGHT/"
         "RECORDER/SANITIZER/TRAFFIC/INGEST) with no enclosing None "
@@ -105,6 +112,7 @@ RULES = (
     rule_collective_order_divergence,
     rule_buffer_reuse_before_wait,
     rule_handle_leak,
+    rule_osc_unclosed_epoch,
     rule_bare_public_raise,
     rule_unregistered_pvar,
     rule_unguarded_observability,

@@ -223,7 +223,11 @@ WELL_KNOWN = (
     "neighbor_allgather", "neighbor_allgatherv", "neighbor_alltoall",
     "neighbor_alltoallv",
     "osc_put", "osc_get", "osc_acc", "osc_fence",
-    "osc_device_epoch_op",
+    "osc_device_epoch_op", "osc_device_fallbacks",
+    "osc_pallas_windows", "osc_pallas_put", "osc_pallas_get",
+    "osc_pallas_acc", "osc_pallas_get_acc", "osc_pallas_fence",
+    "osc_pallas_rounds", "osc_pallas_bytes", "osc_pallas_am_ops",
+    "osc_pallas_fallthrough",
     "rcache_hits", "rcache_evictions",
     "rndv_frag", "rndv_sc",
     "shmem_alloc_bytes", "shmem_put", "shmem_get", "shmem_atomic",

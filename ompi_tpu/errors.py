@@ -28,6 +28,8 @@ ERR_OTHER = 16
 ERR_INTERN = 17
 ERR_PENDING = 18
 ERR_IN_STATUS = 19
+ERR_RMA_CONFLICT = 43
+ERR_RMA_SYNC = 44
 ERR_WIN = 45
 ERR_FILE = 27
 ERR_NO_MEM = 34

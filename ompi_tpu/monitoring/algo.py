@@ -86,6 +86,23 @@ def pallas_per_peer(op: str, algorithm: str, rank: int, n: int,
     return {nxt: total}
 
 
+def rma_per_peer(rank: int, edges, itemsize: int) -> Dict[int, float]:
+    """Bytes `rank` SENDS per peer for one osc/pallas fence flush.
+
+    ``edges`` are (sender, receiver, nelems) wire descriptors over
+    comm-local ranks — puts flow origin->target, gets target->origin,
+    so the caller hands BOTH directions pre-oriented. Only this
+    rank's outgoing edges count (send-side accounting, like every
+    model here), self-edges never touch a link, and the result feeds
+    ``TrafficMatrix.count`` so level-2 ICI link attribution walks the
+    CartTopo routes for RMA exactly as it does for collectives."""
+    out: Dict[int, float] = {}
+    for s, d, n in edges:
+        if s == rank and d != rank:
+            out[d] = out.get(d, 0.0) + float(n) * float(itemsize)
+    return out
+
+
 def hier_level_bytes(op: str, n_dcn: int, n_ici: int,
                      nbytes: int, linear: bool = False):
     """(ici_bytes, dcn_bytes) one rank moves for a coll/hier launch —

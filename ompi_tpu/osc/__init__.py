@@ -625,7 +625,6 @@ class Window(AttrHost):
 
         if target == self.rank:
             return
-        self._flush_acked = getattr(self, "_flush_acked", set())
         self._flush_acked.discard(target)
         self._send(target, ("flush_req",))
         progress.wait_until(lambda: target in self._flush_acked)
@@ -825,7 +824,16 @@ class SharedWindow(Window):
 
 def win_create(comm, base: np.ndarray, disp_unit: int = 1,
                info=None) -> Window:
-    """MPI_Win_create."""
+    """MPI_Win_create. Staged backend selection: the device-resident
+    osc/pallas window serves supported jax-array buffers when enabled
+    (``--mca osc_pallas on``); everything else — including every
+    fallthrough case the pallas selection rejects — gets the host AM
+    window below."""
+    from ompi_tpu.osc import pallas as _pallas
+
+    win = _pallas.maybe_window(comm, base, disp_unit, info=info)
+    if win is not None:
+        return win
     return Window(comm, base, disp_unit, info=info)
 
 
@@ -854,4 +862,10 @@ def win_allocate(comm, shape, dtype=np.uint8,
 # stays on the Window AM path above)
 from ompi_tpu.osc.device_epoch import (  # noqa: E402,F401
     DeviceEpochWindow, win_create_device,
+)
+# device-resident one-sided plane (kernel-applied RMA + DMA fence
+# rounds); imported at the bottom so its cvars register whenever osc
+# loads — MCA env flags are read at registration time
+from ompi_tpu.osc.pallas import (  # noqa: E402,F401
+    PallasWindow, win_create_pallas,
 )

@@ -33,7 +33,32 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ompi_tpu import errors
-from ompi_tpu.core import pvar
+from ompi_tpu.core import events as mpit_events, output, pvar
+
+_out = output.stream("osc_device")
+
+_FALLBACK_EVENT = mpit_events.register_type(
+    "osc_device_fallback",
+    "a device-epoch window routed an operation to the host path "
+    "(non-elementwise accumulate, passive target)",
+    ("op", "reason"))
+
+_warned: set = set()
+
+
+def _fallback(op: str, reason: str) -> None:
+    """The device-epoch window cannot serve ``op``; the host Window
+    (or osc/pallas) path must. Loud exactly once per (op, reason) —
+    the tune.observe.table_error pattern: a silent reroute is a
+    silent perf cliff — and counted every time."""
+    pvar.record("osc_device_fallbacks")
+    key = (op, reason)
+    if key not in _warned:
+        _warned.add(key)
+        _out.verbose(0, "WARNING: device-epoch window %s falls back "
+                     "to the host path: %s", op, reason)
+    if mpit_events.active("osc_device_fallback"):
+        mpit_events.emit("osc_device_fallback", op=op, reason=reason)
 
 
 class GetHandle:
@@ -108,6 +133,9 @@ class DeviceEpochWindow:
         # fusable = exactly what the fence program can apply as one
         # scatter-update (_APPLY keys; "put" is Put's own marker)
         if kind == "put" or kind not in self._APPLY:
+            _fallback("accumulate",
+                      f"op {name!r} is not fusable into the fence "
+                      "program")
             raise errors.MPIError(
                 errors.ERR_OP,
                 f"device-epoch accumulate op {name!r} not fusable; "
@@ -139,6 +167,34 @@ class DeviceEpochWindow:
     def Free(self) -> None:
         self.comm.coll.barrier(self.comm)
         self.comm.free()  # release the dup'd comm (+ its ctx cache)
+
+    # -- passive target: not expressible as a compiled fence program
+    # (every rank must enter an SPMD program; a lone origin cannot).
+    # Loudly routed instead of silently absent, so callers holding a
+    # DeviceEpochWindow learn WHERE the capability lives.
+    def _no_passive(self, op: str):
+        _fallback(op, "passive target needs the host Window AM path "
+                  "or an osc/pallas window")
+        return errors.MPIError(
+            errors.ERR_RMA_SYNC,
+            f"device-epoch windows are fence-only; {op} needs a host "
+            "Window (osc.win_create) or a PallasWindow "
+            "(--mca osc_pallas on)")
+
+    def Lock(self, target: int, lock_type: str = "exclusive"):
+        raise self._no_passive("Lock")
+
+    def Unlock(self, target: int):
+        raise self._no_passive("Unlock")
+
+    def Flush(self, target: int):
+        raise self._no_passive("Flush")
+
+    def Post(self, group_ranks):
+        raise self._no_passive("Post")
+
+    def Start(self, group_ranks):
+        raise self._no_passive("Start")
 
     # -- the compiled flush ----------------------------------------------
     def _flush(self) -> None:

@@ -148,6 +148,17 @@ def np_dtype(name: str):
     return np.dtype(name)
 
 
+def pallas_device_id_type(pltpu):
+    """The mesh-logical ``DeviceIdType`` member for
+    ``make_async_remote_copy``/``semaphore_signal`` across jax
+    versions: newer releases spell the mesh-coordinate addressing mode
+    ``MESH``, older ones only have ``LOGICAL`` (same semantics inside
+    ``shard_map``). osc/pallas_kernels and any future DMA kernel go
+    through here instead of version-checking at the call site."""
+    dt = pltpu.DeviceIdType
+    return getattr(dt, "MESH", None) or dt.LOGICAL
+
+
 def pallas_remote_dma_ok() -> bool:
     """Whether this jax build can *execute* ``make_async_remote_copy``
     kernels on the current default backend. True only on real TPU —

@@ -1,0 +1,147 @@
+#!/usr/bin/env bash
+# osc/pallas smoke lane, three legs:
+#   1. 4-rank halo exchange (examples/halo_exchange.py) — the example
+#      itself asserts multi-step bit-identity of the epoch-scoped
+#      Put_strided schedule against the host AM window; the lane
+#      checks the success line and keeps the JSON summary.
+#   2. per-link RMA byte attribution at monitoring_level 2 on the
+#      4-rank torus: fence-flush puts must walk the CartTopo routes
+#      into monitoring_link_bytes_* pvars.
+#   3. a seeded stuck epoch: rank 1 Starts toward rank 0, which only
+#      Posts ~6s later — the telemetry watchdog must dump a hang
+#      report whose in-flight op names the window AND the peer group
+#      before the epoch resolves and the job completes cleanly.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${1:-osc_smoke_out}"
+rm -rf "$out"
+mkdir -p "$out"
+
+# -- leg 1: halo exchange bit-identity -----------------------------------
+halo=$(JAX_PLATFORMS=cpu \
+  OMPI_TPU_OSC_ARTIFACT="$out/halo_summary.json" \
+  python -m ompi_tpu.runtime.launcher -n 4 \
+  --timeout 150 \
+  --mca device_plane on \
+  --mca osc_pallas on \
+  examples/halo_exchange.py)
+echo "$halo"
+echo "$halo" | grep -q "bitwise vs host window" \
+  || { echo "osc smoke: missing halo bit-identity line" >&2; exit 1; }
+[ -s "$out/halo_summary.json" ] \
+  || { echo "osc smoke: halo summary artifact missing" >&2; exit 1; }
+
+# -- leg 2: per-link RMA bytes on the torus ------------------------------
+cat > "$out/link_job.py" <<'EOF'
+import json
+import os
+
+import jax.numpy as jnp
+
+from ompi_tpu import mpi, osc
+from ompi_tpu.core import pvar
+from ompi_tpu.monitoring import matrix
+from ompi_tpu.osc.pallas import PallasWindow
+
+comm = mpi.Init()
+rank, size = comm.rank, comm.size
+tm = matrix.TRAFFIC
+assert tm is not None and tm.level == 2 and tm.linkmap is not None
+win = osc.win_create(comm, jnp.zeros(64, jnp.float32), disp_unit=4)
+assert isinstance(win, PallasWindow), type(win).__name__
+win.Fence()
+win.Put(jnp.full(32, 1.0 + rank, jnp.float32), (rank + 1) % size)
+win.Fence()
+cell = tm.tables["osc"].get((rank + 1) % size)
+assert cell is not None and cell[1] >= 128.0, tm.tables["osc"]
+links = {n: int(v) for n, v in pvar.snapshot().items()
+         if n.startswith("monitoring_link_bytes_d")}
+assert links and any(v > 0 for v in links.values()), links
+win.Free()
+outdir = os.environ["OSC_SMOKE_OUT"]
+with open(f"{outdir}/links_rank{rank}.json", "w") as f:
+    json.dump({"rank": rank, "links": links}, f, indent=1)
+mpi.Finalize()
+EOF
+JAX_PLATFORMS=cpu OSC_SMOKE_OUT="$out" \
+  python -m ompi_tpu.runtime.launcher -n 4 \
+  --timeout 150 \
+  --mca device_plane on \
+  --mca osc_pallas on \
+  --mca monitoring_level 2 \
+  "$out/link_job.py"
+
+# -- leg 3: stuck PSCW epoch caught by the watchdog ----------------------
+cat > "$out/stuck_job.py" <<'EOF'
+import time
+
+import jax.numpy as jnp
+
+from ompi_tpu import mpi, osc
+
+comm = mpi.Init()
+rank, size = comm.rank, comm.size
+win = osc.win_create_pallas(comm, jnp.zeros(8, jnp.float32))
+win.Fence()  # warm-up: publish flight seqs
+if rank == 1:
+    # blocks in the osc_pallas_start flight slot until rank 0 posts
+    win.Start([0])
+    win.Put(jnp.ones(2, jnp.float32), 0)
+    win.Complete()
+elif rank == 0:
+    time.sleep(6.0)  # the seeded stall: well past the hang timeout
+    win.Post([1])
+    win.Wait()
+comm.barrier()
+win.Fence()
+win.Free()
+mpi.Finalize()
+EOF
+JAX_PLATFORMS=cpu python -m ompi_tpu.runtime.launcher -n 2 \
+  --timeout 150 \
+  --mca device_plane on \
+  --mca osc_pallas on \
+  --mca telemetry_enable 1 \
+  --mca telemetry_hang_timeout 2 \
+  --mca telemetry_watchdog_period 0.2 \
+  --mca telemetry_interval 0.5 \
+  --mca telemetry_dump_dir "$out" \
+  "$out/stuck_job.py"
+
+python - "$out" <<'EOF'
+import glob
+import json
+import sys
+
+out = sys.argv[1]
+
+halo = json.load(open(out + "/halo_summary.json"))
+assert halo["bitwise_vs_host"], halo
+assert halo["osc_pallas_rounds"] > 0 and halo["osc_pallas_bytes"] > 0, \
+    halo
+
+ranks = sorted(glob.glob(out + "/links_rank*.json"))
+assert len(ranks) == 4, ranks
+total = 0
+for path in ranks:
+    doc = json.load(open(path))
+    total += sum(doc["links"].values())
+assert total > 0, "no RMA bytes attributed to any torus link"
+
+dumps = sorted(glob.glob(out + "/ompi_tpu_hang_rank*_seq*.json"))
+assert dumps, f"no hang dump written in {out}"
+named = False
+for path in dumps:
+    doc = json.load(open(path))
+    ops = [str(doc["verdict"].get("op", ""))]
+    ops += [str(s.get("op", "")) for s in doc.get("inflight", [])]
+    if any("osc_pallas_start" in o and "peer=[0]" in o for o in ops):
+        assert any("win=" in o for o in ops if "osc_pallas_start" in o)
+        named = True
+assert named, \
+    f"no dump names the stuck osc_pallas_start epoch: {dumps}"
+print(f"osc smoke OK: halo bitwise over 4 ranks, "
+      f"{total} link-attributed RMA bytes, stuck epoch named in "
+      f"{len(dumps)} dump(s)")
+EOF

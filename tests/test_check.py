@@ -79,6 +79,41 @@ def test_pready_after_start_negative():
     """, rule="pready-outside-start") == []
 
 
+def test_osc_unclosed_epoch_positive():
+    fs = _lint("""
+        def f(comm, base, peers):
+            win = osc.win_create(comm, base)
+            win.Lock(1)
+            win.Put(base, 1)
+            win.Free()
+            w2 = osc.win_create_pallas(comm, base)
+            w2.Start(peers)
+            w2.Put(base, peers[0])
+            w2.Free()
+    """, rule="osc-unclosed-epoch")
+    assert len(fs) == 2
+    assert "no Unlock" in fs[0].message and "'win'" in fs[0].message
+    assert "no Complete" in fs[1].message and "'w2'" in fs[1].message
+
+
+def test_osc_unclosed_epoch_negative():
+    # closed epochs, windows from elsewhere, and attribute receivers
+    # are all quiet
+    assert _lint("""
+        def f(comm, base, peers, foreign):
+            win = osc.win_create(comm, base)
+            win.Lock(1)
+            win.Put(base, 1)
+            win.Unlock(1)
+            win.Post(peers)
+            win.Wait()
+            win.Free()
+            foreign.Lock(0)          # not created here: cannot see
+            self_like = comm
+            self_like.obj.Start(peers)  # attribute receiver: skip
+    """, rule="osc-unclosed-epoch") == []
+
+
 def test_rank_divergent_collective_positive():
     # superseded lexical rule's fixture, now caught (with both paths
     # named) by the CFG-based collective-order-divergence rule
